@@ -1,0 +1,22 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax imports.
+
+Mirrors the reference's testing stance (deterministic in-process multi-"node"
+simulation, `ydb/library/actors/testlib/test_runtime.h`): all sharding /
+collective paths are exercised on a virtual 8-device mesh in one process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may point at a TPU
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

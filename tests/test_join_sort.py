@@ -1,0 +1,94 @@
+"""Tests for broadcast join (searchsorted MapJoin) and device sort/top-k."""
+
+import numpy as np
+import pandas as pd
+
+from ydb_tpu.core import dtypes as dt
+from ydb_tpu.core.block import HostBlock
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.ops import join as mj
+from ydb_tpu.ops.device import to_device, to_host
+from ydb_tpu.ops.sort import sort_block
+from ydb_tpu.ops.xla_exec import compress_block
+
+
+def _dim_block(n=100):
+    return HostBlock.from_pandas(pd.DataFrame({
+        "pk": np.arange(n, dtype=np.int64) * 10,
+        "name": [f"item{i}" for i in range(n)],
+        "price": np.arange(n, dtype=np.float64) * 1.5,
+    }))
+
+
+def _fact_block(rng, n=5000, dim_n=100):
+    keys = rng.integers(0, dim_n * 2, n) * 10  # half miss
+    return HostBlock.from_pandas(pd.DataFrame({
+        "fk": keys.astype(np.int64),
+        "qty": rng.integers(1, 10, n).astype(np.int64),
+    }))
+
+
+def test_inner_join_matches_pandas(rng):
+    dim, fact = _dim_block(), _fact_block(rng)
+    table = mj.build(dim, "pk", ["name", "price"])
+    assert table.unique
+    out, sel = mj.probe(to_device(fact), table, "fk", kind="inner")
+    res = to_host(compress_block(out, sel)).to_pandas()
+
+    expect = fact.to_pandas().merge(
+        dim.to_pandas(), left_on="fk", right_on="pk")[["fk", "qty", "name", "price"]]
+    res_s = res.sort_values(["fk", "qty"]).reset_index(drop=True)
+    exp_s = expect.sort_values(["fk", "qty"]).reset_index(drop=True)
+    assert len(res_s) == len(exp_s)
+    np.testing.assert_array_equal(res_s["fk"].to_numpy(), exp_s["fk"].to_numpy())
+    np.testing.assert_allclose(
+        res_s["price"].to_numpy(np.float64), exp_s["price"].to_numpy(np.float64))
+    assert (res_s["name"] == exp_s["name"]).all()
+
+
+def test_left_join_nulls(rng):
+    dim, fact = _dim_block(), _fact_block(rng)
+    table = mj.build(dim, "pk", ["price"])
+    out, sel = mj.probe(to_device(fact), table, "fk", kind="left")
+    res = to_host(compress_block(out, sel)).to_pandas()
+    assert len(res) == fact.length
+    missing = res["price"].isna()
+    assert missing.any()
+    assert (res.loc[missing, "fk"].to_numpy() >= 1000).all()
+
+
+def test_semi_anti_join(rng):
+    dim, fact = _dim_block(), _fact_block(rng)
+    table = mj.build(dim, "pk", [])
+    dfact = to_device(fact)
+    _, sel_semi = mj.probe(dfact, table, "fk", kind="left_semi")
+    _, sel_anti = mj.probe(dfact, table, "fk", kind="left_anti")
+    n_semi = to_host(compress_block(dfact, sel_semi)).length
+    n_anti = to_host(compress_block(dfact, sel_anti)).length
+    assert n_semi + n_anti == fact.length
+    assert n_semi == int((fact.columns["fk"].data < 1000).sum())
+
+
+def test_sort_topk(rng):
+    n = 3000
+    b = HostBlock.from_pandas(pd.DataFrame({
+        "x": rng.integers(0, 1000, n).astype(np.int64),
+        "y": rng.normal(size=n),
+    }))
+    d = sort_block(to_device(b), [("x", False, False), ("y", True, False)], limit=50)
+    res = to_host(d).to_pandas()
+    exp = b.to_pandas().sort_values(["x", "y"], ascending=[False, True]).head(50)
+    np.testing.assert_array_equal(res["x"].to_numpy(), exp["x"].to_numpy())
+    np.testing.assert_allclose(res["y"].to_numpy(np.float64),
+                               exp["y"].to_numpy(np.float64))
+
+
+def test_sort_nulls_last(rng):
+    b = HostBlock.from_pandas(pd.DataFrame({
+        "x": [3.0, None, 1.0, 2.0, None],
+    }))
+    d = sort_block(to_device(b), [("x", True, False)])
+    res = to_host(d).to_pandas()
+    vals = res["x"].tolist()
+    assert vals[:3] == [1.0, 2.0, 3.0]
+    assert pd.isna(vals[3]) and pd.isna(vals[4])

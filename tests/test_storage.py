@@ -1,0 +1,103 @@
+"""Column store tests: insert/commit/indexate, MVCC, pruning, compaction."""
+
+import numpy as np
+import pandas as pd
+
+from ydb_tpu.core import dtypes as dt
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.ops import ir
+from ydb_tpu.ops.ir import Col, Const, call
+from ydb_tpu.storage.mvcc import Snapshot, WriteVersion
+from ydb_tpu.storage.pushdown import extract_prune_predicates
+from ydb_tpu.storage.table import ColumnTable
+
+
+SCHEMA = Schema([
+    Column("id", dt.DType(dt.Kind.INT64, nullable=False)),
+    Column("v", dt.FLOAT64),
+    Column("s", dt.STRING),
+])
+
+
+def _df(rng, n, base=0):
+    return pd.DataFrame({
+        "id": np.arange(base, base + n, dtype=np.int64),
+        "v": rng.normal(size=n),
+        "s": [f"tag{i % 5}" for i in range(n)],
+    })
+
+
+def test_write_commit_scan_mvcc(rng):
+    t = ColumnTable("t", SCHEMA, ["id"], shards=1, portion_rows=1000)
+    t.bulk_upsert(_df(rng, 2500), WriteVersion(10, 1))
+    t.bulk_upsert(_df(rng, 500, base=2500), WriteVersion(20, 1))
+    assert t.num_rows == 3000
+    # snapshot between the two commits sees only the first write
+    rows_old = sum(b.length for b in t.scan_shard(0, ["id"], Snapshot(15, 0)))
+    rows_new = sum(b.length for b in t.scan_shard(0, ["id"], Snapshot(25, 0)))
+    assert rows_old == 2500 and rows_new == 3000
+
+
+def test_uncommitted_invisible(rng):
+    t = ColumnTable("t", SCHEMA, ["id"], shards=1)
+    from ydb_tpu.core.block import HostBlock
+    block = HostBlock.from_pandas(_df(rng, 100), schema=SCHEMA,
+                                  dictionaries=t.dictionaries)
+    t.write(block)
+    assert sum(b.length for b in t.scan_shard(0, ["id"])) == 0
+
+
+def test_stats_pruning(rng):
+    t = ColumnTable("t", SCHEMA, ["id"], shards=1, portion_rows=1000)
+    t.bulk_upsert(_df(rng, 5000), WriteVersion(1, 1))
+    shard = t.shards[0]
+    assert len(shard.portions) == 5
+    # id >= 4500 touches only the last portion
+    blocks = list(shard.scan(["id"], prune_predicates=[("id", "ge", 4500)]))
+    assert sum(b.length for b in blocks) == 1000
+
+
+def test_prune_predicate_extraction():
+    p = (ir.Program()
+         .filter(call("and",
+                      call("ge", Col("a"), Const(5, dt.INT64)),
+                      call("lt", Const(3, dt.INT64), Col("b"))))
+         .filter(call("eq", Col("c"), Const(7, dt.INT64))))
+    preds = extract_prune_predicates(p)
+    assert ("a", "ge", 5) in preds
+    assert ("b", "gt", 3) in preds
+    assert ("c", "eq", 7) in preds
+
+
+def test_compaction(rng):
+    t = ColumnTable("t", SCHEMA, ["id"], shards=1, portion_rows=1000)
+    for i in range(10):
+        t.bulk_upsert(_df(rng, 100, base=i * 100), WriteVersion(1, 1))
+    shard = t.shards[0]
+    assert len(shard.portions) == 10
+    merged = shard.compact()
+    assert merged > 0
+    assert len(shard.portions) == 1
+    assert shard.num_rows == 1000
+
+
+def test_multi_shard_routing(rng):
+    t = ColumnTable("t", SCHEMA, ["id"], shards=4, portion_rows=1000)
+    t.bulk_upsert(_df(rng, 4000), WriteVersion(1, 1))
+    per_shard = [s.num_rows for s in t.shards]
+    assert sum(per_shard) == 4000
+    assert all(n > 0 for n in per_shard)
+    ids = np.concatenate([
+        np.concatenate([b.columns["id"].data for b in t.scan_shard(i, ["id"])])
+        for i in range(4)])
+    assert sorted(ids.tolist()) == list(range(4000))
+
+
+def test_string_dictionary_shared_across_shards(rng):
+    t = ColumnTable("t", SCHEMA, ["id"], shards=2)
+    t.bulk_upsert(_df(rng, 1000), WriteVersion(1, 1))
+    d = t.dictionaries["s"]
+    assert len(d) == 5
+    for i in range(2):
+        for b in t.scan_shard(i, ["s"]):
+            assert b.columns["s"].dictionary is d

@@ -1,0 +1,26 @@
+"""ydb_tpu — a TPU-native distributed SQL engine.
+
+A from-scratch framework with the capability surface of YDB (reference:
+waralex/ydb), redesigned TPU-first:
+
+- the columnar execution substrate is a typed SSA-style op IR
+  (``ydb_tpu.ops``) with a numpy oracle lowering and an XLA lowering
+  (``jax.jit`` per program/shape-bucket) — the analog of the reference's
+  ColumnShard SSA program (`ydb/core/protos/ssa.proto`) and MiniKQL block
+  compute nodes (`ydb/library/yql/minikql/comp_nodes/mkql_block_*.cpp`);
+- the storage layer is an embedded column store mirroring ColumnShard's
+  InsertTable/portions/compaction model (`ydb/core/tx/columnshard/engines/`);
+- distributed execution is a DQ-style stage/task/channel graph
+  (`ydb/library/yql/dq/`) whose hash shuffles lower to XLA collectives over
+  a `jax.sharding.Mesh` instead of Interconnect TCP channels.
+
+Numeric policy: f64/i64 are first-class (TPU emulates f64 with adequate
+precision for SQL aggregate semantics); therefore jax x64 mode is enabled
+at package import.
+"""
+
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+from ydb_tpu.core import dtypes
+from ydb_tpu.core.block import HostBlock
+from ydb_tpu.core.schema import Column, Schema
+
+__all__ = ["dtypes", "HostBlock", "Column", "Schema"]

@@ -1,0 +1,71 @@
+"""Append-only string dictionaries.
+
+The device data plane never sees raw bytes: string columns travel as int32
+codes; the dictionary (codes → values) stays on the host. String predicates
+(LIKE/eq/substr) are evaluated once over the dictionary on the host, producing
+a boolean/typed lookup table the device gathers through — the TPU-native
+counterpart of the reference's dictionary encoding
+(`ydb/core/formats/arrow/dictionary/`) + hyperscan/re2 string UDFs
+(`ydb/library/yql/udfs/common/`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Dictionary:
+    """Append-only value dictionary: value <-> int32 code."""
+
+    __slots__ = ("_map", "_values")
+
+    def __init__(self):
+        self._map: dict[str, int] = {}
+        self._values: list[str] = []
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def encode(self, values) -> np.ndarray:
+        """Encode an iterable of python strings (None → code -1)."""
+        m = self._map
+        vals = self._values
+        out = np.empty(len(values), dtype=np.int32)
+        for i, v in enumerate(values):
+            if v is None:
+                out[i] = -1
+                continue
+            code = m.get(v)
+            if code is None:
+                code = len(vals)
+                m[v] = code
+                vals.append(v)
+            out[i] = code
+        return out
+
+    def encode_existing(self, value: str) -> int:
+        """Code for a value, or -2 (never matches) if absent."""
+        return self._map.get(value, -2)
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        values = np.asarray(self._values, dtype=object)
+        out = np.empty(len(codes), dtype=object)
+        ok = codes >= 0
+        out[ok] = values[codes[ok]]
+        out[~ok] = None
+        return out
+
+    def values_array(self) -> np.ndarray:
+        return np.asarray(self._values, dtype=object)
+
+    def lut(self, predicate) -> np.ndarray:
+        """Evaluate `predicate(value) -> bool` over all dictionary entries.
+
+        Returns a bool LUT of len(dict); the device evaluates the predicate
+        on a code column as `lut[code]` (a gather).
+        """
+        vals = self._values
+        out = np.empty(len(vals), dtype=np.bool_)
+        for i, v in enumerate(vals):
+            out[i] = predicate(v)
+        return out

@@ -1,0 +1,8 @@
+from ydb_tpu.ops.ir import (
+    Agg, Assign, Call, Col, Const, Filter, GroupBy, Param, Program, Projection,
+)
+
+__all__ = [
+    "Agg", "Assign", "Call", "Col", "Const", "Filter", "GroupBy", "Param",
+    "Program", "Projection",
+]

@@ -1,0 +1,68 @@
+"""Device-resident blocks.
+
+A ``DeviceBlock`` keeps a block's columns on the accelerator between
+operators of the same stage — the analog of MiniKQL block values flowing
+between Block* computation nodes without leaving the engine
+(`mkql_computation_node_holders.h:577` TArrowBlock). Host round-trips happen
+only at channel boundaries (serialization) or result egress.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ydb_tpu.core.block import ColumnData, HostBlock
+from ydb_tpu.core.dictionary import Dictionary
+from ydb_tpu.core.schema import Schema
+
+
+def bucket_capacity(n: int, minimum: int = 8192) -> int:
+    cap = minimum
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+@dataclass
+class DeviceBlock:
+    schema: Schema
+    arrays: dict                      # name -> jnp array (len = capacity)
+    valids: dict                      # name -> jnp bool array (subset of names)
+    length: object                    # traced/concrete scalar int32
+    capacity: int
+    dictionaries: dict = field(default_factory=dict)  # name -> Dictionary
+
+    def sig(self) -> tuple:
+        return tuple((c.name, c.dtype.kind.value, c.name in self.valids)
+                     for c in self.schema)
+
+
+def to_device(block: HostBlock, capacity: Optional[int] = None) -> DeviceBlock:
+    cap = capacity or bucket_capacity(max(block.length, 1))
+    arrays, valids, dicts = {}, {}, {}
+    pad = cap - block.length
+    for c in block.schema:
+        cd = block.columns[c.name]
+        data = np.pad(cd.data, (0, pad)) if pad else cd.data
+        arrays[c.name] = jnp.asarray(data)
+        if cd.valid is not None:
+            v = np.pad(cd.valid, (0, pad)) if pad else cd.valid
+            valids[c.name] = jnp.asarray(v)
+        if cd.dictionary is not None:
+            dicts[c.name] = cd.dictionary
+    return DeviceBlock(block.schema, arrays, valids, jnp.int32(block.length),
+                       cap, dicts)
+
+
+def to_host(dblock: DeviceBlock) -> HostBlock:
+    n = int(dblock.length)
+    cols = {}
+    for c in dblock.schema:
+        d = np.asarray(dblock.arrays[c.name][:n]).astype(c.dtype.np)
+        v = np.asarray(dblock.valids[c.name][:n]) if c.name in dblock.valids else None
+        cols[c.name] = ColumnData(d, v, dblock.dictionaries.get(c.name))
+    return HostBlock(dblock.schema, cols, n)

@@ -1,0 +1,165 @@
+"""Broadcast hash-join — TPU-native MapJoin.
+
+The reference's broadcast join (`mkql_map_join.cpp` MapJoinCore) builds a
+host hash table and probes row-by-row. The TPU-native design replaces the
+probe with a fully vectorized binary search over a *sorted* build side:
+
+  * build (host, once per build table): sort build keys, keep the
+    permutation — O(n log n) on small dimension tables;
+  * probe (device, per block): ``jnp.searchsorted`` (vectorized binary
+    search, log2(n) gathers) + one equality check + payload gathers.
+
+Duplicate build keys are rejected for inner/left probes (raises; the
+planner must route such joins to the partitioned GraceJoin path once it
+exists); semi/anti joins tolerate duplicates since they only test
+membership.
+
+Join kinds: inner, left, left_semi, left_anti (the kinds KQP plans emit for
+broadcast joins).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydb_tpu.core.block import ColumnData, HostBlock
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.ops.device import DeviceBlock, bucket_capacity
+
+
+def _host_key(block: HostBlock, name: str) -> tuple[np.ndarray, Optional[np.ndarray]]:
+    """Key in its search domain: float keys stay float64, the rest int64.
+
+    (No IEEE bitcast encodings: the TPU x64 emulation pass cannot rewrite
+    f64<->s64 bitcasts, and searchsorted compares floats natively.)"""
+    cd = block.columns[name]
+    d = cd.data
+    if np.issubdtype(d.dtype, np.floating):
+        return d.astype(np.float64), cd.valid
+    return d.astype(np.int64), cd.valid
+
+
+@dataclass
+class BuildTable:
+    """Sorted build side, resident on device."""
+    keys_sorted: object            # jnp int64 (padded with INT64_MAX)
+    n: int                         # real build rows
+    payload: dict                  # name -> jnp array (sorted by key)
+    payload_valid: dict            # name -> jnp bool
+    schema: Schema                 # payload schema
+    dictionaries: dict
+    unique: bool
+
+
+def build(block: HostBlock, key: str, payload_names: list[str]) -> BuildTable:
+    enc, valid = _host_key(block, key)
+    if valid is not None:
+        # null build keys never match; drop them
+        keep = np.nonzero(valid)[0]
+        block = block.take(keep)
+        enc = enc[keep]
+    order = np.argsort(enc, kind="stable")
+    enc = enc[order]
+    unique = bool(np.all(np.diff(enc) != 0)) if len(enc) > 1 else True
+    cap = bucket_capacity(max(len(enc), 1), minimum=128)
+    sentinel = np.inf if enc.dtype == np.float64 else np.iinfo(np.int64).max
+    keys_pad = np.full(cap, sentinel, dtype=enc.dtype)
+    keys_pad[:len(enc)] = enc
+    payload, payload_valid, dicts = {}, {}, {}
+    for name in payload_names:
+        cd = block.columns[name]
+        d = cd.data[order]
+        pad = np.zeros(cap - len(d), dtype=d.dtype)
+        payload[name] = jnp.asarray(np.concatenate([d, pad]))
+        if cd.valid is not None:
+            v = np.concatenate([cd.valid[order], np.zeros(cap - len(d), np.bool_)])
+            payload_valid[name] = jnp.asarray(v)
+        if cd.dictionary is not None:
+            dicts[name] = cd.dictionary
+    return BuildTable(jnp.asarray(keys_pad), len(enc), payload, payload_valid,
+                      block.schema.select(payload_names), dicts, unique)
+
+
+def _probe_enc(d):
+    if d.dtype in (jnp.float64, jnp.float32):
+        return d.astype(jnp.float64)
+    return d.astype(jnp.int64)
+
+
+@partial(jax.jit, static_argnames=("probe_key", "kind", "payload_names"))
+def _probe(probe_arrays, probe_valids, length, sel, n_build,
+           keys_sorted, payload, payload_valid,
+           probe_key, kind: str, payload_names: tuple):
+    cap = probe_arrays[probe_key].shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    row_mask = iota < length
+    active = row_mask if sel is None else (row_mask & sel)
+
+    d = probe_arrays[probe_key]
+    enc = _probe_enc(d)
+    v = probe_valids.get(probe_key)
+    # NULL probe keys never match but must survive LEFT / LEFT ANTI joins
+    matchable = active if v is None else (active & v)
+
+    padded = keys_sorted.shape[0]
+    pos = jnp.searchsorted(keys_sorted, enc).astype(jnp.int32)
+    safe = jnp.clip(pos, 0, padded - 1)
+    # `safe < n_build` guards against probe keys equal to the padding
+    # sentinel (INT64_MAX / +inf) matching padding slots
+    found = (keys_sorted[safe] == enc) & matchable & (safe < n_build)
+
+    out_sel = found if kind in ("inner", "left_semi") else (
+        (~found) & active if kind == "left_anti" else active)
+
+    gathered, gathered_valid = {}, {}
+    if kind in ("inner", "left"):
+        for name in payload_names:
+            pd_ = payload[name][safe]
+            gathered[name] = pd_
+            pv = payload_valid.get(name)
+            gv = found if pv is None else (found & pv[safe])
+            gathered_valid[name] = gv
+    return out_sel, gathered, gathered_valid
+
+
+def probe(dblock: DeviceBlock, table: BuildTable, probe_key: str,
+          kind: str = "inner", sel=None,
+          rename: Optional[dict] = None) -> tuple[DeviceBlock, object]:
+    """Probe a device block against a build table.
+
+    Returns (new DeviceBlock with payload columns appended, new selection
+    mask). The caller decides when to compress.
+    """
+    if not table.unique and kind in ("inner", "left"):
+        raise ValueError(
+            "broadcast MapJoin requires unique build keys for inner/left "
+            "joins; duplicate keys need the partitioned GraceJoin path")
+    rename = rename or {}
+    names = tuple(table.schema.names)
+    out_sel, gathered, gathered_valid = _probe(
+        dblock.arrays, dblock.valids, dblock.length, sel, jnp.int32(table.n),
+        table.keys_sorted, table.payload, table.payload_valid,
+        probe_key, kind, names)
+
+    arrays = dict(dblock.arrays)
+    valids = dict(dblock.valids)
+    dicts = dict(dblock.dictionaries)
+    cols = list(dblock.schema.columns)
+    if kind in ("inner", "left"):
+        for name in names:
+            out_name = rename.get(name, name)
+            arrays[out_name] = gathered[name]
+            valids[out_name] = gathered_valid[name]
+            dt = table.schema.dtype(name).with_nullable(True)
+            cols = [c for c in cols if c.name != out_name] + [Column(out_name, dt)]
+            if name in table.dictionaries:
+                dicts[out_name] = table.dictionaries[name]
+    schema = Schema(cols)
+    out = DeviceBlock(schema, arrays, valids, dblock.length, dblock.capacity, dicts)
+    return out, out_sel

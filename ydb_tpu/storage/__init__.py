@@ -1,0 +1,4 @@
+from ydb_tpu.storage.mvcc import Snapshot, WriteVersion
+from ydb_tpu.storage.table import ColumnTable
+
+__all__ = ["ColumnTable", "Snapshot", "WriteVersion"]

@@ -1,0 +1,78 @@
+"""Immutable indexed portions with per-column statistics.
+
+The unit of storage in a column shard — analog of the reference's portion
+(`ydb/core/tx/columnshard/engines/portions/`): an immutable columnar chunk
+with min/max stats per column used for scan pruning, stamped with the MVCC
+write version that committed it.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ydb_tpu.core.block import HostBlock
+from ydb_tpu.storage.mvcc import WriteVersion
+
+_portion_ids = itertools.count(1)
+
+
+@dataclass
+class ColumnStats:
+    min: object = None
+    max: object = None
+    null_count: int = 0
+
+
+@dataclass
+class Portion:
+    block: HostBlock
+    version: WriteVersion
+    stats: dict = field(default_factory=dict)   # col name -> ColumnStats
+    id: int = field(default_factory=lambda: next(_portion_ids))
+
+    @property
+    def num_rows(self) -> int:
+        return self.block.length
+
+    @staticmethod
+    def from_block(block: HostBlock, version: WriteVersion) -> "Portion":
+        stats = {}
+        for c in block.schema:
+            cd = block.columns[c.name]
+            st = ColumnStats()
+            if cd.valid is not None:
+                st.null_count = int((~cd.valid).sum())
+                vals = cd.data[cd.valid]
+            else:
+                vals = cd.data
+            if len(vals) and not c.dtype.is_string:
+                st.min = vals.min()
+                st.max = vals.max()
+            stats[c.name] = st
+        return Portion(block, version, stats)
+
+
+def prune_by_range(portion: Portion, col: str, op: str, value) -> bool:
+    """True if the portion can be skipped for `col <op> value` (no row matches).
+
+    The pruning analog of the reference's early-filter index checks
+    (`engines/reader/.../fetching.h` TApplyIndexStep / TPredicateFilter)."""
+    st = portion.stats.get(col)
+    if st is None or st.min is None:
+        return False
+    lo, hi = st.min, st.max
+    if op == "eq":
+        return value < lo or value > hi
+    if op == "lt":
+        return lo >= value
+    if op == "le":
+        return lo > value
+    if op == "gt":
+        return hi <= value
+    if op == "ge":
+        return hi < value
+    return False

@@ -1,0 +1,159 @@
+"""A single column shard: insert buffer → portions → compaction → scan.
+
+Mirrors the reference ColumnShard's write/read lifecycle
+(`ydb/core/tx/columnshard/columnshard_impl.h`):
+
+  * writes land in an **insert table** of uncommitted blobs
+    (`engines/insert_table/`), become visible at commit (plan step);
+  * **indexation** turns committed inserts into immutable portions with
+    stats (`engines/changes/indexation.cpp`);
+  * **compaction** merges small portions (`general_compaction.cpp`);
+  * **scan** iterates portions under an MVCC snapshot, prunes by stats,
+    and hands blocks to the device program — the per-portion early-filter
+    shape of `engines/reader/plain_reader/iterator/`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ydb_tpu.core.block import HostBlock
+from ydb_tpu.core.schema import Schema
+from ydb_tpu.ops import ir
+from ydb_tpu.storage.mvcc import MAX_SNAPSHOT, Snapshot, WriteVersion
+from ydb_tpu.storage.portion import Portion, prune_by_range
+
+DEFAULT_PORTION_ROWS = 1 << 20
+COMPACT_MIN_PORTIONS = 8
+
+
+@dataclass
+class InsertEntry:
+    block: HostBlock
+    write_id: int
+    committed_version: Optional[WriteVersion] = None
+
+
+class ColumnShard:
+    def __init__(self, schema: Schema, shard_id: int = 0,
+                 portion_rows: int = DEFAULT_PORTION_ROWS):
+        self.schema = schema
+        self.shard_id = shard_id
+        self.portion_rows = portion_rows
+        self.portions: list[Portion] = []
+        self.inserts: list[InsertEntry] = []
+        self._next_write_id = 1
+        self.rows_written = 0
+
+    # -- write path -------------------------------------------------------
+
+    def write(self, block: HostBlock) -> int:
+        """Stage an uncommitted insert; returns write id (InsertTable model)."""
+        wid = self._next_write_id
+        self._next_write_id += 1
+        self.inserts.append(InsertEntry(block, wid))
+        return wid
+
+    def commit(self, write_ids: list[int], version: WriteVersion) -> None:
+        for e in self.inserts:
+            if e.write_id in write_ids:
+                e.committed_version = version
+                self.rows_written += e.block.length
+
+    def indexate(self) -> int:
+        """Background indexation: committed inserts → portions. Returns #portions."""
+        ready = [e for e in self.inserts if e.committed_version is not None]
+        if not ready:
+            return 0
+        self.inserts = [e for e in self.inserts if e.committed_version is None]
+        made = 0
+        # group by version so a portion has a single write version
+        by_ver: dict[WriteVersion, list[HostBlock]] = {}
+        for e in ready:
+            by_ver.setdefault(e.committed_version, []).append(e.block)
+        for ver, blocks in by_ver.items():
+            merged = HostBlock.concat(blocks)
+            for start in range(0, merged.length, self.portion_rows):
+                chunk = merged.slice(start, min(start + self.portion_rows, merged.length))
+                self.portions.append(Portion.from_block(chunk, ver))
+                made += 1
+        return made
+
+    def compact(self) -> int:
+        """Merge adjacent small portions of the same version into full ones."""
+        small = [p for p in self.portions if p.num_rows < self.portion_rows // 2]
+        if len(small) < COMPACT_MIN_PORTIONS:
+            return 0
+        by_ver: dict[WriteVersion, list[Portion]] = {}
+        for p in small:
+            by_ver.setdefault(p.version, []).append(p)
+        merged_count = 0
+        for ver, ps in by_ver.items():
+            if len(ps) < 2:
+                continue
+            ids = {p.id for p in ps}
+            self.portions = [p for p in self.portions if p.id not in ids]
+            merged = HostBlock.concat([p.block for p in ps])
+            for start in range(0, merged.length, self.portion_rows):
+                chunk = merged.slice(start, min(start + self.portion_rows, merged.length))
+                self.portions.append(Portion.from_block(chunk, ver))
+                merged_count += len(ps)
+        return merged_count
+
+    # -- read path --------------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return sum(p.num_rows for p in self.portions) + sum(
+            e.block.length for e in self.inserts if e.committed_version)
+
+    def scan(self, columns: list[str],
+             snapshot: Snapshot = MAX_SNAPSHOT,
+             prune_predicates: Optional[list[tuple]] = None,
+             block_rows: Optional[int] = None) -> Iterator[HostBlock]:
+        """Yield host blocks of ~block_rows under the snapshot.
+
+        prune_predicates: [(col, op, value)] conjuncts for min/max pruning.
+        """
+        block_rows = block_rows or self.portion_rows
+        prune_predicates = prune_predicates or []
+        pending: list[HostBlock] = []
+        pending_rows = 0
+
+        def flush():
+            nonlocal pending, pending_rows
+            if pending:
+                out = HostBlock.concat(pending) if len(pending) > 1 else pending[0]
+                pending, pending_rows = [], 0
+                return out
+            return None
+
+        sources: list[HostBlock] = []
+        for p in self.portions:
+            if not snapshot.includes(p.version):
+                continue
+            if any(prune_by_range(p, c, op, v) for (c, op, v) in prune_predicates):
+                continue
+            sources.append(p.block)
+        for e in self.inserts:  # committed-but-unindexed inserts are visible
+            if e.committed_version and snapshot.includes(e.committed_version):
+                sources.append(e.block)
+
+        for src in sources:
+            blk = src.select(columns)
+            pos = 0
+            while pos < blk.length:
+                take = min(block_rows - pending_rows, blk.length - pos)
+                pending.append(blk.slice(pos, pos + take))
+                pending_rows += take
+                pos += take
+                if pending_rows >= block_rows:
+                    out = flush()
+                    if out is not None:
+                        yield out
+        out = flush()
+        if out is not None:
+            yield out

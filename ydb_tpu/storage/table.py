@@ -1,0 +1,94 @@
+"""Sharded column tables.
+
+A table is N ``ColumnShard``s; rows are routed by hash of the first
+partitioning key column — the analog of the reference's hash-sharded OLAP
+tables (`ydb/core/tx/data_events/shards_splitter.cpp` hash splitter, and
+SchemeShard's partitioning metadata). String columns share one table-wide
+dictionary per column so codes are comparable across shards and portions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ydb_tpu.core.block import HostBlock
+from ydb_tpu.core.dictionary import Dictionary
+from ydb_tpu.core.schema import Schema
+from ydb_tpu.storage.mvcc import MAX_SNAPSHOT, Snapshot, WriteVersion
+from ydb_tpu.storage.shard import ColumnShard
+from ydb_tpu.utils.hashing import splitmix64
+
+
+class ColumnTable:
+    def __init__(self, name: str, schema: Schema, key_columns: list[str],
+                 shards: int = 1, portion_rows: int = 1 << 20,
+                 partition_by: Optional[list[str]] = None):
+        if not key_columns:
+            raise ValueError("column tables need a primary key")
+        for k in key_columns:
+            if not schema.has(k):
+                raise ValueError(f"unknown key column {k}")
+        self.name = name
+        self.schema = schema
+        self.key_columns = key_columns
+        self.partition_by = partition_by or [key_columns[0]]
+        self.shards = [ColumnShard(schema, i, portion_rows) for i in range(shards)]
+        self.dictionaries: dict[str, Dictionary] = {
+            c.name: Dictionary() for c in schema if c.dtype.is_string}
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+    @property
+    def num_rows(self) -> int:
+        return sum(s.num_rows for s in self.shards)
+
+    # -- write path -------------------------------------------------------
+
+    def _route(self, block: HostBlock) -> np.ndarray:
+        col = self.partition_by[0]
+        cd = block.columns[col]
+        h = splitmix64(np, cd.data)
+        return (h % np.uint64(len(self.shards))).astype(np.int64)
+
+    def write(self, block: HostBlock) -> list[tuple[int, int]]:
+        """Stage rows into shards; returns [(shard_id, write_id)]."""
+        if len(self.shards) == 1:
+            return [(0, self.shards[0].write(block))]
+        dest = self._route(block)
+        out = []
+        for sid in range(len(self.shards)):
+            idx = np.nonzero(dest == sid)[0]
+            if len(idx):
+                out.append((sid, self.shards[sid].write(block.take(idx))))
+        return out
+
+    def commit(self, writes: list[tuple[int, int]], version: WriteVersion) -> None:
+        by_shard: dict[int, list[int]] = {}
+        for sid, wid in writes:
+            by_shard.setdefault(sid, []).append(wid)
+        for sid, wids in by_shard.items():
+            self.shards[sid].commit(wids, version)
+
+    def bulk_upsert(self, df, version: WriteVersion) -> int:
+        """Ingest a pandas DataFrame (BulkUpsert analog): write+commit+indexate."""
+        block = HostBlock.from_pandas(df, schema=self.schema,
+                                      dictionaries=self.dictionaries)
+        writes = self.write(block)
+        self.commit(writes, version)
+        for s in self.shards:
+            s.indexate()
+        return block.length
+
+    # -- read path --------------------------------------------------------
+
+    def scan_shard(self, shard_id: int, columns: list[str],
+                   snapshot: Snapshot = MAX_SNAPSHOT,
+                   prune_predicates: Optional[list[tuple]] = None,
+                   block_rows: Optional[int] = None) -> Iterator[HostBlock]:
+        return self.shards[shard_id].scan(columns, snapshot,
+                                          prune_predicates, block_rows)

@@ -1,0 +1,69 @@
+"""Benchmark: TPC-H Q1 scan+aggregate throughput on the device.
+
+Runs the full SQL path (parse → plan → pushdown → device programs →
+two-phase aggregation) over a generated TPC-H lineitem at BENCH_SF, and an
+independent CPU baseline (pandas) over the same data — the measured analog
+of the reference's `ydb workload tpch run` (no published numbers exist
+in-repo; see BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": "tpch_q1_rows_per_sec", "value": N, "unit": "rows/s",
+   "vs_baseline": device_throughput / pandas_cpu_throughput}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SF = float(os.environ.get("BENCH_SF", "0.1"))
+REPEATS = int(os.environ.get("BENCH_REPEATS", "3"))
+
+
+def main() -> None:
+    from ydb_tpu.bench.tpch_gen import load_tpch
+    from ydb_tpu.query import QueryEngine
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.tpch_util import QUERIES, oracle
+
+    eng = QueryEngine(block_rows=1 << 20)
+    data = load_tpch(eng.catalog, sf=SF)
+    n_rows = eng.catalog.table("lineitem").num_rows
+
+    q1 = QUERIES["q1"]
+    eng.query(q1)                       # warm-up: compile all programs
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        got = eng.query(q1)
+        times.append(time.perf_counter() - t0)
+    device_t = min(times)
+
+    t0 = time.perf_counter()
+    want = oracle("q1", data)
+    cpu_t = time.perf_counter() - t0
+
+    # correctness gate: a fast wrong answer scores zero
+    want_sorted = want.sort_values(["l_returnflag", "l_linestatus"])
+    np.testing.assert_allclose(
+        got["sum_charge"].to_numpy(dtype=np.float64),
+        want_sorted["sum_charge"].to_numpy(dtype=np.float64), rtol=1e-9)
+    np.testing.assert_array_equal(
+        got["count_order"].to_numpy(dtype=np.int64),
+        want_sorted["count_order"].to_numpy(dtype=np.int64))
+
+    value = n_rows / device_t
+    print(json.dumps({
+        "metric": "tpch_q1_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/s",
+        "vs_baseline": round((n_rows / cpu_t) and value / (n_rows / cpu_t), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,245 @@
+"""TPC-H query texts + pandas oracle helpers for tests and bench.
+
+The oracle role: what Arrow-compute is to the reference's SSA executor
+(`ydb/core/formats/arrow/program.cpp`), pandas is here — an independent
+CPU evaluation of the same query over the same generated data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from ydb_tpu.bench.tpch_gen import TpchData, date32
+
+
+def frames(data: TpchData) -> dict[str, pd.DataFrame]:
+    return {name: pd.DataFrame(cols) for name, cols in data.tables.items()}
+
+
+QUERIES: dict[str, str] = {
+    "q1": """
+select l_returnflag, l_linestatus,
+  sum(l_quantity) as sum_qty,
+  sum(l_extendedprice) as sum_base_price,
+  sum(l_extendedprice*(1-l_discount)) as sum_disc_price,
+  sum(l_extendedprice*(1-l_discount)*(1+l_tax)) as sum_charge,
+  avg(l_quantity) as avg_qty, avg(l_extendedprice) as avg_price,
+  avg(l_discount) as avg_disc, count(*) as count_order
+from lineitem
+where l_shipdate <= date '1998-12-01' - interval '90' day
+group by l_returnflag, l_linestatus
+order by l_returnflag, l_linestatus""",
+    "q3": """
+select l_orderkey, sum(l_extendedprice*(1-l_discount)) as revenue,
+  o_orderdate, o_shippriority
+from customer, orders, lineitem
+where c_mktsegment = 'BUILDING' and c_custkey = o_custkey
+  and l_orderkey = o_orderkey
+  and o_orderdate < date '1995-03-15' and l_shipdate > date '1995-03-15'
+group by l_orderkey, o_orderdate, o_shippriority
+order by revenue desc, o_orderdate
+limit 10""",
+    "q5": """
+select n_name, sum(l_extendedprice*(1-l_discount)) as revenue
+from customer, orders, lineitem, supplier, nation, region
+where c_custkey = o_custkey and l_orderkey = o_orderkey
+  and l_suppkey = s_suppkey and c_nationkey = s_nationkey
+  and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+  and r_name = 'ASIA'
+  and o_orderdate >= date '1994-01-01'
+  and o_orderdate < date '1994-01-01' + interval '1' year
+group by n_name
+order by revenue desc""",
+    "q6": """
+select sum(l_extendedprice*l_discount) as revenue
+from lineitem
+where l_shipdate >= date '1994-01-01'
+  and l_shipdate < date '1994-01-01' + interval '1' year
+  and l_discount between 0.05 and 0.07
+  and l_quantity < 24""",
+    "q10": """
+select c_custkey, c_name, sum(l_extendedprice*(1-l_discount)) as revenue,
+  c_acctbal, n_name, c_address, c_phone, c_comment
+from customer, orders, lineitem, nation
+where c_custkey = o_custkey and l_orderkey = o_orderkey
+  and o_orderdate >= date '1993-10-01'
+  and o_orderdate < date '1993-10-01' + interval '3' month
+  and l_returnflag = 'R' and c_nationkey = n_nationkey
+group by c_custkey, c_name, c_acctbal, c_phone, n_name, c_address, c_comment
+order by revenue desc
+limit 20""",
+    "q12": """
+select l_shipmode,
+  sum(case when o_orderpriority = '1-URGENT' or o_orderpriority = '2-HIGH'
+      then 1 else 0 end) as high_line_count,
+  sum(case when o_orderpriority <> '1-URGENT' and o_orderpriority <> '2-HIGH'
+      then 1 else 0 end) as low_line_count
+from orders, lineitem
+where o_orderkey = l_orderkey and l_shipmode in ('MAIL', 'SHIP')
+  and l_commitdate < l_receiptdate and l_shipdate < l_commitdate
+  and l_receiptdate >= date '1994-01-01'
+  and l_receiptdate < date '1994-01-01' + interval '1' year
+group by l_shipmode
+order by l_shipmode""",
+    "q14": """
+select 100.00 * sum(case when p_type like 'PROMO%'
+    then l_extendedprice*(1-l_discount) else 0 end)
+  / sum(l_extendedprice*(1-l_discount)) as promo_revenue
+from lineitem, part
+where l_partkey = p_partkey
+  and l_shipdate >= date '1995-09-01'
+  and l_shipdate < date '1995-09-01' + interval '1' month""",
+    "q19": """
+select sum(l_extendedprice*(1-l_discount)) as revenue
+from lineitem, part
+where (p_partkey = l_partkey and p_brand = 'Brand#12'
+   and p_container in ('SM CASE','SM BOX','SM PACK','SM PKG')
+   and l_quantity >= 1 and l_quantity <= 11 and p_size between 1 and 5
+   and l_shipmode in ('AIR','AIR REG') and l_shipinstruct = 'DELIVER IN PERSON')
+or (p_partkey = l_partkey and p_brand = 'Brand#23'
+   and p_container in ('MED BAG','MED BOX','MED PKG','MED PACK')
+   and l_quantity >= 10 and l_quantity <= 20 and p_size between 1 and 10
+   and l_shipmode in ('AIR','AIR REG') and l_shipinstruct = 'DELIVER IN PERSON')
+or (p_partkey = l_partkey and p_brand = 'Brand#34'
+   and p_container in ('LG CASE','LG BOX','LG PACK','LG PKG')
+   and l_quantity >= 20 and l_quantity <= 30 and p_size between 1 and 15
+   and l_shipmode in ('AIR','AIR REG') and l_shipinstruct = 'DELIVER IN PERSON')""",
+}
+
+
+def oracle(name: str, data: TpchData) -> pd.DataFrame:
+    f = frames(data)
+    li, od, cu = f["lineitem"], f["orders"], f["customer"]
+    if name == "q1":
+        d = li[li.l_shipdate <= date32(1998, 12, 1) - 90]
+        disc = d.l_extendedprice * (1 - d.l_discount)
+        d = d.assign(dp=disc, ch=disc * (1 + d.l_tax))
+        g = d.groupby(["l_returnflag", "l_linestatus"], sort=True).agg(
+            sum_qty=("l_quantity", "sum"), sum_base_price=("l_extendedprice", "sum"),
+            sum_disc_price=("dp", "sum"), sum_charge=("ch", "sum"),
+            avg_qty=("l_quantity", "mean"), avg_price=("l_extendedprice", "mean"),
+            avg_disc=("l_discount", "mean"), count_order=("l_orderkey", "count"),
+        ).reset_index()
+        return g
+    if name == "q3":
+        c = cu[cu.c_mktsegment == "BUILDING"]
+        o = od[od.o_orderdate < date32(1995, 3, 15)]
+        l = li[li.l_shipdate > date32(1995, 3, 15)]
+        j = l.merge(o, left_on="l_orderkey", right_on="o_orderkey") \
+             .merge(c, left_on="o_custkey", right_on="c_custkey")
+        j = j.assign(rev=j.l_extendedprice * (1 - j.l_discount))
+        g = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"]).rev.sum() \
+             .reset_index().rename(columns={"rev": "revenue"})
+        g = g.sort_values(["revenue", "o_orderdate"],
+                          ascending=[False, True], kind="stable").head(10)
+        return g[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+    if name == "q5":
+        na, re_, su = f["nation"], f["region"], f["supplier"]
+        r = re_[re_.r_name == "ASIA"]
+        n = na.merge(r, left_on="n_regionkey", right_on="r_regionkey")
+        o = od[(od.o_orderdate >= date32(1994, 1, 1))
+               & (od.o_orderdate < date32(1995, 1, 1))]
+        j = li.merge(o, left_on="l_orderkey", right_on="o_orderkey") \
+              .merge(cu, left_on="o_custkey", right_on="c_custkey") \
+              .merge(su, left_on="l_suppkey", right_on="s_suppkey") \
+              .merge(n, left_on="s_nationkey", right_on="n_nationkey")
+        j = j[j.c_nationkey == j.s_nationkey]
+        j = j.assign(rev=j.l_extendedprice * (1 - j.l_discount))
+        g = j.groupby("n_name").rev.sum().reset_index() \
+             .rename(columns={"rev": "revenue"})
+        return g.sort_values("revenue", ascending=False, kind="stable")
+    if name == "q6":
+        d = li[(li.l_shipdate >= date32(1994, 1, 1))
+               & (li.l_shipdate < date32(1995, 1, 1))
+               & (li.l_discount >= 0.05 - 1e-12) & (li.l_discount <= 0.07 + 1e-12)
+               & (li.l_quantity < 24)]
+        return pd.DataFrame({"revenue": [(d.l_extendedprice * d.l_discount).sum()]})
+    if name == "q10":
+        na = f["nation"]
+        o = od[(od.o_orderdate >= date32(1993, 10, 1))
+               & (od.o_orderdate < date32(1994, 1, 1))]
+        l = li[li.l_returnflag == "R"]
+        j = l.merge(o, left_on="l_orderkey", right_on="o_orderkey") \
+             .merge(cu, left_on="o_custkey", right_on="c_custkey") \
+             .merge(na, left_on="c_nationkey", right_on="n_nationkey")
+        j = j.assign(rev=j.l_extendedprice * (1 - j.l_discount))
+        g = j.groupby(["c_custkey", "c_name", "c_acctbal", "c_phone",
+                       "n_name", "c_address", "c_comment"]).rev.sum() \
+             .reset_index().rename(columns={"rev": "revenue"})
+        g = g.sort_values("revenue", ascending=False, kind="stable").head(20)
+        return g[["c_custkey", "c_name", "revenue", "c_acctbal", "n_name",
+                  "c_address", "c_phone", "c_comment"]]
+    if name == "q12":
+        l = li[li.l_shipmode.isin(["MAIL", "SHIP"])
+               & (li.l_commitdate < li.l_receiptdate)
+               & (li.l_shipdate < li.l_commitdate)
+               & (li.l_receiptdate >= date32(1994, 1, 1))
+               & (li.l_receiptdate < date32(1995, 1, 1))]
+        j = l.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+        hi = j.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+        j = j.assign(h=hi.astype(np.int64), lo=(~hi).astype(np.int64))
+        g = j.groupby("l_shipmode").agg(high_line_count=("h", "sum"),
+                                        low_line_count=("lo", "sum")).reset_index()
+        return g.sort_values("l_shipmode")
+    if name == "q14":
+        pa = f["part"]
+        l = li[(li.l_shipdate >= date32(1995, 9, 1))
+               & (li.l_shipdate < date32(1995, 10, 1))]
+        j = l.merge(pa, left_on="l_partkey", right_on="p_partkey")
+        rev = j.l_extendedprice * (1 - j.l_discount)
+        promo = rev.where(j.p_type.str.startswith("PROMO"), 0.0)
+        return pd.DataFrame({"promo_revenue":
+                             [100.0 * promo.sum() / rev.sum()]})
+    if name == "q19":
+        pa = f["part"]
+        j = li.merge(pa, left_on="l_partkey", right_on="p_partkey")
+        ship = j.l_shipmode.isin(["AIR", "AIR REG"]) & \
+            (j.l_shipinstruct == "DELIVER IN PERSON")
+        c1 = (j.p_brand == "Brand#12") \
+            & j.p_container.isin(["SM CASE", "SM BOX", "SM PACK", "SM PKG"]) \
+            & (j.l_quantity >= 1) & (j.l_quantity <= 11) \
+            & (j.p_size >= 1) & (j.p_size <= 5) & ship
+        c2 = (j.p_brand == "Brand#23") \
+            & j.p_container.isin(["MED BAG", "MED BOX", "MED PKG", "MED PACK"]) \
+            & (j.l_quantity >= 10) & (j.l_quantity <= 20) \
+            & (j.p_size >= 1) & (j.p_size <= 10) & ship
+        c3 = (j.p_brand == "Brand#34") \
+            & j.p_container.isin(["LG CASE", "LG BOX", "LG PACK", "LG PKG"]) \
+            & (j.l_quantity >= 20) & (j.l_quantity <= 30) \
+            & (j.p_size >= 1) & (j.p_size <= 15) & ship
+        d = j[c1 | c2 | c3]
+        rev = (d.l_extendedprice * (1 - d.l_discount)).sum() if len(d) \
+            else np.nan   # SQL: SUM over empty set is NULL
+        return pd.DataFrame({"revenue": [rev]})
+    raise KeyError(name)
+
+
+def assert_frames_match(got: pd.DataFrame, want: pd.DataFrame,
+                        ordered: bool, rtol: float = 1e-9):
+    assert list(got.columns) == list(want.columns), \
+        f"columns {list(got.columns)} != {list(want.columns)}"
+    assert len(got) == len(want), f"rows {len(got)} != {len(want)}"
+    g, w = got.reset_index(drop=True), want.reset_index(drop=True)
+    if not ordered and len(g):
+        cols = list(g.columns)
+        g = g.sort_values(cols, kind="stable").reset_index(drop=True)
+        w = w.sort_values(cols, kind="stable").reset_index(drop=True)
+    for col in g.columns:
+        gv, wv = g[col].to_numpy(), w[col].to_numpy()
+        if gv.dtype == object or wv.dtype == object:
+            try:
+                gf = np.array([np.nan if x is None else float(x) for x in gv])
+                wf = np.array([np.nan if x is None else float(x) for x in wv])
+            except (TypeError, ValueError):
+                assert list(gv) == list(wv), f"column {col} differs"
+                continue
+            np.testing.assert_allclose(gf, wf, rtol=rtol, err_msg=f"column {col}")
+        elif np.issubdtype(np.asarray(wv).dtype, np.floating):
+            np.testing.assert_allclose(gv.astype(np.float64),
+                                       wv.astype(np.float64), rtol=rtol,
+                                       err_msg=f"column {col}")
+        else:
+            np.testing.assert_array_equal(gv.astype(np.int64),
+                                          wv.astype(np.int64),
+                                          err_msg=f"column {col}")

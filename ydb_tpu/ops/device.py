@@ -64,5 +64,7 @@ def to_host(dblock: DeviceBlock) -> HostBlock:
     for c in dblock.schema:
         d = np.asarray(dblock.arrays[c.name][:n]).astype(c.dtype.np)
         v = np.asarray(dblock.valids[c.name][:n]) if c.name in dblock.valids else None
+        if v is not None and v.all():
+            v = None
         cols[c.name] = ColumnData(d, v, dblock.dictionaries.get(c.name))
     return HostBlock(dblock.schema, cols, n)

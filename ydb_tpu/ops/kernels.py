@@ -189,7 +189,15 @@ def _rt_if(ts, extra):
     return t.with_nullable(ts[1].nullable or ts[2].nullable)
 
 
+def _typed_null_nv(xp, args, extra):
+    """All-null column with the dtype/shape of the argument (CASE w/o ELSE)."""
+    (da, _va) = args[0]
+    return da, xp.zeros(da.shape, dtype=bool)
+
+
 _reg("if", _rt_if, null_mode="custom", impl_nv=_if_nv)
+_reg("typed_null", lambda ts, e: ts[0].with_nullable(True),
+     null_mode="custom", impl_nv=_typed_null_nv)
 _reg("coalesce", lambda ts, e: ts[0].with_nullable(ts[1].nullable),
      null_mode="custom", impl_nv=_coalesce_nv)
 _reg("is_null", lambda ts, e: DType(Kind.BOOL, False), null_mode="custom", impl_nv=_is_null_nv)

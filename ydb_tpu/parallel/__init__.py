@@ -1,0 +1,3 @@
+from ydb_tpu.parallel.shuffle import (  # noqa: F401
+    DistributedAgg, make_mesh,
+)

@@ -1,0 +1,274 @@
+"""Device-mesh hash shuffle + distributed two-phase aggregation.
+
+The TPU-native replacement for DQ's hash-shuffle channels
+(`DqCnHashShuffle`, partitioner `dq_output_consumer.cpp:99`, channel data
+events `dq_compute_actor_channels.h:90`): instead of packing rows into
+TEvChannelData and pushing them over Interconnect TCP, every stage-boundary
+repartition is a single `jax.lax.all_to_all` across the pod's ICI mesh:
+
+  per device:  partial GroupBy (BlockCombineHashed analog)
+               → bucket rows by key hash  (TDqOutputHashPartitionConsumer)
+               → build D fixed-capacity segments
+  all_to_all:  segment d of device s  →  device d segment s     (ICI)
+  per device:  compact received segments → final GroupBy
+               (BlockMergeFinalizeHashed analog)
+
+Group keys are disjoint across devices after the shuffle, so the final
+merge is local and the host only concatenates per-device results.
+
+Everything is static-shape: segments have a fixed per-edge capacity and
+carry a row count; overflow falls back to a larger bucket (recompile), the
+analog of DQ channel spilling (`dq/actors/spilling/channel_storage.cpp`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ydb_tpu.core.block import ColumnData, HostBlock
+from ydb_tpu.core.dtypes import DType, Kind
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.ops import ir
+from ydb_tpu.ops.device import bucket_capacity
+from ydb_tpu.ops.xla_exec import _trace_program, compress
+from ydb_tpu.utils.hashing import hash_combine, splitmix64
+
+AXIS = "shards"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    return Mesh(np.array(devs[:n]), (AXIS,))
+
+
+def _bucket_of(env, key_names, ndev):
+    """Hash-partition bucket id per row (device-side, same hash family as
+    host shard routing — `ydb_tpu/utils/hashing.py`)."""
+    h = None
+    for k in key_names:
+        d, v = env[k]
+        # value-truncating int64 coercion for all key dtypes (float keys
+        # hash by truncated value — bitcast encodings are unavailable under
+        # TPU x64 emulation)
+        x = splitmix64(jnp, d.astype(jnp.int64))
+        if v is not None:
+            x = jnp.where(v, x, jnp.uint64(0))
+        h = x if h is None else hash_combine(jnp, h, x)
+    if h is None:
+        return None
+    return (h % jnp.uint64(ndev)).astype(jnp.int32)
+
+
+@dataclass
+class DistributedAgg:
+    """Compiled distributed two-phase aggregation over a device mesh."""
+
+    partial: ir.Program
+    final: ir.Program
+    in_schema: Schema
+    mesh: Mesh
+    seg_rows: int = 0        # per-edge segment capacity (0: = capacity)
+
+    def __post_init__(self):
+        self._fn = None
+        self._sig = None
+
+    # -- compile ----------------------------------------------------------
+
+    def _build(self, cap: int, valid_names: tuple, param_names: tuple):
+        ndev = self.mesh.devices.size
+        seg = self.seg_rows or cap
+        in_cols = list(self.in_schema.columns)
+        partial_prog, final_prog = self.partial, self.final
+
+        gb = next(c for c in partial_prog.commands
+                  if isinstance(c, ir.GroupBy))
+        key_names = list(gb.keys)
+
+        def per_device(arrays, valids, length, params):
+            env = {}
+            for c in in_cols:
+                env[c.name] = (arrays[c.name][0], valids.get(c.name))
+            env = {k: (d, v[0] if v is not None else None)
+                   for k, (d, v) in env.items()}
+            env, glen, sel, schema = _trace_program(
+                partial_prog, in_cols, cap, env, length[0], params)
+            assert sel is None  # partial ends in GroupBy
+            names = list(schema.names)
+
+            if not key_names or ndev == 1:
+                # global agg: no shuffle, merge via all_gather
+                datas = {n: jax.lax.all_gather(env[n][0], AXIS) for n in names}
+                valid_g = {n: jax.lax.all_gather(
+                    env[n][1] if env[n][1] is not None
+                    else jnp.ones((cap,), jnp.bool_), AXIS) for n in names}
+                lens = jax.lax.all_gather(glen, AXIS)
+                iota = jnp.arange(cap, dtype=jnp.int32)
+                seg_mask = (iota[None, :] < lens[:, None]).reshape(-1)
+                env2 = {n: (datas[n].reshape(-1), valid_g[n].reshape(-1))
+                        for n in names}
+                env2, tot = compress(env2, jnp.int32(ndev * cap), seg_mask,
+                                     ndev * cap)
+                fenv, flen, fsel, fschema = _trace_program(
+                    final_prog, list(schema.columns), ndev * cap, env2, tot,
+                    params)
+                if fsel is not None:
+                    fenv, flen = compress(fenv, flen, fsel, ndev * cap)
+                # merged result is identical on every device — report once
+                flen = jnp.where(jax.lax.axis_index(AXIS) == 0, flen, 0)
+                out_d = {n: fenv[n][0] for n in fschema.names}
+                out_v = {n: (fenv[n][1] if fenv[n][1] is not None
+                             else jnp.ones_like(out_d[n], dtype=jnp.bool_))
+                         for n in fschema.names}
+                return out_d, out_v, flen, jnp.bool_(False), tuple(
+                    (c.name, c.dtype.kind.value, c.dtype.nullable)
+                    for c in fschema.columns)
+
+            # hash shuffle: build ndev segments of seg rows each
+            bucket = _bucket_of(env, key_names, ndev)
+            iota = jnp.arange(cap, dtype=jnp.int32)
+            active = iota < glen
+            seg_datas = {n: [] for n in names}
+            seg_valids = {n: [] for n in names}
+            counts = []
+            overflow = jnp.bool_(False)
+            for d_t in range(ndev):
+                mask = active & (bucket == d_t)
+                env_c, cnt = compress(env, glen, mask, cap)
+                overflow = overflow | (cnt > seg)
+                counts.append(jnp.minimum(cnt, seg))
+                for n in names:
+                    seg_datas[n].append(env_c[n][0][:seg])
+                    v = env_c[n][1]
+                    seg_valids[n].append(
+                        v[:seg] if v is not None
+                        else jnp.ones((seg,), jnp.bool_))
+            stacked_d = {n: jnp.stack(seg_datas[n]) for n in names}      # (D, S)
+            stacked_v = {n: jnp.stack(seg_valids[n]) for n in names}
+            cnts = jnp.stack(counts)                                     # (D,)
+
+            recv_d = {n: jax.lax.all_to_all(stacked_d[n], AXIS, 0, 0,
+                                            tiled=False) for n in names}
+            recv_v = {n: jax.lax.all_to_all(stacked_v[n], AXIS, 0, 0,
+                                            tiled=False) for n in names}
+            recv_c = jax.lax.all_to_all(cnts[:, None], AXIS, 0, 0,
+                                        tiled=False)[:, 0]               # (D,)
+
+            flat = ndev * seg
+            jrow = jnp.arange(seg, dtype=jnp.int32)
+            seg_mask = (jrow[None, :] < recv_c[:, None]).reshape(-1)
+            env2 = {n: (recv_d[n].reshape(-1), recv_v[n].reshape(-1))
+                    for n in names}
+            env2, tot = compress(env2, jnp.int32(flat), seg_mask, flat)
+            fenv, flen, fsel, fschema = _trace_program(
+                final_prog, list(schema.columns), flat, env2, tot, params)
+            if fsel is not None:
+                fenv, flen = compress(fenv, flen, fsel, flat)
+            out_d = {n: fenv[n][0] for n in fschema.names}
+            out_v = {n: (fenv[n][1] if fenv[n][1] is not None
+                         else jnp.ones_like(out_d[n], dtype=jnp.bool_))
+                     for n in fschema.names}
+            return out_d, out_v, flen, overflow, tuple(
+                (c.name, c.dtype.kind.value, c.dtype.nullable)
+                for c in fschema.columns)
+
+        out_schema_holder = {}
+
+        def wrapper(arrays, valids, lengths, params):
+            out_d, out_v, flen, overflow, out_sig = per_device(
+                arrays, valids, lengths, params)
+            out_schema_holder["sig"] = out_sig
+            return (
+                {n: x[None] for n, x in out_d.items()},
+                {n: x[None] for n, x in out_v.items()},
+                flen[None],
+                overflow[None],
+            )
+
+        pspec_in = (
+            {c.name: P(AXIS, None) for c in in_cols},
+            {n: P(AXIS, None) for n in valid_names},
+            P(AXIS),
+            {n: P() for n in param_names},
+        )
+        shard_fn = jax.jit(jax.shard_map(
+            wrapper, mesh=self.mesh, in_specs=pspec_in,
+            out_specs=(P(AXIS, None), P(AXIS, None), P(AXIS), P(AXIS)),
+            check_vma=False,
+        ))
+        return shard_fn, out_schema_holder
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self, blocks_per_device: list, params: Optional[dict] = None
+            ) -> HostBlock:
+        """blocks_per_device: one HostBlock per mesh device (row partition)."""
+        ndev = self.mesh.devices.size
+        assert len(blocks_per_device) == ndev
+        params = params or {}
+        cap = bucket_capacity(max(max(b.length for b in blocks_per_device), 1))
+        arrays, valids, lengths = {}, {}, []
+        valid_names = []
+        for c in self.in_schema:
+            stk, vstk, any_valid = [], [], False
+            for b in blocks_per_device:
+                cd = b.columns[c.name]
+                pad = cap - b.length
+                stk.append(np.pad(cd.data, (0, pad)))
+                if cd.valid is not None:
+                    any_valid = True
+                    vstk.append(np.pad(cd.valid, (0, pad)))
+                else:
+                    vstk.append(np.ones(cap, np.bool_))
+            arrays[c.name] = np.stack(stk)
+            if any_valid:
+                valids[c.name] = np.stack(vstk)
+                valid_names.append(c.name)
+        lengths = np.array([b.length for b in blocks_per_device],
+                           dtype=np.int32)
+
+        sig = (cap, tuple(sorted(valid_names)), tuple(sorted(params)))
+        if self._fn is None or self._sig != sig:
+            self._fn, self._holder = self._build(cap, tuple(sorted(valid_names)),
+                                                 tuple(sorted(params)))
+            self._sig = sig
+
+        dev_params = {k: jnp.asarray(v) for k, v in params.items()}
+        out_d, out_v, flens, overflow = self._fn(arrays, valids, lengths,
+                                                 dev_params)
+        if bool(np.any(np.asarray(overflow))):
+            raise RuntimeError(
+                f"hash-shuffle segment overflow (seg_rows={self.seg_rows}): "
+                "rerun with larger seg_rows (0 = full capacity, never "
+                "overflows)")
+        out_sig = self._holder["sig"]
+        out_cols = [Column(n, DType(Kind(k), nullable))
+                    for (n, k, nullable) in out_sig]
+        schema = Schema(out_cols)
+
+        # per-device results → host concat (groups are disjoint)
+        flens = np.asarray(flens)
+        blocks = []
+        dicts = {}
+        for b in blocks_per_device:
+            for name, cd in b.columns.items():
+                if cd.dictionary is not None:
+                    dicts[name] = cd.dictionary
+        for d in range(ndev):
+            n = int(flens[d])
+            cols = {}
+            for c in out_cols:
+                data = np.asarray(out_d[c.name][d][:n]).astype(c.dtype.np)
+                v = np.asarray(out_v[c.name][d][:n])
+                cols[c.name] = ColumnData(
+                    data, None if v.all() else v, dicts.get(c.name))
+            blocks.append(HostBlock(schema, cols, n))
+        return HostBlock.concat(blocks)

@@ -1,0 +1,1 @@
+from ydb_tpu.query.engine import QueryEngine  # noqa: F401

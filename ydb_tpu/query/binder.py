@@ -1,0 +1,479 @@
+"""Name resolution and expression binding: SQL AST → columnar op IR.
+
+Combines the roles of the reference's type annotation
+(`ydb/library/yql/core/type_ann/`) and the KQP OLAP lambda compiler
+(`ydb/core/kqp/query_compiler/kqp_olap_compiler.cpp:33` — AST comparisons/
+arithmetic → SSA assign/filter commands).
+
+String predicates never reach the device as bytes: any pure function of a
+single dictionary-encoded column compared against literals is folded into a
+lookup-table Param evaluated over the dictionary host-side, and the device
+program gathers through it (`take_lut`) — the TPU-native counterpart of the
+reference's string UDF kernels (`ydb/library/yql/udfs/common/`,
+hyperscan/re2) applied at `custom_registry.cpp:95`.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from ydb_tpu.core import dtypes as dt
+from ydb_tpu.core.dictionary import Dictionary
+from ydb_tpu.ops import ir
+from ydb_tpu.sql import ast
+
+
+class BindError(Exception):
+    pass
+
+
+AGG_NAMES = {"sum", "count", "min", "max", "avg", "some"}
+
+_TYPE_MAP = {
+    "int64": dt.Kind.INT64, "bigint": dt.Kind.INT64, "int": dt.Kind.INT32,
+    "int32": dt.Kind.INT32, "integer": dt.Kind.INT32, "int16": dt.Kind.INT16,
+    "int8": dt.Kind.INT8, "uint64": dt.Kind.UINT64, "uint32": dt.Kind.UINT32,
+    "uint16": dt.Kind.UINT16, "uint8": dt.Kind.UINT8,
+    "double": dt.Kind.FLOAT64, "float64": dt.Kind.FLOAT64,
+    "float": dt.Kind.FLOAT32, "float32": dt.Kind.FLOAT32,
+    "real": dt.Kind.FLOAT64, "decimal": dt.Kind.FLOAT64,
+    "numeric": dt.Kind.FLOAT64,
+    "bool": dt.Kind.BOOL, "boolean": dt.Kind.BOOL,
+    "date": dt.Kind.DATE32, "date32": dt.Kind.DATE32,
+    "timestamp": dt.Kind.TIMESTAMP, "datetime": dt.Kind.TIMESTAMP,
+    "utf8": dt.Kind.STRING, "string": dt.Kind.STRING, "text": dt.Kind.STRING,
+    "varchar": dt.Kind.STRING, "char": dt.Kind.STRING,
+}
+
+
+def sql_type_to_dtype(name: str, not_null: bool = False) -> dt.DType:
+    kind = _TYPE_MAP.get(name.lower())
+    if kind is None:
+        raise BindError(f"unsupported type {name!r}")
+    return dt.DType(kind, nullable=not not_null)
+
+
+def parse_date_literal(s: str) -> int:
+    m = re.fullmatch(r"(\d{4})-(\d{2})-(\d{2})", s.strip())
+    if not m:
+        raise BindError(f"bad date literal {s!r}")
+    from ydb_tpu.bench.tpch_gen import date32
+    return date32(int(m.group(1)), int(m.group(2)), int(m.group(3)))
+
+
+def _civil_from_days(days: int) -> tuple[int, int, int]:
+    z = days + 719468
+    era = (z if z >= 0 else z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + 3 if mp < 10 else mp - 9
+    return (y + 1 if m <= 2 else y, m, d)
+
+
+def shift_date(days: int, qty: int, unit: str) -> int:
+    from ydb_tpu.bench.tpch_gen import date32
+    if unit in ("day", "days"):
+        return days + qty
+    y, m, d = _civil_from_days(days)
+    if unit in ("month", "months"):
+        t = (y * 12 + (m - 1)) + qty
+        y, m = divmod(t, 12)
+        m += 1
+    elif unit in ("year", "years"):
+        y += qty
+    else:
+        raise BindError(f"unsupported interval unit {unit!r}")
+    leap = (y % 4 == 0 and y % 100 != 0) or y % 400 == 0
+    month_len = [31, 29 if leap else 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31]
+    return date32(y, m, min(d, month_len[m - 1]))
+
+
+def like_to_regex(pattern: str) -> str:
+    out = []
+    for ch in pattern:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "".join(out)
+
+
+@dataclass
+class ColumnBinding:
+    internal: str                  # internal column name in the pipeline
+    dtype: dt.DType
+    dictionary: Optional[Dictionary] = None
+
+
+@dataclass
+class Scope:
+    """alias.column and unqualified-column resolution."""
+    by_alias: dict = field(default_factory=dict)   # alias -> {col -> ColumnBinding}
+
+    def add(self, alias: str, col: str, binding: ColumnBinding):
+        self.by_alias.setdefault(alias, {})[col] = binding
+
+    def resolve(self, parts: tuple) -> ColumnBinding:
+        if len(parts) == 2:
+            cols = self.by_alias.get(parts[0])
+            if cols is None or parts[1] not in cols:
+                raise BindError(f"unknown column {'.'.join(parts)}")
+            return cols[parts[1]]
+        name = parts[0]
+        hits = [cols[name] for cols in self.by_alias.values() if name in cols]
+        if not hits:
+            raise BindError(f"unknown column {name}")
+        if len(hits) > 1:
+            raise BindError(f"ambiguous column {name}")
+        return hits[0]
+
+    def try_resolve(self, parts: tuple) -> Optional[ColumnBinding]:
+        try:
+            return self.resolve(parts)
+        except BindError:
+            return None
+
+
+class ParamPool:
+    """Array/scalar runtime parameters collected during binding."""
+
+    def __init__(self, prefix: str = "p"):
+        self.values: dict = {}
+        self._n = 0
+        self._prefix = prefix
+
+    def add(self, value, dtype: dt.DType, is_array: bool = False) -> ir.Param:
+        name = f"{self._prefix}{self._n}"
+        self._n += 1
+        self.values[name] = value
+        return ir.Param(name, dtype, is_array)
+
+
+# -- constant folding ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class _FoldedConst:
+    value: object
+    dtype: dt.DType
+    hint: Optional[str] = None     # "date" | "interval_<unit>"
+
+
+def _try_fold(e: ast.Expr):
+    """Literal / date / interval constant folding (host-side, bind time)."""
+    if isinstance(e, ast.Literal):
+        if e.type_hint == "date":
+            return _FoldedConst(parse_date_literal(e.value),
+                                dt.DType(dt.Kind.DATE32, False), "date")
+        if e.type_hint and e.type_hint.startswith("interval_"):
+            return _FoldedConst(e.value, dt.DType(dt.Kind.INT64, False),
+                                e.type_hint)
+        if isinstance(e.value, bool):
+            return _FoldedConst(e.value, dt.DType(dt.Kind.BOOL, False))
+        if isinstance(e.value, int):
+            return _FoldedConst(e.value, dt.DType(dt.Kind.INT64, False))
+        if isinstance(e.value, float):
+            return _FoldedConst(e.value, dt.DType(dt.Kind.FLOAT64, False))
+        if isinstance(e.value, str):
+            return _FoldedConst(e.value, dt.DType(dt.Kind.STRING, False))
+        return None
+    if isinstance(e, ast.UnaryOp) and e.op == "-":
+        f = _try_fold(e.arg)
+        if f is not None and isinstance(f.value, (int, float)):
+            return _FoldedConst(-f.value, f.dtype, f.hint)
+        return None
+    if isinstance(e, ast.Cast):
+        f = _try_fold(e.arg)
+        if f is None:
+            return None
+        if e.to == "date" and isinstance(f.value, str):
+            return _FoldedConst(parse_date_literal(f.value),
+                                dt.DType(dt.Kind.DATE32, False), "date")
+        try:
+            target = sql_type_to_dtype(e.to, not_null=True)
+        except BindError:
+            return None
+        if target.is_numeric and isinstance(f.value, (int, float)):
+            v = float(f.value) if target.is_float else int(f.value)
+            return _FoldedConst(v, target)
+        return None
+    if isinstance(e, ast.BinOp) and e.op in ("+", "-", "*", "/"):
+        lf, rf = _try_fold(e.left), _try_fold(e.right)
+        if lf is None or rf is None:
+            return None
+        # date ± interval (interval + date only for '+')
+        pairs = [(lf, rf)] + ([(rf, lf)] if e.op == "+" else [])
+        for a, b in pairs:
+            if a.hint == "date" and b.hint and b.hint.startswith("interval_"):
+                unit = b.hint.split("_", 1)[1]
+                qty = b.value if e.op == "+" else -b.value
+                return _FoldedConst(shift_date(a.value, qty, unit),
+                                    dt.DType(dt.Kind.DATE32, False), "date")
+        if isinstance(lf.value, (int, float)) and isinstance(rf.value, (int, float)) \
+                and lf.hint is None and rf.hint is None:
+            x, y = lf.value, rf.value
+            v = (x + y if e.op == "+" else x - y if e.op == "-"
+                 else x * y if e.op == "*" else x / y)
+            kind = dt.Kind.FLOAT64 if isinstance(v, float) else dt.Kind.INT64
+            return _FoldedConst(v, dt.DType(kind, False))
+        return None
+    return None
+
+
+# -- string folding (dictionary LUTs) --------------------------------------
+
+
+def _string_fn(e: ast.Expr, scope: Scope):
+    """If `e` is a pure function of ONE dictionary-encoded column returning a
+    python string, return (binding, fn: str|None -> str|None)."""
+    if isinstance(e, ast.Name):
+        b = scope.try_resolve(e.parts)
+        if b is not None and b.dtype.is_string and b.dictionary is not None:
+            return b, (lambda s: s)
+        return None
+    if isinstance(e, ast.FuncCall) and e.name == "substring":
+        inner = _string_fn(e.args[0], scope)
+        if inner is None:
+            return None
+        b, f = inner
+        start_f = _try_fold(e.args[1])
+        if start_f is None:
+            return None
+        start = int(start_f.value) - 1  # SQL 1-based
+        length = None
+        if len(e.args) > 2:
+            len_f = _try_fold(e.args[2])
+            if len_f is None:
+                return None
+            length = int(len_f.value)
+
+        def g(s, f=f, start=start, length=length):
+            s = f(s)
+            if s is None:
+                return None
+            return s[start:start + length] if length is not None else s[start:]
+        return b, g
+    if isinstance(e, ast.BinOp) and e.op == "||":
+        lf = _try_fold(e.right)
+        if lf is not None and isinstance(lf.value, str):
+            inner = _string_fn(e.left, scope)
+            if inner is not None:
+                b, f = inner
+                return b, (lambda s, f=f, suf=lf.value:
+                           None if f(s) is None else f(s) + suf)
+        rf = _try_fold(e.left)
+        if rf is not None and isinstance(rf.value, str):
+            inner = _string_fn(e.right, scope)
+            if inner is not None:
+                b, f = inner
+                return b, (lambda s, f=f, pre=rf.value:
+                           None if f(s) is None else pre + f(s))
+        return None
+    return None
+
+
+def _lut_pred(binding: ColumnBinding, fn: Callable, pool: ParamPool) -> ir.Expr:
+    """bool-LUT gather over a dictionary column."""
+    d = binding.dictionary
+    lut = np.zeros(max(len(d), 1), dtype=np.bool_)
+    for i, v in enumerate(d.values_array()):
+        lut[i] = bool(fn(v))
+    p = pool.add(lut, dt.DType(dt.Kind.BOOL, False), is_array=True)
+    return ir.call("take_lut", ir.Col(binding.internal), p)
+
+
+# -- the binder ------------------------------------------------------------
+
+
+class ExprBinder:
+    """Binds row-level AST expressions over a Scope into op-IR."""
+
+    def __init__(self, scope: Scope, pool: ParamPool):
+        self.scope = scope
+        self.pool = pool
+
+    def bind(self, e: ast.Expr) -> ir.Expr:
+        f = _try_fold(e)
+        if f is not None:
+            if isinstance(f.value, str):
+                raise BindError("string literal outside a string comparison")
+            return ir.Const(f.value, f.dtype)
+
+        if isinstance(e, ast.Name):
+            return ir.Col(self.scope.resolve(e.parts).internal)
+
+        if isinstance(e, ast.BinOp):
+            return self._bin(e)
+
+        if isinstance(e, ast.UnaryOp):
+            if e.op == "not":
+                return ir.call("not", self.bind(e.arg))
+            if e.op == "-":
+                return ir.call("neg", self.bind(e.arg))
+            raise BindError(f"unary {e.op}")
+
+        if isinstance(e, ast.Like):
+            sf = _string_fn(e.arg, self.scope)
+            if sf is None:
+                raise BindError("LIKE on a non-string expression")
+            b, fn = sf
+            rx = re.compile(like_to_regex(e.pattern), re.DOTALL)
+            pred = _lut_pred(
+                b, lambda s: s is not None and fn(s) is not None
+                and rx.fullmatch(fn(s)) is not None, self.pool)
+            return ir.call("not", pred) if e.negated else pred
+
+        if isinstance(e, ast.Between):
+            arg = self.bind(e.arg)
+            lo, hi = self.bind(e.lo), self.bind(e.hi)
+            expr = ir.call("and", ir.call("ge", arg, lo), ir.call("le", arg, hi))
+            return ir.call("not", expr) if e.negated else expr
+
+        if isinstance(e, ast.InList):
+            sf = _string_fn(e.arg, self.scope)
+            if sf is not None:
+                b, fn = sf
+                values = set()
+                for item in e.items:
+                    f2 = _try_fold(item)
+                    if f2 is None or not isinstance(f2.value, str):
+                        sf = None
+                        break
+                    values.add(f2.value)
+                if sf is not None:
+                    pred = _lut_pred(
+                        b, lambda s: fn(s) in values if s is not None else False,
+                        self.pool)
+                    return ir.call("not", pred) if e.negated else pred
+            arg = self.bind(e.arg)
+            expr = None
+            for item in e.items:
+                term = ir.call("eq", arg, self.bind(item))
+                expr = term if expr is None else ir.call("or", expr, term)
+            if expr is None:
+                expr = ir.Const(False, dt.DType(dt.Kind.BOOL, False))
+            return ir.call("not", expr) if e.negated else expr
+
+        if isinstance(e, ast.IsNull):
+            arg = self.bind(e.arg)
+            return ir.call("is_not_null" if e.negated else "is_null", arg)
+
+        if isinstance(e, ast.Case):
+            return self._case(e)
+
+        if isinstance(e, ast.Cast):
+            arg = self.bind(e.arg)
+            target = sql_type_to_dtype(e.to)
+            return ir.call("cast", arg, to=target.kind.value)
+
+        if isinstance(e, ast.FuncCall):
+            return self._func(e)
+
+        raise BindError(f"unsupported expression {type(e).__name__}")
+
+    # -- helpers -----------------------------------------------------------
+
+    _BIN_KERNEL = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod",
+                   "=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt",
+                   ">=": "ge", "and": "and", "or": "or"}
+
+    def _bin(self, e: ast.BinOp) -> ir.Expr:
+        # bare string column = literal → code comparison (prunable by stats)
+        if e.op in ("=", "<>"):
+            for a, bexp in ((e.left, e.right), (e.right, e.left)):
+                if isinstance(a, ast.Name):
+                    cb = self._maybe_string_col(a)
+                    lit = _try_fold(bexp)
+                    if cb is not None and cb.dictionary is not None \
+                            and lit is not None and isinstance(lit.value, str):
+                        code = cb.dictionary.encode_existing(lit.value)
+                        kern = "eq" if e.op == "=" else "ne"
+                        return ir.call(kern, ir.Col(cb.internal),
+                                       ir.Const(code, dt.DType(dt.Kind.STRING, False)))
+        # string comparisons fold through the dictionary
+        if e.op in ("=", "<>", "<", "<=", ">", ">="):
+            for a, bexp, flip in ((e.left, e.right, False), (e.right, e.left, True)):
+                sf = _string_fn(a, self.scope)
+                lit = _try_fold(bexp)
+                if sf is not None and lit is not None and isinstance(lit.value, str):
+                    b, fn = sf
+                    op = e.op
+                    if flip:
+                        op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+                    tgt = lit.value
+                    cmpf = {"=": lambda s: s == tgt, "<>": lambda s: s != tgt,
+                            "<": lambda s: s < tgt, "<=": lambda s: s <= tgt,
+                            ">": lambda s: s > tgt, ">=": lambda s: s >= tgt}[op]
+                    return _lut_pred(
+                        b, lambda s: s is not None and fn(s) is not None
+                        and cmpf(fn(s)), self.pool)
+            # string col = string col (shared dictionary only)
+            if e.op in ("=", "<>"):
+                lb = self._maybe_string_col(e.left)
+                rb = self._maybe_string_col(e.right)
+                if lb is not None and rb is not None:
+                    if lb.dictionary is not rb.dictionary:
+                        raise BindError(
+                            "string equality across different dictionaries "
+                            "(needs re-encode; not yet supported)")
+        kern = self._BIN_KERNEL.get(e.op)
+        if kern is None:
+            raise BindError(f"operator {e.op}")
+        return ir.call(kern, self.bind(e.left), self.bind(e.right))
+
+    def _maybe_string_col(self, e: ast.Expr) -> Optional[ColumnBinding]:
+        if isinstance(e, ast.Name):
+            b = self.scope.try_resolve(e.parts)
+            if b is not None and b.dtype.is_string:
+                return b
+        return None
+
+    def _case(self, e: ast.Case) -> ir.Expr:
+        whens = []
+        for cond, res in e.whens:
+            if e.operand is not None:
+                cond = ast.BinOp("=", e.operand, cond)
+            whens.append((self.bind(cond), self.bind(res)))
+        if e.default is not None:
+            out = self.bind(e.default)
+        else:
+            out = ir.call("typed_null", whens[-1][1])
+        for cond, res in reversed(whens):
+            out = ir.call("if", cond, res, out)
+        return out
+
+    def _func(self, e: ast.FuncCall) -> ir.Expr:
+        name = e.name
+        if name in AGG_NAMES:
+            raise BindError(f"aggregate {name} not allowed here")
+        simple = {"year": "year", "month": "month", "day": "day_of_month",
+                  "abs": "abs", "floor": "floor", "ceil": "ceil",
+                  "sqrt": "sqrt", "exp": "exp", "ln": "ln", "round": "round",
+                  "coalesce": "coalesce", "if": "if"}
+        if name in simple:
+            return ir.call(simple[name], *[self.bind(a) for a in e.args])
+        if name == "power":
+            return ir.call("pow", *[self.bind(a) for a in e.args])
+        if name in ("startswith", "endswith", "contains_string"):
+            sf = _string_fn(e.args[0], self.scope)
+            lit = _try_fold(e.args[1])
+            if sf is None or lit is None:
+                raise BindError(f"{name} needs a string column and literal")
+            b, fn = sf
+            tgt = lit.value
+            test = {"startswith": lambda s: s.startswith(tgt),
+                    "endswith": lambda s: s.endswith(tgt),
+                    "contains_string": lambda s: tgt in s}[name]
+            return _lut_pred(b, lambda s: s is not None and test(fn(s)),
+                             self.pool)
+        raise BindError(f"unknown function {name}")

@@ -1,0 +1,145 @@
+"""Query engine front door: SQL text in, result blocks out.
+
+Plays the role of the KQP session actor + compile service
+(`kqp_session_actor.cpp:455` CompileQuery → `ExecutePhyTx`): parses, plans
+(with a fingerprint-keyed plan cache), executes, and applies DDL/DML against
+the catalog. Single-session, single-node for now; the distributed planner
+and the transactional write path slot in behind the same interface.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ydb_tpu.core.block import HostBlock
+from ydb_tpu.query.binder import BindError, sql_type_to_dtype, parse_date_literal
+from ydb_tpu.query.executor import Executor
+from ydb_tpu.query.plan import QueryPlan, explain
+from ydb_tpu.query.planner import PlanError, Planner
+from ydb_tpu.scheme.catalog import Catalog
+from ydb_tpu.sql import ast, parse
+from ydb_tpu.storage.mvcc import Snapshot, WriteVersion
+from ydb_tpu.core.schema import Column, Schema
+
+
+class QueryError(Exception):
+    pass
+
+
+class QueryEngine:
+    def __init__(self, catalog: Optional[Catalog] = None,
+                 block_rows: int = 1 << 20):
+        self.catalog = catalog or Catalog()
+        self.planner = Planner(self.catalog)
+        self.executor = Executor(self.catalog, block_rows)
+        self._plan_step = 1
+        self._tx_id = 1
+
+    # -- versions (standing in for coordinator/mediator time) -------------
+
+    def _next_version(self) -> WriteVersion:
+        self._plan_step += 1
+        return WriteVersion(self._plan_step, self._tx_id)
+
+    def snapshot(self) -> Snapshot:
+        return Snapshot(self._plan_step, 2 ** 62)
+
+    # -- entry -------------------------------------------------------------
+
+    def execute(self, sql: str) -> HostBlock:
+        stmt = parse(sql)
+        try:
+            if isinstance(stmt, ast.Select):
+                plan = self.planner.plan_select(stmt)
+                return self.executor.execute(plan, self.snapshot())
+            if isinstance(stmt, ast.CreateTable):
+                return self._create_table(stmt)
+            if isinstance(stmt, ast.DropTable):
+                if stmt.if_exists and not self.catalog.has(stmt.name):
+                    return _unit_block()
+                self.catalog.drop_table(stmt.name)
+                return _unit_block()
+            if isinstance(stmt, ast.Insert):
+                return self._insert(stmt)
+            raise QueryError(f"unsupported statement {type(stmt).__name__}")
+        except (BindError, PlanError) as e:
+            raise QueryError(str(e)) from e
+
+    def explain(self, sql: str) -> str:
+        stmt = parse(sql)
+        if not isinstance(stmt, ast.Select):
+            raise QueryError("EXPLAIN supports SELECT only")
+        return explain(self.planner.plan_select(stmt))
+
+    def query(self, sql: str):
+        """Execute and return a pandas DataFrame (tests / CLI)."""
+        return self.execute(sql).to_pandas()
+
+    # -- DDL / DML ---------------------------------------------------------
+
+    def _create_table(self, stmt: ast.CreateTable) -> HostBlock:
+        if self.catalog.has(stmt.name):
+            if stmt.if_not_exists:
+                return _unit_block()
+            raise QueryError(f"table {stmt.name!r} already exists")
+        cols = [Column(name, sql_type_to_dtype(ty, not_null))
+                for (name, ty, not_null) in stmt.columns]
+        pk = stmt.primary_key or [cols[0].name]
+        self.catalog.create_table(stmt.name, Schema(cols), pk,
+                                  shards=max(1, stmt.partition_count))
+        return _unit_block()
+
+    def _insert(self, stmt: ast.Insert) -> HostBlock:
+        table = self.catalog.table(stmt.table)
+        if stmt.query is not None:
+            raise QueryError("INSERT ... SELECT not supported yet")
+        names = stmt.columns or table.schema.names
+        data: dict[str, list] = {n: [] for n in names}
+        from ydb_tpu.query.binder import _try_fold
+        for row in stmt.rows:
+            if len(row) != len(names):
+                raise QueryError("VALUES arity mismatch")
+            for n, lit in zip(names, row):
+                if isinstance(lit, ast.Literal) and lit.value is None:
+                    data[n].append(None)
+                    continue
+                folded = _try_fold(lit)   # literals, -x, DATE '...', CAST
+                if folded is None:
+                    raise QueryError("VALUES must be constant expressions")
+                data[n].append(folded.value)
+
+        arrays, valids = {}, {}
+        n_rows = len(stmt.rows)
+        for c in table.schema:
+            if c.name in data:
+                vals = data[c.name]
+                mask = np.array([v is not None for v in vals])
+                if c.dtype.is_string:
+                    codes = table.dictionaries[c.name].encode(
+                        [None if v is None else str(v) for v in vals])
+                    arrays[c.name] = codes
+                else:
+                    arrays[c.name] = np.array(
+                        [0 if v is None else v for v in vals], dtype=c.dtype.np)
+                if not mask.all():
+                    if not c.dtype.nullable:
+                        raise QueryError(f"NULL in NOT NULL column {c.name}")
+                    valids[c.name] = mask
+            else:
+                if not c.dtype.nullable:
+                    raise QueryError(f"missing NOT NULL column {c.name}")
+                arrays[c.name] = np.zeros(n_rows, dtype=c.dtype.np)
+                valids[c.name] = np.zeros(n_rows, dtype=bool)
+        block = HostBlock.from_arrays(table.schema, arrays, valids,
+                                      dict(table.dictionaries))
+        writes = table.write(block)
+        table.commit(writes, self._next_version())
+        for s in table.shards:
+            s.indexate()
+        return _unit_block()
+
+
+def _unit_block() -> HostBlock:
+    return HostBlock(Schema([]), {}, 0)

@@ -1,0 +1,188 @@
+"""Physical plan executor (single-node).
+
+The analog of the KQP scan-executer + compute-actor run loop
+(`kqp_scan_executer.cpp`, `dq_compute_actor_impl.h:295`): streams blocks
+from shard scans through the device-compiled pipeline (pushdown program →
+broadcast-join probes → partial aggregation), merges partials, and runs the
+final stage (merge GroupBy, HAVING, output expressions, sort, limit).
+
+Every block-level compute step runs on the device via the jit pattern cache
+(`ops/xla_exec.py`); the host only routes blocks and (for now) concatenates
+partials — the role the DQ channels play in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ydb_tpu.core.block import ColumnData, HostBlock
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.ops import ir
+from ydb_tpu.ops import join as J
+from ydb_tpu.ops.device import DeviceBlock, to_device, to_host
+from ydb_tpu.ops.sort import sort_block
+from ydb_tpu.ops.xla_exec import compress_block, run_on_device
+from ydb_tpu.query.plan import JoinStep, Pipeline, QueryPlan, SortKey
+from ydb_tpu.storage.mvcc import MAX_SNAPSHOT, Snapshot
+
+DEFAULT_BLOCK_ROWS = 1 << 20
+
+
+class Executor:
+    def __init__(self, catalog, block_rows: int = DEFAULT_BLOCK_ROWS):
+        self.catalog = catalog
+        self.block_rows = block_rows
+
+    # -- entry -------------------------------------------------------------
+
+    def execute(self, plan: QueryPlan,
+                snapshot: Snapshot = MAX_SNAPSHOT) -> HostBlock:
+        partials = self._run_pipeline(plan.pipeline, plan.params, snapshot)
+        merged = HostBlock.concat(partials)
+
+        if plan.final_program is not None:
+            merged = to_host(run_on_device(plan.final_program,
+                                           to_device(merged), plan.params))
+
+        if plan.sort:
+            merged = self._sort(merged, plan.sort, plan.limit, plan.offset)
+        elif plan.limit is not None or plan.offset:
+            lo = plan.offset or 0
+            hi = lo + plan.limit if plan.limit is not None else merged.length
+            merged = merged.slice(lo, min(hi, merged.length))
+
+        return self._project_output(merged, plan.output)
+
+    # -- pipelines ---------------------------------------------------------
+
+    def _run_pipeline(self, pipe: Pipeline, params: dict,
+                      snapshot: Snapshot) -> list:
+        """Partial-result HostBlocks for a pipeline (≥1 block: an empty scan
+        still runs the programs once so global aggregates emit their row)."""
+        builds = [self._prepare_join(step, params, snapshot)
+                  for kind, step in pipe.steps if kind == "join"]
+        out = [self._run_block(pipe, block, builds, params)
+               for block in self._scan_blocks(pipe, snapshot)]
+        if not out:
+            out = [self._run_block(pipe, self._empty_scan_block(pipe),
+                                   builds, params)]
+        return out
+
+    def _run_block(self, pipe: Pipeline, block: HostBlock, builds: list,
+                   params: dict) -> HostBlock:
+        d = to_device(block)
+        if pipe.pre_program is not None:
+            d = run_on_device(pipe.pre_program, d, params)
+        bi = 0
+        for kind, step in pipe.steps:
+            if kind == "join":
+                table = builds[bi]
+                bi += 1
+                rename = {}
+                d, sel = J.probe(d, table, step.probe_key, step.kind,
+                                 sel=None, rename=rename)
+                d = compress_block(d, sel)
+            else:
+                d = run_on_device(step, d, params)
+        if pipe.partial is not None:
+            d = run_on_device(pipe.partial, d, params)
+        return to_host(d)
+
+    def _prepare_join(self, step: JoinStep, params: dict,
+                      snapshot: Snapshot) -> J.BuildTable:
+        built = HostBlock.concat(self._run_pipeline(step.build, params,
+                                                    snapshot))
+        return J.build(built, step.build_key, list(step.payload))
+
+    def _scan_blocks(self, pipe: Pipeline, snapshot: Snapshot):
+        table = self.catalog.table(pipe.scan.table)
+        storage_names = [s for (s, _i) in pipe.scan.columns]
+        rename = {s: i for (s, i) in pipe.scan.columns}
+        for shard in table.shards:
+            for block in shard.scan(storage_names, snapshot,
+                                    prune_predicates=pipe.scan.prune or None,
+                                    block_rows=self.block_rows):
+                yield _rename_block(block, rename)
+
+    def _empty_scan_block(self, pipe: Pipeline) -> HostBlock:
+        """Zero-row block with the scan's schema and dictionaries."""
+        table = self.catalog.table(pipe.scan.table)
+        cols, schema_cols = {}, []
+        for (storage, internal) in pipe.scan.columns:
+            c = table.schema.col(storage)
+            cols[internal] = ColumnData(
+                np.zeros(0, dtype=c.dtype.np), None,
+                table.dictionaries.get(storage))
+            schema_cols.append(Column(internal, c.dtype))
+        return HostBlock(Schema(schema_cols), cols, 0)
+
+    # -- final sort / output ----------------------------------------------
+
+    def _sort(self, block: HostBlock, sort_keys: list,
+              limit: Optional[int], offset: Optional[int]) -> HostBlock:
+        if block.length == 0:
+            return block
+        prog = ir.Program()
+        keys = []
+        drop = []
+        pool_params = {}
+        for j, sk in enumerate(sort_keys):
+            dtype = block.schema.dtype(sk.name)
+            cd = block.columns[sk.name]
+            if dtype.is_string and cd.dictionary is not None:
+                # order by lexicographic rank, not dictionary code
+                vals = cd.dictionary.values_array()
+                ranks = np.argsort(np.argsort(vals)).astype(np.int32) \
+                    if len(vals) else np.zeros(0, np.int32)
+                pname = f"__rank{j}"
+                pool_params[pname] = ranks
+                rank_col = f"__sortrank{j}"
+                from ydb_tpu.core import dtypes as dt
+                prog.assign(rank_col, ir.call(
+                    "take_lut", ir.Col(sk.name),
+                    ir.Param(pname, dt.DType(dt.Kind.INT32, False),
+                             is_array=True)))
+                keys.append((rank_col, sk.ascending, sk.nulls_first))
+                drop.append(rank_col)
+            else:
+                keys.append((sk.name, sk.ascending, sk.nulls_first))
+        d = to_device(block)
+        if prog.commands:
+            d = run_on_device(prog, d, pool_params)
+        d = sort_block(d, keys, limit=(None if offset else limit))
+        out = to_host(d)
+        if drop:
+            out = out.select([n for n in out.schema.names if n not in drop])
+        lo = offset or 0
+        if lo or limit is not None:
+            hi = lo + limit if limit is not None else out.length
+            out = out.slice(lo, min(hi, out.length))
+        return out
+
+    def _project_output(self, block: HostBlock, output: list) -> HostBlock:
+        cols = {}
+        schema_cols = []
+        used = set()
+        for (internal, label) in output:
+            lbl = label
+            k = 2
+            while lbl in used:
+                lbl = f"{label}_{k}"
+                k += 1
+            used.add(lbl)
+            cd = block.columns[internal]
+            cols[lbl] = ColumnData(cd.data, cd.valid, cd.dictionary)
+            schema_cols.append(Column(lbl, block.schema.dtype(internal)))
+        return HostBlock(Schema(schema_cols), cols, block.length)
+
+
+def _rename_block(block: HostBlock, rename: dict) -> HostBlock:
+    cols = {}
+    schema_cols = []
+    for c in block.schema:
+        new = rename.get(c.name, c.name)
+        cols[new] = block.columns[c.name]
+        schema_cols.append(Column(new, c.dtype))
+    return HostBlock(Schema(schema_cols), cols, block.length)

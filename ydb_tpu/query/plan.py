@@ -1,0 +1,108 @@
+"""Physical query plans.
+
+The analog of the reference's `TKqpPhyQuery` protobuf (`kqp_physical.proto`)
++ DQ task-graph stages (`dq/tasks/dq_tasks_graph.h`): a query is a tree of
+streaming *pipelines*, each anchored on a table scan (its SSA pre-program
+pushed down into the scan, `TKqpPhyOpReadOlapRanges` style), followed by
+broadcast-join probe steps and an optional partial aggregation, and a final
+stage that merges partials, applies HAVING, computes output expressions,
+sorts and limits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ydb_tpu.ops import ir
+
+
+@dataclass
+class ScanSpec:
+    table: str
+    columns: list                    # [(storage_name, internal_name)]
+    prune: list = field(default_factory=list)   # [(storage_col, op, value)]
+
+
+@dataclass
+class JoinStep:
+    build: "Pipeline"                # materialized build side
+    build_key: str                   # internal name in build output
+    probe_key: str                   # internal name in probe pipeline
+    kind: str                        # inner | left | left_semi | left_anti
+    payload: list = field(default_factory=list)  # build columns to attach
+
+
+@dataclass
+class Pipeline:
+    """One streaming stage: scan → program → (join → program)* → partial."""
+    scan: ScanSpec
+    pre_program: Optional[ir.Program] = None      # pushdown filters/assigns
+    steps: list = field(default_factory=list)     # [("join", JoinStep) | ("program", ir.Program)]
+    partial: Optional[ir.Program] = None          # ends in partial GroupBy / projection
+    out_names: list = field(default_factory=list)  # pipeline output columns
+
+
+@dataclass
+class SortKey:
+    name: str
+    ascending: bool = True
+    nulls_first: bool = False
+
+
+@dataclass
+class QueryPlan:
+    pipeline: Pipeline
+    final_program: Optional[ir.Program] = None    # merge agg + having + exprs
+    sort: list = field(default_factory=list)      # [SortKey]
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    output: list = field(default_factory=list)    # [(internal_name, label)]
+    params: dict = field(default_factory=dict)    # param name -> value
+
+
+def explain(plan: QueryPlan, indent: int = 0) -> str:
+    """Human-readable plan (the `kqp_query_plan.cpp` analog)."""
+    pad = "  " * indent
+    lines = []
+
+    def pipe(p: Pipeline, d: int):
+        pp = "  " * d
+        lines.append(f"{pp}Scan {p.scan.table} cols={[c[1] for c in p.scan.columns]}"
+                     + (f" prune={p.scan.prune}" if p.scan.prune else ""))
+        if p.pre_program:
+            lines.append(f"{pp}  pre: {_prog(p.pre_program)}")
+        for kind, step in p.steps:
+            if kind == "join":
+                lines.append(f"{pp}  {step.kind.upper()} JOIN probe={step.probe_key} "
+                             f"build={step.build_key} payload={step.payload}")
+                pipe(step.build, d + 2)
+            else:
+                lines.append(f"{pp}  program: {_prog(step)}")
+        if p.partial:
+            lines.append(f"{pp}  partial: {_prog(p.partial)}")
+
+    pipe(plan.pipeline, indent)
+    if plan.final_program:
+        lines.append(f"{pad}final: {_prog(plan.final_program)}")
+    if plan.sort:
+        lines.append(f"{pad}sort: {[(s.name, 'asc' if s.ascending else 'desc') for s in plan.sort]}")
+    if plan.limit is not None:
+        lines.append(f"{pad}limit: {plan.limit}")
+    lines.append(f"{pad}output: {[lbl for _, lbl in plan.output]}")
+    return "\n".join(lines)
+
+
+def _prog(p: ir.Program) -> str:
+    parts = []
+    for cmd in p.commands:
+        if isinstance(cmd, ir.Assign):
+            parts.append(f"assign {cmd.name}")
+        elif isinstance(cmd, ir.Filter):
+            parts.append("filter")
+        elif isinstance(cmd, ir.GroupBy):
+            parts.append(f"groupby[{','.join(cmd.keys)}]"
+                         f"({','.join(a.func for a in cmd.aggs)})")
+        elif isinstance(cmd, ir.Projection):
+            parts.append(f"project[{len(cmd.names)}]")
+    return " → ".join(parts)

@@ -1,0 +1,649 @@
+"""AST → physical plan.
+
+Combines the reference's logical/physical optimization + stage building:
+  * predicate classification & pushdown into scans — the
+    `KqpPushOlapFilter` rule (`kqp_opt_phy_olap_filter.cpp:527`);
+  * join-tree construction from equi-edges with the largest table as the
+    streaming fact side and broadcast build fragments — the MapJoin
+    strategy of `dq_opt_join.cpp` (CBO/DPhyp ordering comes later);
+  * two-phase aggregation: per-block partial GroupBy on device, final
+    merge GroupBy — the BlockCombineHashed → BlockMergeFinalizeHashed
+    split (`mkql_block_agg.cpp`);
+  * HAVING/output/ORDER BY expression binding over the aggregated schema.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ydb_tpu.core import dtypes as dt
+from ydb_tpu.ops import ir
+from ydb_tpu.query import binder as B
+from ydb_tpu.query.plan import JoinStep, Pipeline, QueryPlan, ScanSpec, SortKey
+from ydb_tpu.sql import ast
+
+
+class PlanError(Exception):
+    pass
+
+
+def conjuncts(e: Optional[ast.Expr]) -> list:
+    if e is None:
+        return []
+    if isinstance(e, ast.BinOp) and e.op == "and":
+        return conjuncts(e.left) + conjuncts(e.right)
+    return [e]
+
+
+def disjuncts(e: ast.Expr) -> list:
+    if isinstance(e, ast.BinOp) and e.op == "or":
+        return disjuncts(e.left) + disjuncts(e.right)
+    return [e]
+
+
+def _and_fold(parts: list) -> Optional[ast.Expr]:
+    out = None
+    for p in parts:
+        out = p if out is None else ast.BinOp("and", out, p)
+    return out
+
+
+def _or_fold(parts: list) -> Optional[ast.Expr]:
+    out = None
+    for p in parts:
+        out = p if out is None else ast.BinOp("or", out, p)
+    return out
+
+
+def hoist_or_common(pred: ast.Expr) -> list:
+    """(a AND x) OR (a AND y) → a AND (x OR y): lift conjuncts shared by
+    every OR branch to the top (TPC-H Q19's join condition shape) — the
+    role of the reference's common-opt OR factoring."""
+    out: list = []
+    for p in conjuncts(pred):
+        if not (isinstance(p, ast.BinOp) and p.op == "or"):
+            out.append(p)
+            continue
+        branches = [conjuncts(b) for b in disjuncts(p)]
+        common = [c for c in branches[0]
+                  if all(c in b for b in branches[1:])]
+        if not common:
+            out.append(p)
+            continue
+        out.extend(common)
+        rests = []
+        degenerate = False
+        for b in branches:
+            rest = [c for c in b if c not in common]
+            if not rest:
+                degenerate = True   # one branch had only common conjuncts
+                break
+            rests.append(_and_fold(rest))
+        if not degenerate:
+            out.append(_or_fold(rests))
+    return out
+
+
+def walk_names(e, out: set):
+    """Collect ast.Name nodes (skipping into subqueries)."""
+    if isinstance(e, ast.Name):
+        out.add(e.parts)
+    elif isinstance(e, ast.BinOp):
+        walk_names(e.left, out)
+        walk_names(e.right, out)
+    elif isinstance(e, ast.UnaryOp):
+        walk_names(e.arg, out)
+    elif isinstance(e, ast.FuncCall):
+        for a in e.args:
+            walk_names(a, out)
+    elif isinstance(e, ast.Case):
+        if e.operand is not None:
+            walk_names(e.operand, out)
+        for c, r in e.whens:
+            walk_names(c, out)
+            walk_names(r, out)
+        if e.default is not None:
+            walk_names(e.default, out)
+    elif isinstance(e, (ast.Cast,)):
+        walk_names(e.arg, out)
+    elif isinstance(e, ast.Between):
+        walk_names(e.arg, out)
+        walk_names(e.lo, out)
+        walk_names(e.hi, out)
+    elif isinstance(e, (ast.InList,)):
+        walk_names(e.arg, out)
+        for i in e.items:
+            walk_names(i, out)
+    elif isinstance(e, (ast.Like, ast.IsNull)):
+        walk_names(e.arg, out)
+
+
+def walk_aggs(e, out: list):
+    """Collect aggregate FuncCalls (no nesting into their args)."""
+    if isinstance(e, ast.FuncCall):
+        if e.name in B.AGG_NAMES:
+            out.append(e)
+            return
+        for a in e.args:
+            walk_aggs(a, out)
+    elif isinstance(e, ast.BinOp):
+        walk_aggs(e.left, out)
+        walk_aggs(e.right, out)
+    elif isinstance(e, ast.UnaryOp):
+        walk_aggs(e.arg, out)
+    elif isinstance(e, ast.Case):
+        if e.operand is not None:
+            walk_aggs(e.operand, out)
+        for c, r in e.whens:
+            walk_aggs(c, out)
+            walk_aggs(r, out)
+        if e.default is not None:
+            walk_aggs(e.default, out)
+    elif isinstance(e, ast.Cast):
+        walk_aggs(e.arg, out)
+    elif isinstance(e, ast.Between):
+        walk_aggs(e.arg, out)
+        walk_aggs(e.lo, out)
+        walk_aggs(e.hi, out)
+
+
+@dataclass
+class _Rel:
+    alias: str
+    table: object                 # ColumnTable
+    local_preds: list = field(default_factory=list)
+
+
+class Planner:
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    # -- entry -------------------------------------------------------------
+
+    def plan_select(self, sel: ast.Select) -> QueryPlan:
+        if sel.relation is None:
+            raise PlanError("SELECT without FROM is not supported yet")
+        pool = B.ParamPool()
+
+        rels, join_conds, left_joins = self._flatten_relations(sel.relation)
+        if left_joins:
+            raise PlanError("outer joins not supported yet")
+        scope = B.Scope()
+        for r in rels.values():
+            for col in r.table.schema:
+                internal = f"{r.alias}.{col.name}"
+                scope.add(r.alias, col.name, B.ColumnBinding(
+                    internal, col.dtype,
+                    r.table.dictionaries.get(col.name)))
+        self.scope = scope
+        self.pool = pool
+        binder = B.ExprBinder(scope, pool)
+
+        # classify predicates ((a∧x)∨(a∧y) → a∧(x∨y) first: surfaces
+        # join conditions buried in OR branches, e.g. TPC-H Q19)
+        preds = []
+        for p in conjuncts(sel.where) + join_conds:
+            preds.extend(hoist_or_common(p))
+        edges: list = []           # (alias_a, col_a, alias_b, col_b)
+        residuals: list = []
+        for p in preds:
+            aliases = self._pred_aliases(p, rels, scope)
+            if len(aliases) <= 1:
+                alias = next(iter(aliases), None)
+                if alias is None:
+                    residuals.append(p)     # constant pred → keep at top
+                else:
+                    rels[alias].local_preds.append(p)
+            elif (len(aliases) == 2 and isinstance(p, ast.BinOp)
+                  and p.op == "=" and isinstance(p.left, ast.Name)
+                  and isinstance(p.right, ast.Name)):
+                la = self._name_alias(p.left, rels, scope)
+                ra = self._name_alias(p.right, rels, scope)
+                edges.append((la, p.left, ra, p.right))
+            else:
+                residuals.append(p)
+
+        # column demand: everything referenced above the scans
+        needed: set = set()        # internal names
+        for item in sel.items:
+            if isinstance(item.expr, ast.Star):
+                for r in rels.values():
+                    for col in r.table.schema:
+                        needed.add(f"{r.alias}.{col.name}")
+            else:
+                self._demand(item.expr, needed)
+        for e in sel.group_by:
+            self._demand(e, needed)
+        for o in sel.order_by:
+            self._demand(o.expr, needed)
+        if sel.having is not None:
+            self._demand(sel.having, needed)
+        for p in residuals:
+            self._demand(p, needed)
+
+        # fact table and join spanning tree (PK edges preferred: MapJoin
+        # needs unique build keys; leftover edges become residual filters)
+        fact = max(rels.values(), key=lambda r: r.table.num_rows).alias
+        children, in_tree, leftovers = self._spanning_tree(fact, rels, edges)
+        unreachable = set(rels) - in_tree
+        if unreachable:
+            raise PlanError(f"no join path to {sorted(unreachable)} "
+                            "(cross joins not supported yet)")
+        for (la, lname, ra, rname) in leftovers:
+            residuals.append(ast.BinOp("=", lname, rname))
+        for p in residuals:
+            self._demand(p, needed)
+
+        pipeline = self._build_pipeline(fact, rels, children, needed,
+                                        binder, top=True)
+
+        # residual predicates at top
+        if residuals:
+            prog = ir.Program()
+            for p in residuals:
+                prog.filter(binder.bind(p))
+            pipeline.steps.append(("program", prog))
+
+        plan = QueryPlan(pipeline=pipeline, params=pool.values)
+        self._plan_projection_agg(sel, plan, binder)
+        return plan
+
+    # -- relations ---------------------------------------------------------
+
+    def _flatten_relations(self, rel: ast.Relation):
+        rels: dict[str, _Rel] = {}
+        conds: list = []
+        left_joins: list = []
+
+        def add_table(t: ast.TableRef):
+            alias = t.alias or t.name
+            if alias in rels:
+                raise PlanError(f"duplicate alias {alias}")
+            rels[alias] = _Rel(alias, self.catalog.table(t.name))
+
+        def walk(r):
+            if isinstance(r, ast.TableRef):
+                add_table(r)
+            elif isinstance(r, ast.Join):
+                if r.kind in ("inner", "cross"):
+                    walk(r.left)
+                    walk(r.right)
+                    if r.on is not None:
+                        conds.extend(conjuncts(r.on))
+                elif r.kind == "left":
+                    left_joins.append(r)
+                    walk(r.left)
+                    walk(r.right)
+                else:
+                    raise PlanError(f"{r.kind} join not supported yet")
+            elif isinstance(r, ast.SubqueryRef):
+                raise PlanError("FROM subqueries not supported yet")
+            else:
+                raise PlanError(f"bad relation {r!r}")
+
+        walk(rel)
+        return rels, conds, left_joins
+
+    def _pred_aliases(self, p, rels, scope) -> set:
+        names: set = set()
+        walk_names(p, names)
+        out = set()
+        for parts in names:
+            b = scope.try_resolve(parts)
+            if b is None:
+                raise PlanError(f"unknown column {'.'.join(parts)}")
+            out.add(b.internal.split(".", 1)[0])
+        return out
+
+    def _name_alias(self, n: ast.Name, rels, scope) -> str:
+        return scope.resolve(n.parts).internal.split(".", 1)[0]
+
+    def _demand(self, e, needed: set):
+        names: set = set()
+        walk_names(e, names)
+        for parts in names:
+            b = self.scope.try_resolve(parts)
+            if b is not None:
+                needed.add(b.internal)
+
+    # -- join tree ---------------------------------------------------------
+
+    def _spanning_tree(self, fact: str, rels, edges):
+        """Prim-style tree from the fact outward; prefer edges whose child
+        column is the child table's (single-column) primary key so the
+        broadcast-join build side has unique keys."""
+        in_tree = {fact}
+        children: dict[str, list] = {a: [] for a in rels}
+        used = [False] * len(edges)
+        while True:
+            best = None
+            for i, (la, lname, ra, rname) in enumerate(edges):
+                if used[i]:
+                    continue
+                for (pa, pname, ca, cname) in ((la, lname, ra, rname),
+                                               (ra, rname, la, lname)):
+                    if pa in in_tree and ca not in in_tree:
+                        col = self.scope.resolve(cname.parts).internal \
+                            .split(".", 1)[1]
+                        pk = rels[ca].table.key_columns
+                        score = 2 if (len(pk) == 1 and pk[0] == col) \
+                            else (1 if col in pk else 0)
+                        cand = (score, -rels[ca].table.num_rows,
+                                -i, pa, pname, ca, cname)
+                        if best is None or cand[:3] > best[:3]:
+                            best = cand
+            if best is None:
+                break
+            _s, _r, neg_i, pa, pname, ca, cname = best
+            used[-neg_i] = True
+            in_tree.add(ca)
+            children[pa].append((ca, pname, cname))
+        # drop used edges; also edges between two in-tree tables stay residual
+        leftovers = [e for i, e in enumerate(edges) if not used[i]]
+        return children, in_tree, leftovers
+
+    def _build_pipeline(self, alias: str, rels, children, needed,
+                        binder, top: bool) -> Pipeline:
+        r = rels[alias]
+        # local predicate program
+        pre = ir.Program()
+        scan_cols: set = set()
+        for p in r.local_preds:
+            pre.filter(binder.bind(p))
+            self._demand(p, scan_cols)
+
+        # recurse into children first (they register join-key demand)
+        join_steps = []
+        for (child, my_name, child_name) in children[alias]:
+            probe_b = self.scope.resolve(my_name.parts)
+            build_b = self.scope.resolve(child_name.parts)
+            scan_cols.add(probe_b.internal)
+            child_needed = set(needed)
+            child_needed.add(build_b.internal)
+            sub = self._build_pipeline(child, rels, children,
+                                       child_needed, binder, top=False)
+            # keep the build key in the payload when referenced above
+            # (e.g. it is a group key)
+            payload = [c for c in sub.out_names
+                       if c in needed
+                       and (c != build_b.internal or build_b.internal in needed)]
+            kind = "inner" if payload else "left_semi"
+            join_steps.append(JoinStep(sub, build_b.internal,
+                                       probe_b.internal, kind, payload))
+
+        # own columns demanded from above
+        own_cols = {n for n in needed
+                    if n.split(".", 1)[0] == alias
+                    and self.scope.by_alias[alias].get(n.split(".", 1)[1])}
+        scan_cols |= own_cols
+
+        storage_cols = []
+        for internal in sorted(scan_cols):
+            a, col = internal.split(".", 1)
+            if a == alias:
+                storage_cols.append((col, internal))
+        scan = ScanSpec(r.table.name, storage_cols)
+        self._extract_prune(pre, scan, r.table)
+
+        out_names = sorted(own_cols)
+        for js in join_steps:
+            out_names.extend(js.payload)
+        pipe = Pipeline(scan=scan,
+                        pre_program=pre if pre.commands else None,
+                        steps=[("join", js) for js in join_steps],
+                        out_names=out_names)
+        if not top:
+            # build fragments materialize: project to outputs
+            prog = ir.Program().project(out_names)
+            pipe.partial = prog
+        return pipe
+
+    def _extract_prune(self, prog: ir.Program, scan: ScanSpec, table) -> None:
+        from ydb_tpu.storage.pushdown import extract_prune_predicates
+        internal_to_storage = {i: s for (s, i) in scan.columns}
+        for (col, op, val) in extract_prune_predicates(prog):
+            storage = internal_to_storage.get(col)
+            if storage is None:
+                continue
+            dtype = table.schema.dtype(storage)
+            if dtype.is_string and op != "eq":
+                continue   # dictionary codes are unordered
+            scan.prune.append((storage, op, val))
+
+    # -- aggregation & projection ------------------------------------------
+
+    def _plan_projection_agg(self, sel: ast.Select, plan: QueryPlan,
+                             binder: B.ExprBinder) -> None:
+        aggs: list = []
+        for item in sel.items:
+            if not isinstance(item.expr, ast.Star):
+                walk_aggs(item.expr, aggs)
+        if sel.having is not None:
+            walk_aggs(sel.having, aggs)
+        for o in sel.order_by:
+            walk_aggs(o.expr, aggs)
+
+        has_agg = bool(aggs) or bool(sel.group_by)
+
+        # alias map for GROUP BY / ORDER BY references to select aliases
+        alias_map = {item.alias: item.expr for item in sel.items if item.alias}
+
+        def deref(e, positional=False):
+            """Select-alias substitution; `positional` additionally resolves
+            bare integers as 1-based select positions (ORDER BY 1 / GROUP
+            BY 1) and must only be used at the top level of those clauses —
+            never recursively, or nested literals would be rewritten."""
+            if isinstance(e, ast.Name) and len(e.parts) == 1 \
+                    and e.parts[0] in alias_map \
+                    and self.scope.try_resolve(e.parts) is None:
+                return alias_map[e.parts[0]]
+            if positional and isinstance(e, ast.Literal) \
+                    and isinstance(e.value, int) and e.type_hint is None \
+                    and 1 <= e.value <= len(sel.items):
+                return sel.items[e.value - 1].expr
+            return e
+
+        if has_agg:
+            self._plan_aggregate(sel, plan, binder, aggs, deref)
+        else:
+            self._plan_simple(sel, plan, binder, deref)
+
+    def _plan_simple(self, sel: ast.Select, plan: QueryPlan,
+                     binder: B.ExprBinder, deref) -> None:
+        """No aggregation: compute outputs per block; final sort/limit."""
+        prog = ir.Program()
+        output = []
+        out_names = []
+        for i, item in enumerate(sel.items):
+            if isinstance(item.expr, ast.Star):
+                for name in plan.pipeline.out_names:
+                    output.append((name, name.split(".", 1)[1]))
+                    out_names.append(name)
+                continue
+            e = binder.bind(item.expr)
+            label = item.alias or (
+                item.expr.parts[-1] if isinstance(item.expr, ast.Name)
+                else f"column{i}")
+            if isinstance(e, ir.Col):
+                name = e.name
+            else:
+                name = f"expr{i}"
+                prog.assign(name, e)
+            output.append((name, label))
+            out_names.append(name)
+
+        uniq_outs = list(dict.fromkeys(out_names))
+        if sel.distinct:
+            # dedup per block, then globally; sort expressions are computed
+            # after the final dedup (they would be dropped by the GroupBy)
+            prog.group_by(uniq_outs, [])
+            plan.pipeline.partial = prog
+            final = ir.Program().group_by(uniq_outs, [])
+            sort_keys, _extra = self._bind_sort(sel, binder.bind, out_names,
+                                                final, alias_deref=deref)
+            plan.final_program = final
+        else:
+            sort_keys, extra = self._bind_sort(sel, binder.bind, out_names,
+                                               prog, alias_deref=deref)
+            prog.project(list(dict.fromkeys(out_names + extra)))
+            plan.pipeline.partial = prog
+        plan.sort = sort_keys
+        plan.limit, plan.offset = sel.limit, sel.offset
+        plan.output = output
+
+    def _plan_aggregate(self, sel: ast.Select, plan: QueryPlan,
+                        binder: B.ExprBinder, agg_calls, deref) -> None:
+        partial = ir.Program()
+        # group keys
+        key_specs = []     # (ast_expr, ir_expr, key_name)
+        for i, ge in enumerate(sel.group_by):
+            ge = deref(ge, positional=True)
+            e = binder.bind(ge)
+            if isinstance(e, ir.Col):
+                name = e.name
+            else:
+                name = f"gk{i}"
+                partial.assign(name, e)
+            key_specs.append((ge, e, name))
+        key_names = [k[2] for k in key_specs]
+
+        # aggregate instances (deduped by bound signature)
+        agg_map: dict = {}          # signature -> dict describing partial/final
+        partial_aggs: list = []
+        final_aggs: list = []
+        n = 0
+
+        sealed = [False]
+
+        def register(call: ast.FuncCall) -> dict:
+            nonlocal n
+            if call.distinct:
+                raise PlanError("DISTINCT aggregates not supported yet")
+            # dedup on the AST (bound IR is not stable: LUT params get
+            # fresh names per binding)
+            if call.star or not call.args:
+                sig = ("count_all",)
+            else:
+                sig = (call.name, repr(call.args[0]))
+            inst = agg_map.get(sig)
+            if inst is not None:
+                return inst
+            if sealed[0]:
+                raise PlanError(
+                    f"aggregate {call.name} appeared only after the partial "
+                    "stage was sealed (planner bug)")
+            inst = {"func": call.name}
+            if call.star or not call.args:
+                out = f"agg{n}"; n += 1
+                partial_aggs.append(ir.Agg(out, "count_all"))
+                final_aggs.append(ir.Agg(out, "sum", out))
+                inst["col"] = out
+            else:
+                arg_ir = binder.bind(call.args[0])
+                arg_name = arg_ir.name if isinstance(arg_ir, ir.Col) else None
+                if arg_name is None:
+                    arg_name = f"aggarg{n}"
+                    partial.assign(arg_name, arg_ir)
+                if call.name == "avg":
+                    s, c = f"agg{n}s", f"agg{n}c"; n += 1
+                    partial_aggs.append(ir.Agg(s, "sum", arg_name))
+                    partial_aggs.append(ir.Agg(c, "count", arg_name))
+                    final_aggs.append(ir.Agg(s, "sum", s))
+                    final_aggs.append(ir.Agg(c, "sum", c))
+                    inst["sum"], inst["count"] = s, c
+                elif call.name == "count":
+                    out = f"agg{n}"; n += 1
+                    partial_aggs.append(ir.Agg(out, "count", arg_name))
+                    final_aggs.append(ir.Agg(out, "sum", out))
+                    inst["col"] = out
+                elif call.name in ("sum", "min", "max", "some"):
+                    out = f"agg{n}"; n += 1
+                    f = call.name
+                    partial_aggs.append(ir.Agg(out, f, arg_name))
+                    final_aggs.append(ir.Agg(out, "sum" if f == "sum" else f, out))
+                    inst["col"] = out
+                else:
+                    raise PlanError(f"aggregate {call.name} not supported")
+            agg_map[sig] = inst
+            return inst
+
+        for call in agg_calls:
+            register(call)
+
+        partial.group_by(key_names, partial_aggs)
+        sealed[0] = True
+        plan.pipeline.partial = partial
+
+        # -- final stage: merge aggs, having, outputs, sort ---------------
+        final = ir.Program().group_by(key_names, final_aggs)
+
+        planner = self
+
+        class GroupBinder(B.ExprBinder):
+            def bind(self, e):
+                e = deref(e)
+                # whole-expression match against a group key
+                try:
+                    be = binder.bind(e)
+                except B.BindError:
+                    be = None
+                if be is not None:
+                    for (_ge, ire, name) in key_specs:
+                        if be == ire:
+                            return ir.Col(name)
+                if isinstance(e, ast.FuncCall) and e.name in B.AGG_NAMES:
+                    inst = register(e)
+                    if e.name == "avg":
+                        return ir.call("div", ir.Col(inst["sum"]),
+                                       ir.Col(inst["count"]))
+                    return ir.Col(inst["col"])
+                return super().bind(e)
+
+        gbinder = GroupBinder(self.scope, self.pool)
+
+        if sel.having is not None:
+            final.filter(gbinder.bind(sel.having))
+
+        output = []
+        out_names = []
+        for i, item in enumerate(sel.items):
+            if isinstance(item.expr, ast.Star):
+                raise PlanError("* with GROUP BY")
+            e = gbinder.bind(item.expr)
+            label = item.alias or (
+                item.expr.parts[-1] if isinstance(item.expr, ast.Name)
+                else f"column{i}")
+            if isinstance(e, ir.Col):
+                name = e.name
+            else:
+                name = f"out{i}"
+                final.assign(name, e)
+            output.append((name, label))
+            out_names.append(name)
+
+        sort_keys, extra = self._bind_sort(sel, gbinder.bind, out_names, final,
+                                           alias_deref=deref)
+        final.project(list(dict.fromkeys(out_names + extra)))
+        plan.final_program = final
+        plan.sort = sort_keys
+        plan.limit, plan.offset = sel.limit, sel.offset
+        plan.output = output
+
+    def _bind_sort(self, sel, bind_fn, out_names: list, prog: ir.Program,
+                   alias_deref) -> tuple[list, list]:
+        sort_keys: list = []
+        extra: list = []
+        for j, o in enumerate(sel.order_by):
+            e = bind_fn(alias_deref(o.expr, positional=True))
+            if isinstance(e, ir.Col):
+                name = e.name
+            else:
+                name = f"sort{j}"
+                prog.assign(name, e)
+                extra.append(name)
+            nf = o.nulls_first
+            if nf is None:
+                nf = o.ascending       # YQL: NULL is smallest
+            sort_keys.append(SortKey(name, o.ascending, nf))
+        return sort_keys, extra

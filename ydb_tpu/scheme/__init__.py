@@ -1,0 +1,1 @@
+from ydb_tpu.scheme.catalog import Catalog  # noqa: F401

@@ -1,0 +1,43 @@
+"""Table catalog — the SchemeShard/SchemeCache analog (embedded, v0).
+
+The reference keeps a path tree in the SchemeShard tablet
+(`ydb/core/tx/schemeshard/schemeshard_impl.h:69`) replicated to per-node
+SchemeCaches (`ydb/core/tx/scheme_cache/scheme_cache.h:102`). Here the
+catalog is an in-process registry of tables; DDL versioning, path tree, and
+replication arrive with the distributed control plane.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ydb_tpu.core.schema import Schema
+from ydb_tpu.storage.table import ColumnTable
+
+
+class Catalog:
+    def __init__(self):
+        self.tables: dict[str, ColumnTable] = {}
+        self._next_version = 1
+
+    def create_table(self, name: str, schema: Schema, key_columns: list[str],
+                     shards: int = 1, portion_rows: int = 1 << 20,
+                     partition_by: Optional[list[str]] = None) -> ColumnTable:
+        if name in self.tables:
+            raise ValueError(f"table {name!r} already exists")
+        t = ColumnTable(name, schema, key_columns, shards, portion_rows,
+                        partition_by)
+        self.tables[name] = t
+        return t
+
+    def drop_table(self, name: str) -> None:
+        del self.tables[name]
+
+    def table(self, name: str) -> ColumnTable:
+        t = self.tables.get(name)
+        if t is None:
+            raise KeyError(f"unknown table {name!r}")
+        return t
+
+    def has(self, name: str) -> bool:
+        return name in self.tables

@@ -1,0 +1,1 @@
+from ydb_tpu.sql.parser import parse  # noqa: F401

@@ -1,0 +1,214 @@
+"""SQL AST.
+
+The analog of the reference's SQL→AST layer (`ydb/library/yql/sql/v1/` —
+ANTLR grammar `SQLv1.g.in` producing `TExprNode` s-expressions). Here the
+grammar is hand-written recursive descent (ydb_tpu/sql/parser.py) and the
+AST is plain dataclasses consumed by the logical planner
+(ydb_tpu/query/planner.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Union
+
+
+# -- expressions -----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Name:
+    """Column reference: `x` or `t.x`."""
+    parts: tuple                   # ("x",) or ("t", "x")
+
+
+@dataclass(frozen=True)
+class Literal:
+    value: Any                     # int | float | str | bool | None
+    type_hint: Optional[str] = None  # "date" | "interval_day" | ...
+
+
+@dataclass(frozen=True)
+class BinOp:
+    op: str                        # + - * / % and or = <> < <= > >= ||
+    left: "Expr"
+    right: "Expr"
+
+
+@dataclass(frozen=True)
+class UnaryOp:
+    op: str                        # - not
+    arg: "Expr"
+
+
+@dataclass(frozen=True)
+class FuncCall:
+    name: str                      # lower-cased
+    args: tuple                    # tuple[Expr, ...]
+    distinct: bool = False         # COUNT(DISTINCT x)
+    star: bool = False             # COUNT(*)
+
+
+@dataclass(frozen=True)
+class Case:
+    operand: Optional["Expr"]      # CASE <operand> WHEN ... (None: searched)
+    whens: tuple                   # tuple[(cond, result), ...]
+    default: Optional["Expr"]
+
+
+@dataclass(frozen=True)
+class Cast:
+    arg: "Expr"
+    to: str                        # type name, lower-cased
+
+
+@dataclass(frozen=True)
+class Between:
+    arg: "Expr"
+    lo: "Expr"
+    hi: "Expr"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InList:
+    arg: "Expr"
+    items: tuple                   # tuple[Expr, ...]
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class InSubquery:
+    arg: "Expr"
+    query: "Select"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Exists:
+    query: "Select"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class ScalarSubquery:
+    query: "Select"
+
+
+@dataclass(frozen=True)
+class Like:
+    arg: "Expr"
+    pattern: str
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull:
+    arg: "Expr"
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Star:
+    """SELECT * or t.*"""
+    table: Optional[str] = None
+
+
+Expr = Union[Name, Literal, BinOp, UnaryOp, FuncCall, Case, Cast, Between,
+             InList, InSubquery, Exists, ScalarSubquery, Like, IsNull, Star]
+
+
+# -- relations -------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SubqueryRef:
+    query: "Select"
+    alias: str
+
+
+@dataclass(frozen=True)
+class Join:
+    kind: str                      # inner | left | right | full | cross
+    left: "Relation"
+    right: "Relation"
+    on: Optional[Expr] = None
+
+
+Relation = Union[TableRef, SubqueryRef, Join]
+
+
+# -- statements ------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class OrderItem:
+    expr: Expr
+    ascending: bool = True
+    nulls_first: Optional[bool] = None   # None = dialect default (last)
+
+
+@dataclass
+class Select:
+    items: list = field(default_factory=list)          # list[SelectItem]
+    relation: Optional[Relation] = None
+    where: Optional[Expr] = None
+    group_by: list = field(default_factory=list)       # list[Expr]
+    having: Optional[Expr] = None
+    order_by: list = field(default_factory=list)       # list[OrderItem]
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    distinct: bool = False
+
+
+@dataclass
+class CreateTable:
+    name: str
+    columns: list                     # list[(name, type_str, not_null)]
+    primary_key: list                 # list[str]
+    partition_count: int = 1
+    store: str = "column"             # column | row
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTable:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class Insert:
+    table: str
+    columns: list                     # list[str] (may be empty = all)
+    rows: list = field(default_factory=list)   # list[list[Literal]]
+    query: Optional[Select] = None
+    mode: str = "insert"              # insert | upsert | replace
+
+
+@dataclass
+class Delete:
+    table: str
+    where: Optional[Expr] = None
+
+
+@dataclass
+class Update:
+    table: str
+    assignments: list = field(default_factory=list)  # list[(col, Expr)]
+    where: Optional[Expr] = None
+
+
+Statement = Union[Select, CreateTable, DropTable, Insert, Delete, Update]

@@ -30,6 +30,29 @@ from lineitem
 where l_shipdate <= date '1998-12-01' - interval '90' day
 group by l_returnflag, l_linestatus
 order by l_returnflag, l_linestatus""",
+    "q2": """
+select s_acctbal, s_name, n_name, p_partkey, p_mfgr, s_address, s_phone,
+  s_comment
+from part, supplier, partsupp, nation, region
+where p_partkey = ps_partkey and s_suppkey = ps_suppkey and p_size = 15
+  and p_type like '%BRASS' and s_nationkey = n_nationkey
+  and n_regionkey = r_regionkey and r_name = 'EUROPE'
+  and ps_supplycost = (
+    select min(ps_supplycost) from partsupp, supplier, nation, region
+    where p_partkey = ps_partkey and s_suppkey = ps_suppkey
+      and s_nationkey = n_nationkey and n_regionkey = r_regionkey
+      and r_name = 'EUROPE')
+order by s_acctbal desc, n_name, s_name, p_partkey
+limit 100""",
+    "q4": """
+select o_orderpriority, count(*) as order_count
+from orders
+where o_orderdate >= date '1993-07-01'
+  and o_orderdate < date '1993-07-01' + interval '3' month
+  and exists (select * from lineitem
+              where l_orderkey = o_orderkey and l_commitdate < l_receiptdate)
+group by o_orderpriority
+order by o_orderpriority""",
     "q3": """
 select l_orderkey, sum(l_extendedprice*(1-l_discount)) as revenue,
   o_orderdate, o_shippriority
@@ -58,6 +81,56 @@ where l_shipdate >= date '1994-01-01'
   and l_shipdate < date '1994-01-01' + interval '1' year
   and l_discount between 0.05 and 0.07
   and l_quantity < 24""",
+    "q7": """
+select n1.n_name as supp_nation, n2.n_name as cust_nation,
+  year(l_shipdate) as l_year,
+  sum(l_extendedprice * (1 - l_discount)) as revenue
+from supplier, lineitem, orders, customer, nation n1, nation n2
+where s_suppkey = l_suppkey and o_orderkey = l_orderkey
+  and c_custkey = o_custkey and s_nationkey = n1.n_nationkey
+  and c_nationkey = n2.n_nationkey
+  and ((n1.n_name = 'FRANCE' and n2.n_name = 'GERMANY')
+    or (n1.n_name = 'GERMANY' and n2.n_name = 'FRANCE'))
+  and l_shipdate between date '1995-01-01' and date '1996-12-31'
+group by supp_nation, cust_nation, l_year
+order by supp_nation, cust_nation, l_year""",
+    "q8": """
+select year(o_orderdate) as o_year,
+  sum(case when n2.n_name = 'BRAZIL'
+      then l_extendedprice * (1 - l_discount) else 0 end)
+    / sum(l_extendedprice * (1 - l_discount)) as mkt_share
+from part, supplier, lineitem, orders, customer, nation n1, nation n2, region
+where p_partkey = l_partkey and s_suppkey = l_suppkey
+  and l_orderkey = o_orderkey and o_custkey = c_custkey
+  and c_nationkey = n1.n_nationkey and n1.n_regionkey = r_regionkey
+  and r_name = 'AMERICA' and s_nationkey = n2.n_nationkey
+  and o_orderdate between date '1995-01-01' and date '1996-12-31'
+  and p_type = 'ECONOMY ANODIZED STEEL'
+group by o_year
+order by o_year""",
+    "q20": """
+select s_name, s_address from supplier, nation
+where s_suppkey in (
+    select ps_suppkey from partsupp
+    where ps_partkey in (select p_partkey from part
+                         where p_name like 'forest%')
+      and ps_availqty > (select 0.5 * sum(l_quantity) from lineitem
+                         where l_partkey = ps_partkey
+                           and l_suppkey = ps_suppkey
+                           and l_shipdate >= date '1994-01-01'
+                           and l_shipdate < date '1994-01-01' + interval '1' year))
+  and s_nationkey = n_nationkey and n_name = 'CANADA'
+order by s_name""",
+    "q9": """
+select n_name, year(o_orderdate) as o_year,
+  sum(l_extendedprice*(1-l_discount) - ps_supplycost*l_quantity) as sum_profit
+from part, supplier, lineitem, partsupp, orders, nation
+where s_suppkey = l_suppkey and ps_suppkey = l_suppkey
+  and ps_partkey = l_partkey and p_partkey = l_partkey
+  and o_orderkey = l_orderkey and s_nationkey = n_nationkey
+  and p_name like '%green%'
+group by n_name, o_year
+order by n_name, o_year desc""",
     "q10": """
 select c_custkey, c_name, sum(l_extendedprice*(1-l_discount)) as revenue,
   c_acctbal, n_name, c_address, c_phone, c_comment
@@ -69,6 +142,48 @@ where c_custkey = o_custkey and l_orderkey = o_orderkey
 group by c_custkey, c_name, c_acctbal, c_phone, n_name, c_address, c_comment
 order by revenue desc
 limit 20""",
+    "q11": """
+select ps_partkey, sum(ps_supplycost * ps_availqty) as value
+from partsupp, supplier, nation
+where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+  and n_name = 'GERMANY'
+group by ps_partkey
+having sum(ps_supplycost * ps_availqty) > (
+  select sum(ps_supplycost * ps_availqty) * 0.0001
+  from partsupp, supplier, nation
+  where ps_suppkey = s_suppkey and s_nationkey = n_nationkey
+    and n_name = 'GERMANY')
+order by value desc""",
+    "q17": """
+select sum(l_extendedprice) / 7.0 as avg_yearly
+from lineitem, part
+where p_partkey = l_partkey and p_brand = 'Brand#23'
+  and p_container = 'MED BOX'
+  and l_quantity < (select 0.2 * avg(l_quantity) from lineitem
+                    where l_partkey = p_partkey)""",
+    "q18": """
+select c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+  sum(l_quantity) as total_qty
+from customer, orders, lineitem
+where o_orderkey in (select l_orderkey from lineitem
+                     group by l_orderkey having sum(l_quantity) > 250)
+  and c_custkey = o_custkey and o_orderkey = l_orderkey
+group by c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+order by o_totalprice desc, o_orderdate
+limit 100""",
+    "q22": """
+select substring(c_phone from 1 for 2) as cntrycode, count(*) as numcust,
+  sum(c_acctbal) as totacctbal
+from customer
+where substring(c_phone from 1 for 2) in
+      ('13', '31', '23', '29', '30', '18', '17')
+  and c_acctbal > (select avg(c_acctbal) from customer
+                   where c_acctbal > 0.00
+                     and substring(c_phone from 1 for 2) in
+                         ('13', '31', '23', '29', '30', '18', '17'))
+  and not exists (select * from orders where o_custkey = c_custkey)
+group by cntrycode
+order by cntrycode""",
     "q12": """
 select l_shipmode,
   sum(case when o_orderpriority = '1-URGENT' or o_orderpriority = '2-HIGH'
@@ -155,6 +270,154 @@ def oracle(name: str, data: TpchData) -> pd.DataFrame:
                & (li.l_discount >= 0.05 - 1e-12) & (li.l_discount <= 0.07 + 1e-12)
                & (li.l_quantity < 24)]
         return pd.DataFrame({"revenue": [(d.l_extendedprice * d.l_discount).sum()]})
+    if name == "q2":
+        pa, su, ps, na, re_ = f["part"], f["supplier"], f["partsupp"], \
+            f["nation"], f["region"]
+        eu = na.merge(re_[re_.r_name == "EUROPE"], left_on="n_regionkey",
+                      right_on="r_regionkey")
+        s_eu = su.merge(eu, left_on="s_nationkey", right_on="n_nationkey")
+        ps_eu = ps.merge(s_eu, left_on="ps_suppkey", right_on="s_suppkey")
+        min_cost = ps_eu.groupby("ps_partkey").ps_supplycost.min() \
+            .rename("min_cost").reset_index()
+        p = pa[(pa.p_size == 15) & pa.p_type.str.endswith("BRASS")]
+        j = p.merge(ps_eu, left_on="p_partkey", right_on="ps_partkey") \
+             .merge(min_cost, on="ps_partkey")
+        j = j[j.ps_supplycost == j.min_cost]
+        j = j.sort_values(["s_acctbal", "n_name", "s_name", "p_partkey"],
+                          ascending=[False, True, True, True],
+                          kind="stable").head(100)
+        return j[["s_acctbal", "s_name", "n_name", "p_partkey", "p_mfgr",
+                  "s_address", "s_phone", "s_comment"]]
+    if name == "q4":
+        o = od[(od.o_orderdate >= date32(1993, 7, 1))
+               & (od.o_orderdate < date32(1993, 10, 1))]
+        late = li[li.l_commitdate < li.l_receiptdate].l_orderkey.unique()
+        o = o[o.o_orderkey.isin(late)]
+        g = o.groupby("o_orderpriority").size().reset_index(name="order_count")
+        return g.sort_values("o_orderpriority")
+    if name == "q11":
+        ps, su, na = f["partsupp"], f["supplier"], f["nation"]
+        g_na = na[na.n_name == "GERMANY"]
+        j = ps.merge(su, left_on="ps_suppkey", right_on="s_suppkey") \
+              .merge(g_na, left_on="s_nationkey", right_on="n_nationkey")
+        j = j.assign(v=j.ps_supplycost * j.ps_availqty)
+        total = j.v.sum() * 0.0001
+        g = j.groupby("ps_partkey").v.sum().reset_index() \
+             .rename(columns={"v": "value"})
+        g = g[g.value > total]
+        return g.sort_values("value", ascending=False, kind="stable")
+    if name == "q17":
+        pa = f["part"]
+        p = pa[(pa.p_brand == "Brand#23") & (pa.p_container == "MED BOX")]
+        j = li.merge(p, left_on="l_partkey", right_on="p_partkey")
+        avg_q = li.groupby("l_partkey").l_quantity.mean() \
+            .rename("avg_q").reset_index()
+        j = j.merge(avg_q, on="l_partkey")
+        j = j[j.l_quantity < 0.2 * j.avg_q]
+        s = j.l_extendedprice.sum() / 7.0 if len(j) else np.nan
+        return pd.DataFrame({"avg_yearly": [s]})
+    if name == "q18":
+        big = li.groupby("l_orderkey").l_quantity.sum()
+        big = big[big > 250].index
+        o = od[od.o_orderkey.isin(big)]
+        j = li.merge(o, left_on="l_orderkey", right_on="o_orderkey") \
+              .merge(cu, left_on="o_custkey", right_on="c_custkey")
+        g = j.groupby(["c_name", "c_custkey", "o_orderkey", "o_orderdate",
+                       "o_totalprice"]).l_quantity.sum().reset_index() \
+             .rename(columns={"l_quantity": "total_qty"})
+        g = g.sort_values(["o_totalprice", "o_orderdate"],
+                          ascending=[False, True], kind="stable").head(100)
+        return g
+    if name == "q22":
+        codes = ["13", "31", "23", "29", "30", "18", "17"]
+        cc = cu.c_phone.str[:2]
+        sel = cu[cc.isin(codes)]
+        avg_bal = sel[sel.c_acctbal > 0].c_acctbal.mean()
+        sel = sel[sel.c_acctbal > avg_bal]
+        sel = sel[~sel.c_custkey.isin(od.o_custkey.unique())]
+        sel = sel.assign(cntrycode=sel.c_phone.str[:2])
+        g = sel.groupby("cntrycode").agg(
+            numcust=("c_custkey", "count"),
+            totacctbal=("c_acctbal", "sum")).reset_index()
+        return g.sort_values("cntrycode")
+    if name == "q7":
+        su, na = f["supplier"], f["nation"]
+        j = li.merge(su, left_on="l_suppkey", right_on="s_suppkey") \
+              .merge(od, left_on="l_orderkey", right_on="o_orderkey") \
+              .merge(cu, left_on="o_custkey", right_on="c_custkey") \
+              .merge(na.add_suffix("_1"), left_on="s_nationkey",
+                     right_on="n_nationkey_1") \
+              .merge(na.add_suffix("_2"), left_on="c_nationkey",
+                     right_on="n_nationkey_2")
+        m = (((j.n_name_1 == "FRANCE") & (j.n_name_2 == "GERMANY"))
+             | ((j.n_name_1 == "GERMANY") & (j.n_name_2 == "FRANCE")))
+        j = j[m & (j.l_shipdate >= date32(1995, 1, 1))
+              & (j.l_shipdate <= date32(1996, 12, 31))]
+        yr = (pd.to_datetime(j.l_shipdate, unit="D", origin="unix")
+              .dt.year.astype(np.int64))
+        j = j.assign(l_year=yr, vol=j.l_extendedprice * (1 - j.l_discount))
+        g = j.groupby(["n_name_1", "n_name_2", "l_year"]).vol.sum() \
+             .reset_index()
+        g.columns = ["supp_nation", "cust_nation", "l_year", "revenue"]
+        return g.sort_values(["supp_nation", "cust_nation", "l_year"])
+    if name == "q8":
+        pa, su, na, re_ = f["part"], f["supplier"], f["nation"], f["region"]
+        am = na.merge(re_[re_.r_name == "AMERICA"], left_on="n_regionkey",
+                      right_on="r_regionkey")
+        p = pa[pa.p_type == "ECONOMY ANODIZED STEEL"]
+        j = li.merge(p, left_on="l_partkey", right_on="p_partkey") \
+              .merge(su, left_on="l_suppkey", right_on="s_suppkey") \
+              .merge(od, left_on="l_orderkey", right_on="o_orderkey") \
+              .merge(cu, left_on="o_custkey", right_on="c_custkey") \
+              .merge(am, left_on="c_nationkey", right_on="n_nationkey") \
+              .merge(na.add_suffix("_s"), left_on="s_nationkey",
+                     right_on="n_nationkey_s")
+        j = j[(j.o_orderdate >= date32(1995, 1, 1))
+              & (j.o_orderdate <= date32(1996, 12, 31))]
+        yr = (pd.to_datetime(j.o_orderdate, unit="D", origin="unix")
+              .dt.year.astype(np.int64))
+        vol = j.l_extendedprice * (1 - j.l_discount)
+        br = vol.where(j.n_name_s == "BRAZIL", 0.0)
+        j = j.assign(o_year=yr, vol=vol, br=br)
+        g = j.groupby("o_year").agg(b=("br", "sum"), v=("vol", "sum"))
+        g = g.reset_index()
+        g["mkt_share"] = g.b / g.v
+        return g[["o_year", "mkt_share"]].sort_values("o_year")
+    if name == "q20":
+        pa, su, ps, na = f["part"], f["supplier"], f["partsupp"], f["nation"]
+        forest = pa[pa.p_name.str.startswith("forest")].p_partkey
+        l = li[(li.l_shipdate >= date32(1994, 1, 1))
+               & (li.l_shipdate < date32(1995, 1, 1))]
+        half = l.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum() * 0.5
+        half = half.rename("half").reset_index()
+        p2 = ps[ps.ps_partkey.isin(forest)]
+        p2 = p2.merge(half, left_on=["ps_partkey", "ps_suppkey"],
+                      right_on=["l_partkey", "l_suppkey"])
+        p2 = p2[p2.ps_availqty > p2.half]
+        sk = p2.ps_suppkey.unique()
+        ca = na[na.n_name == "CANADA"]
+        s = su[su.s_suppkey.isin(sk)].merge(
+            ca, left_on="s_nationkey", right_on="n_nationkey")
+        s = s.sort_values("s_name")
+        return s[["s_name", "s_address"]]
+    if name == "q9":
+        pa, su, ps, na = f["part"], f["supplier"], f["partsupp"], f["nation"]
+        p = pa[pa.p_name.str.contains("green")]
+        j = li.merge(p, left_on="l_partkey", right_on="p_partkey") \
+              .merge(su, left_on="l_suppkey", right_on="s_suppkey") \
+              .merge(ps, left_on=["l_partkey", "l_suppkey"],
+                     right_on=["ps_partkey", "ps_suppkey"]) \
+              .merge(od, left_on="l_orderkey", right_on="o_orderkey") \
+              .merge(na, left_on="s_nationkey", right_on="n_nationkey")
+        oy = (pd.to_datetime(j.o_orderdate, unit="D", origin="unix")
+              .dt.year.astype(np.int64))
+        amount = j.l_extendedprice * (1 - j.l_discount) \
+            - j.ps_supplycost * j.l_quantity
+        j = j.assign(o_year=oy, amount=amount)
+        g = j.groupby(["n_name", "o_year"]).amount.sum().reset_index() \
+             .rename(columns={"amount": "sum_profit"})
+        return g.sort_values(["n_name", "o_year"],
+                             ascending=[True, False], kind="stable")
     if name == "q10":
         na = f["nation"]
         o = od[(od.o_orderdate >= date32(1993, 10, 1))

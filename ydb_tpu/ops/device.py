@@ -59,12 +59,22 @@ def to_device(block: HostBlock, capacity: Optional[int] = None) -> DeviceBlock:
 
 
 def to_host(dblock: DeviceBlock) -> HostBlock:
+    import jax
+
     n = int(dblock.length)
+    # one batched device→host transfer for all columns (each np.asarray on
+    # a device array is a separate blocking round-trip — expensive on a
+    # tunneled TPU)
+    sliced = {name: a[:n] for name, a in dblock.arrays.items()}
+    vsliced = {name: v[:n] for name, v in dblock.valids.items()}
+    host_a, host_v = jax.device_get((sliced, vsliced))
     cols = {}
     for c in dblock.schema:
-        d = np.asarray(dblock.arrays[c.name][:n]).astype(c.dtype.np)
-        v = np.asarray(dblock.valids[c.name][:n]) if c.name in dblock.valids else None
-        if v is not None and v.all():
-            v = None
+        d = np.asarray(host_a[c.name]).astype(c.dtype.np)
+        v = host_v.get(c.name)
+        if v is not None:
+            v = np.asarray(v)
+            if v.all():
+                v = None
         cols[c.name] = ColumnData(d, v, dblock.dictionaries.get(c.name))
     return HostBlock(dblock.schema, cols, n)

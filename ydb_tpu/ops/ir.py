@@ -99,6 +99,12 @@ class Agg:
 class GroupBy:
     keys: tuple                      # tuple[str, ...] (may be empty: global agg)
     aggs: tuple                      # tuple[Agg, ...]
+    # static per-key domain sizes (codes in [-1, domain)); 0 = unbounded.
+    # When every key is bounded and the product is small, the XLA lowering
+    # uses direct-indexed scatter aggregation (BlockCombineHashed analog)
+    # instead of sort-based segmentation. Part of the structural
+    # fingerprint, so dictionary growth recompiles.
+    key_domains: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -121,8 +127,10 @@ class Program:
         self.commands.append(Filter(pred))
         return self
 
-    def group_by(self, keys: list[str], aggs: list[Agg]) -> "Program":
-        self.commands.append(GroupBy(tuple(keys), tuple(aggs)))
+    def group_by(self, keys: list[str], aggs: list[Agg],
+                 key_domains: tuple = ()) -> "Program":
+        self.commands.append(GroupBy(tuple(keys), tuple(aggs),
+                                     tuple(key_domains)))
         return self
 
     def project(self, names: list[str]) -> "Program":

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu.core.block import ColumnData, HostBlock
+from ydb_tpu.core.dtypes import DType, Kind
 from ydb_tpu.core.schema import Column, Schema
 from ydb_tpu.ops.device import DeviceBlock, bucket_capacity
 
@@ -118,31 +119,37 @@ def _probe(probe_arrays, probe_valids, length, sel, n_build,
         (~found) & active if kind == "left_anti" else active)
 
     gathered, gathered_valid = {}, {}
-    if kind in ("inner", "left"):
+    if kind in ("inner", "left", "mark"):
         for name in payload_names:
             pd_ = payload[name][safe]
             gathered[name] = pd_
             pv = payload_valid.get(name)
             gv = found if pv is None else (found & pv[safe])
             gathered_valid[name] = gv
-    return out_sel, gathered, gathered_valid
+    return out_sel, gathered, gathered_valid, found
 
 
 def probe(dblock: DeviceBlock, table: BuildTable, probe_key: str,
           kind: str = "inner", sel=None,
-          rename: Optional[dict] = None) -> tuple[DeviceBlock, object]:
+          rename: Optional[dict] = None,
+          mark_col: Optional[str] = None) -> tuple[DeviceBlock, object]:
     """Probe a device block against a build table.
 
     Returns (new DeviceBlock with payload columns appended, new selection
     mask). The caller decides when to compress.
+
+    kind "mark" keeps every active row, attaches payloads (null where
+    unmatched) and a bool `mark_col` column holding the match flag — the
+    building block for semi/anti joins that need post-join verification
+    (composite hash keys, NOT IN null checks).
     """
-    if not table.unique and kind in ("inner", "left"):
+    if not table.unique and kind in ("inner", "left", "mark"):
         raise ValueError(
             "broadcast MapJoin requires unique build keys for inner/left "
             "joins; duplicate keys need the partitioned GraceJoin path")
     rename = rename or {}
     names = tuple(table.schema.names)
-    out_sel, gathered, gathered_valid = _probe(
+    out_sel, gathered, gathered_valid, found = _probe(
         dblock.arrays, dblock.valids, dblock.length, sel, jnp.int32(table.n),
         table.keys_sorted, table.payload, table.payload_valid,
         probe_key, kind, names)
@@ -151,7 +158,7 @@ def probe(dblock: DeviceBlock, table: BuildTable, probe_key: str,
     valids = dict(dblock.valids)
     dicts = dict(dblock.dictionaries)
     cols = list(dblock.schema.columns)
-    if kind in ("inner", "left"):
+    if kind in ("inner", "left", "mark"):
         for name in names:
             out_name = rename.get(name, name)
             arrays[out_name] = gathered[name]
@@ -160,6 +167,11 @@ def probe(dblock: DeviceBlock, table: BuildTable, probe_key: str,
             cols = [c for c in cols if c.name != out_name] + [Column(out_name, dt)]
             if name in table.dictionaries:
                 dicts[out_name] = table.dictionaries[name]
+    if kind == "mark":
+        name = mark_col or "__mark"
+        arrays[name] = found
+        cols = [c for c in cols if c.name != name] + [
+            Column(name, DType(Kind.BOOL, nullable=False))]
     schema = Schema(cols)
     out = DeviceBlock(schema, arrays, valids, dblock.length, dblock.capacity, dicts)
     return out, out_sel

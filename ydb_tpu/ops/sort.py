@@ -18,8 +18,18 @@ from ydb_tpu.ops.device import DeviceBlock
 from ydb_tpu.ops.xla_exec import _sort_operand, _zero_like_operand
 
 
+def sort_env(arrays, valids, length, sel, keys: tuple, names: tuple):
+    """Traceable sort body (callable from fused jitted pipelines);
+    keys: tuple of (col_name, ascending, nulls_first)."""
+    return _sort_impl(arrays, valids, length, sel, keys, names)
+
+
 @partial(jax.jit, static_argnames=("keys", "names"))
 def _sort_block(arrays, valids, length, sel, keys: tuple, names: tuple):
+    return _sort_impl(arrays, valids, length, sel, keys, names)
+
+
+def _sort_impl(arrays, valids, length, sel, keys: tuple, names: tuple):
     """keys: tuple of (col_name, ascending, nulls_first)."""
     first = arrays[names[0]]
     cap = first.shape[0]

@@ -94,8 +94,118 @@ def _sentinel(dtype, for_min: bool):
     return np.array(info.max if for_min else info.min, dtype=dtype)
 
 
+_SCATTER_MAX_BUCKETS = 1 << 16
+
+
+def _agg_over_segments(cmd: ir.GroupBy, env, active, seg_safe, nseg, iota):
+    """Shared aggregate emission: env values segmented by `seg_safe` into
+    `nseg` buckets; rows where ~active must carry seg_safe == nseg-1 (a
+    garbage bucket the caller drops or overwrites)."""
+    new_env = {}
+    for a in cmd.aggs:
+        if a.func == "count_all":
+            data = jax.ops.segment_sum(active.astype(jnp.uint64), seg_safe, nseg)
+            new_env[a.out] = (data, None)
+            continue
+        d, v = env[a.arg]
+        m = active if v is None else (active & v)
+        if a.func == "count":
+            data = jax.ops.segment_sum(m.astype(jnp.uint64), seg_safe, nseg)
+            new_env[a.out] = (data, None)
+            continue
+        any_valid = jax.ops.segment_max(m.astype(jnp.int32), seg_safe, nseg) > 0
+        if a.func == "sum":
+            if np.issubdtype(np.dtype(d.dtype), np.floating):
+                acc = jnp.where(m, d, 0).astype(jnp.float64)
+            elif d.dtype == jnp.uint64:
+                acc = jnp.where(m, d, 0).astype(jnp.uint64)
+            else:
+                acc = jnp.where(m, d, 0).astype(jnp.int64)
+            data = jax.ops.segment_sum(acc, seg_safe, nseg)
+            new_env[a.out] = (data, any_valid)
+        elif a.func in ("min", "max"):
+            sent = _sentinel(np.dtype(d.dtype), a.func == "min")
+            masked = jnp.where(m, d, sent)
+            fn = jax.ops.segment_min if a.func == "min" else jax.ops.segment_max
+            data = fn(masked, seg_safe, nseg)
+            data = jnp.where(any_valid, data, jnp.zeros((), d.dtype))
+            new_env[a.out] = (data, any_valid)
+        elif a.func == "some":
+            pos = jnp.where(m, iota, len(iota))
+            firstpos = jax.ops.segment_min(pos, seg_safe, nseg)
+            safe = jnp.clip(firstpos, 0, len(iota) - 1)
+            data = d[safe]
+            new_env[a.out] = (data, any_valid)
+        else:
+            raise ValueError(a.func)
+    return new_env
+
+
+def _trace_group_by_scatter(cmd: ir.GroupBy, env, schema: Schema, sel,
+                            length, cap):
+    """Direct-indexed aggregation for statically bounded key domains — the
+    BlockCombineHashed analog (`mkql_block_agg.cpp`): bucket id is the mixed
+    radix of the key codes (+1 slot for NULL), no sort. Buckets live in the
+    leading K slots of the cap-sized block; non-empty buckets are compacted
+    to the front."""
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    active = (iota < length) if sel is None else ((iota < length) & sel)
+
+    kid = jnp.zeros((cap,), jnp.int32)
+    stride = 1
+    strides = []
+    for kname, dom in zip(cmd.keys, cmd.key_domains):
+        d, v = env[kname]
+        code = d.astype(jnp.int32) + 1          # -1 (null string code) → 0
+        if v is not None:
+            code = jnp.where(v, code, 0)        # SQL: one NULL group
+        code = jnp.clip(code, 0, dom)
+        kid = kid + code * stride
+        strides.append(stride)
+        stride *= dom + 1
+    nbuckets = stride
+    nseg = nbuckets + 1                         # +1 garbage bucket
+    seg_safe = jnp.where(active, kid, nbuckets)
+
+    new_env = _agg_over_segments(cmd, env, active, seg_safe, nseg, iota)
+    present = jax.ops.segment_sum(active.astype(jnp.int32), seg_safe, nseg) > 0
+    present = present.at[nbuckets].set(False)
+
+    # rebuild key columns from bucket ids
+    bucket_ids = jnp.arange(nseg, dtype=jnp.int32)
+    for kname, dom, st in zip(cmd.keys, cmd.key_domains, strides):
+        code = (bucket_ids // st) % (dom + 1) - 1
+        d, _v = env[kname]
+        kd = code.astype(jnp.int32).astype(d.dtype)
+        kv = code >= 0
+        dt = schema.dtype(kname)
+        new_env[kname] = (kd, kv if dt.nullable else None)
+
+    # compact non-empty buckets to the front of a SMALL capacity bucket
+    # (compress sorts; doing it over the original cap would cost a full
+    # cap-sized argsort for a handful of groups)
+    out_cap = bucket_capacity(nseg, minimum=128)
+    pad = out_cap - nseg
+    padded = {}
+    for name, (d, v) in new_env.items():
+        dp = jnp.pad(d, (0, pad)) if pad > 0 else d[:out_cap]
+        vp = None
+        if v is not None:
+            vp = jnp.pad(v, (0, pad)) if pad > 0 else v[:out_cap]
+        padded[name] = (dp, vp)
+    present_p = jnp.pad(present, (0, pad)) if pad > 0 else present[:out_cap]
+    out_env, ngroups = compress(padded, jnp.int32(nseg), present_p, out_cap)
+    return out_env, ngroups
+
+
 def _trace_group_by(cmd: ir.GroupBy, env, schema: Schema, sel, length, cap):
     """Sort-based segmented aggregation. Returns (new_env, new_length)."""
+    if cmd.keys and cmd.key_domains and all(d > 0 for d in cmd.key_domains):
+        nb = 1
+        for d in cmd.key_domains:
+            nb *= d + 1
+        if nb + 1 <= min(cap, _SCATTER_MAX_BUCKETS):
+            return _trace_group_by_scatter(cmd, env, schema, sel, length, cap)
     iota = jnp.arange(cap, dtype=jnp.int32)
     row_mask = iota < length
     active = row_mask if sel is None else (row_mask & sel)
@@ -171,43 +281,8 @@ def _trace_group_by(cmd: ir.GroupBy, env, schema: Schema, sel, length, cap):
         dt = schema.dtype(kname)
         new_env[kname] = (kd, kv if dt.nullable else None)
 
-    for a in cmd.aggs:
-        if a.func == "count_all":
-            data = jax.ops.segment_sum(active_s.astype(jnp.uint64), seg_safe, cap)
-            new_env[a.out] = (data, None)
-            continue
-        d, v = env_s[a.arg]
-        m = active_s & v
-        if a.func == "count":
-            data = jax.ops.segment_sum(m.astype(jnp.uint64), seg_safe, cap)
-            new_env[a.out] = (data, None)
-            continue
-        any_valid = jax.ops.segment_max(m.astype(jnp.int32), seg_safe, cap) > 0
-        if a.func == "sum":
-            if np.issubdtype(np.dtype(d.dtype), np.floating):
-                acc = jnp.where(m, d, 0).astype(jnp.float64)
-            elif d.dtype == jnp.uint64:
-                acc = jnp.where(m, d, 0).astype(jnp.uint64)
-            else:
-                acc = jnp.where(m, d, 0).astype(jnp.int64)
-            data = jax.ops.segment_sum(acc, seg_safe, cap)
-            new_env[a.out] = (data, any_valid)
-        elif a.func in ("min", "max"):
-            sent = _sentinel(np.dtype(d.dtype), a.func == "min")
-            masked = jnp.where(m, d, sent)
-            fn = jax.ops.segment_min if a.func == "min" else jax.ops.segment_max
-            data = fn(masked, seg_safe, cap)
-            data = jnp.where(any_valid, data, jnp.zeros((), d.dtype))
-            new_env[a.out] = (data, any_valid)
-        elif a.func == "some":
-            pos = jnp.where(m, iota, cap)
-            firstpos = jax.ops.segment_min(pos, seg_safe, cap)
-            safe = jnp.clip(firstpos, 0, cap - 1)
-            data = d[safe]
-            new_env[a.out] = (data, any_valid)
-        else:
-            raise ValueError(a.func)
-
+    new_env.update(_agg_over_segments(cmd, env_s, active_s, seg_safe, cap,
+                                      iota))
     return new_env, ngroups.astype(jnp.int32)
 
 
@@ -228,6 +303,10 @@ def _trace_program(program: ir.Program, in_schema_cols, cap, env, length, params
             sel = mask if sel is None else (sel & mask)
         elif isinstance(cmd, ir.GroupBy):
             env, length = _trace_group_by(cmd, env, schema, sel, length, cap)
+            # the scatter path shrinks the working capacity to a small
+            # bucket; subsequent commands trace at the new size
+            if env:
+                cap = next(iter(env.values()))[0].shape[0]
             schema = ir.infer_schema(ir.Program([cmd]), schema)
             sel = None
         elif isinstance(cmd, ir.Projection):
@@ -290,7 +369,8 @@ class ProgramCache:
             env, length, sel, schema = _trace_program(
                 program, in_cols, cap, env, length, params)
             if sel is not None:  # statically known: no Filter → already compact
-                env, length = compress(env, length, sel, cap)
+                out_cap = next(iter(env.values()))[0].shape[0] if env else cap
+                env, length = compress(env, length, sel, out_cap)
             out_d = {nm: env[nm][0] for nm in schema.names}
             out_v = {nm: env[nm][1] for nm in schema.names if env[nm][1] is not None}
             return out_d, out_v, length
@@ -334,7 +414,9 @@ def run_on_device(program: ir.Program, dblock: DeviceBlock,
                               dev_params)
     out_schema = ir.infer_schema(program, dblock.schema)
     dicts = {n: d for n, d in dblock.dictionaries.items() if out_schema.has(n)}
-    return DeviceBlock(out_schema, out_d, out_v, length, dblock.capacity, dicts)
+    out_cap = (next(iter(out_d.values())).shape[0] if out_d
+               else dblock.capacity)
+    return DeviceBlock(out_schema, out_d, out_v, length, out_cap, dicts)
 
 
 def run_program(program: ir.Program, block: HostBlock,

@@ -85,7 +85,6 @@ class DistributedAgg:
 
     def _build(self, cap: int, valid_names: tuple, param_names: tuple):
         ndev = self.mesh.devices.size
-        seg = self.seg_rows or cap
         in_cols = list(self.in_schema.columns)
         partial_prog, final_prog = self.partial, self.final
 
@@ -103,25 +102,30 @@ class DistributedAgg:
                 partial_prog, in_cols, cap, env, length[0], params)
             assert sel is None  # partial ends in GroupBy
             names = list(schema.names)
+            # the scatter group-by path shrinks the working capacity
+            pcap = next(iter(env.values()))[0].shape[0] if env else cap
+            seg = min(self.seg_rows or pcap, pcap)
 
             if not key_names or ndev == 1:
                 # global agg: no shuffle, merge via all_gather
                 datas = {n: jax.lax.all_gather(env[n][0], AXIS) for n in names}
                 valid_g = {n: jax.lax.all_gather(
                     env[n][1] if env[n][1] is not None
-                    else jnp.ones((cap,), jnp.bool_), AXIS) for n in names}
+                    else jnp.ones((pcap,), jnp.bool_), AXIS) for n in names}
                 lens = jax.lax.all_gather(glen, AXIS)
-                iota = jnp.arange(cap, dtype=jnp.int32)
+                iota = jnp.arange(pcap, dtype=jnp.int32)
                 seg_mask = (iota[None, :] < lens[:, None]).reshape(-1)
                 env2 = {n: (datas[n].reshape(-1), valid_g[n].reshape(-1))
                         for n in names}
-                env2, tot = compress(env2, jnp.int32(ndev * cap), seg_mask,
-                                     ndev * cap)
+                env2, tot = compress(env2, jnp.int32(ndev * pcap), seg_mask,
+                                     ndev * pcap)
                 fenv, flen, fsel, fschema = _trace_program(
-                    final_prog, list(schema.columns), ndev * cap, env2, tot,
+                    final_prog, list(schema.columns), ndev * pcap, env2, tot,
                     params)
                 if fsel is not None:
-                    fenv, flen = compress(fenv, flen, fsel, ndev * cap)
+                    fcap = next(iter(fenv.values()))[0].shape[0] if fenv \
+                        else ndev * pcap
+                    fenv, flen = compress(fenv, flen, fsel, fcap)
                 # merged result is identical on every device — report once
                 flen = jnp.where(jax.lax.axis_index(AXIS) == 0, flen, 0)
                 out_d = {n: fenv[n][0] for n in fschema.names}
@@ -134,7 +138,7 @@ class DistributedAgg:
 
             # hash shuffle: build ndev segments of seg rows each
             bucket = _bucket_of(env, key_names, ndev)
-            iota = jnp.arange(cap, dtype=jnp.int32)
+            iota = jnp.arange(pcap, dtype=jnp.int32)
             active = iota < glen
             seg_datas = {n: [] for n in names}
             seg_valids = {n: [] for n in names}
@@ -142,7 +146,7 @@ class DistributedAgg:
             overflow = jnp.bool_(False)
             for d_t in range(ndev):
                 mask = active & (bucket == d_t)
-                env_c, cnt = compress(env, glen, mask, cap)
+                env_c, cnt = compress(env, glen, mask, pcap)
                 overflow = overflow | (cnt > seg)
                 counts.append(jnp.minimum(cnt, seg))
                 for n in names:
@@ -171,7 +175,8 @@ class DistributedAgg:
             fenv, flen, fsel, fschema = _trace_program(
                 final_prog, list(schema.columns), flat, env2, tot, params)
             if fsel is not None:
-                fenv, flen = compress(fenv, flen, fsel, flat)
+                fcap = next(iter(fenv.values()))[0].shape[0] if fenv else flat
+                fenv, flen = compress(fenv, flen, fsel, fcap)
             out_d = {n: fenv[n][0] for n in fschema.names}
             out_v = {n: (fenv[n][1] if fenv[n][1] is not None
                          else jnp.ones_like(out_d[n], dtype=jnp.bool_))

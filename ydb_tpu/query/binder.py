@@ -143,6 +143,13 @@ class Scope:
         except BindError:
             return None
 
+    def by_internal(self, internal: str) -> Optional[ColumnBinding]:
+        for cols in self.by_alias.values():
+            for b in cols.values():
+                if b.internal == internal:
+                    return b
+        return None
+
 
 class ParamPool:
     """Array/scalar runtime parameters collected during binding."""
@@ -151,6 +158,9 @@ class ParamPool:
         self.values: dict = {}
         self._n = 0
         self._prefix = prefix
+        # param name -> Dictionary for derived string columns (the LUT maps
+        # source codes to codes of this new dictionary)
+        self.param_dicts: dict = {}
 
     def add(self, value, dtype: dt.DType, is_array: bool = False) -> ir.Param:
         name = f"{self._prefix}{self._n}"
@@ -312,6 +322,16 @@ class ExprBinder:
         if isinstance(e, ast.Name):
             return ir.Col(self.scope.resolve(e.parts).internal)
 
+        if isinstance(e, ast.BoundParam):
+            return ir.Param(e.name, e.dtype)
+
+        # string-VALUED expression (substring/concat of a dict column) used
+        # as a value (group key / output): map source codes to a fresh
+        # dictionary via an int32 LUT. (Names returned above.)
+        sf = _string_fn(e, self.scope)
+        if sf is not None:
+            return self._derived_string(e, sf)
+
         if isinstance(e, ast.BinOp):
             return self._bin(e)
 
@@ -383,6 +403,30 @@ class ExprBinder:
 
     # -- helpers -----------------------------------------------------------
 
+    def _derived_string(self, e: ast.Expr, sf) -> ir.Expr:
+        from ydb_tpu.core.dictionary import Dictionary
+        b, fn = sf
+        # memoized on the AST: repeated bindings (group key vs SELECT item
+        # vs ORDER BY) must yield the IDENTICAL expression, or group-key
+        # matching would fail on the fresh param name
+        cache = self.pool.__dict__.setdefault("_derived_cache", {})
+        ckey = (repr(e), b.internal)
+        hit = cache.get(ckey)
+        if hit is not None:
+            return hit
+        new_dict = Dictionary()
+        src = b.dictionary.values_array()
+        lut = np.full(max(len(src), 1), -1, dtype=np.int32)
+        for i, v in enumerate(src):
+            r = fn(v)
+            if r is not None:
+                lut[i] = new_dict.encode([r])[0]
+        p = self.pool.add(lut, dt.DType(dt.Kind.STRING, False), is_array=True)
+        self.pool.param_dicts[p.name] = new_dict
+        out = ir.call("take_lut", ir.Col(b.internal), p)
+        cache[ckey] = out
+        return out
+
     _BIN_KERNEL = {"+": "add", "-": "sub", "*": "mul", "/": "div", "%": "mod",
                    "=": "eq", "<>": "ne", "<": "lt", "<=": "le", ">": "gt",
                    ">=": "ge", "and": "and", "or": "or"}
@@ -417,15 +461,27 @@ class ExprBinder:
                     return _lut_pred(
                         b, lambda s: s is not None and fn(s) is not None
                         and cmpf(fn(s)), self.pool)
-            # string col = string col (shared dictionary only)
-            if e.op in ("=", "<>"):
-                lb = self._maybe_string_col(e.left)
-                rb = self._maybe_string_col(e.right)
-                if lb is not None and rb is not None:
-                    if lb.dictionary is not rb.dictionary:
+            # string col = string col (shared dictionary only); any other
+            # comparison touching a string-valued side must not fall
+            # through to raw code comparison (codes from different
+            # dictionaries are incomparable)
+            lsf = _string_fn(e.left, self.scope)
+            rsf = _string_fn(e.right, self.scope)
+            if lsf is not None or rsf is not None:
+                if e.op in ("=", "<>") and lsf is not None and rsf is not None:
+                    lb, rb = lsf[0], rsf[0]
+                    if isinstance(e.left, ast.Name) \
+                            and isinstance(e.right, ast.Name) \
+                            and lb.dictionary is rb.dictionary:
+                        pass   # same-dictionary code equality is exact
+                    else:
                         raise BindError(
-                            "string equality across different dictionaries "
-                            "(needs re-encode; not yet supported)")
+                            "string comparison across different "
+                            "dictionaries/expressions is not supported yet")
+                else:
+                    raise BindError(
+                        "unsupported string comparison (fold it against a "
+                        "literal, or compare same-dictionary columns)")
         kern = self._BIN_KERNEL.get(e.op)
         if kern is None:
             raise BindError(f"operator {e.op}")

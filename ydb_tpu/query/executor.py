@@ -1,29 +1,34 @@
 """Physical plan executor (single-node).
 
 The analog of the KQP scan-executer + compute-actor run loop
-(`kqp_scan_executer.cpp`, `dq_compute_actor_impl.h:295`): streams blocks
-from shard scans through the device-compiled pipeline (pushdown program →
-broadcast-join probes → partial aggregation), merges partials, and runs the
-final stage (merge GroupBy, HAVING, output expressions, sort, limit).
-
-Every block-level compute step runs on the device via the jit pattern cache
-(`ops/xla_exec.py`); the host only routes blocks and (for now) concatenates
-partials — the role the DQ channels play in the reference.
+(`kqp_scan_executer.cpp`, `dq_compute_actor_impl.h:295`): streams per-portion
+device blocks (HBM column cache) through the device-compiled pipeline
+(pushdown program → broadcast-join probes → partial aggregation), then runs
+ONE fused device program for the whole final stage — device-side concat of
+the partials, merge GroupBy, HAVING, output expressions, sort and limit —
+so a query costs K partial dispatches + 1 finalize dispatch + 1 transfer,
+not a host round-trip per stage (the dispatch economy matters doubly on a
+tunneled TPU).
 """
 
 from __future__ import annotations
 
+from functools import partial as _partial
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ydb_tpu.core.block import ColumnData, HostBlock
 from ydb_tpu.core.schema import Column, Schema
 from ydb_tpu.ops import ir
 from ydb_tpu.ops import join as J
-from ydb_tpu.ops.device import DeviceBlock, to_device, to_host
-from ydb_tpu.ops.sort import sort_block
-from ydb_tpu.ops.xla_exec import compress_block, run_on_device
+from ydb_tpu.ops.device import DeviceBlock, bucket_capacity, to_device, to_host
+from ydb_tpu.ops.sort import sort_env
+from ydb_tpu.ops.xla_exec import (
+    _trace_program, compress, compress_block, run_on_device,
+)
 from ydb_tpu.query.plan import JoinStep, Pipeline, QueryPlan, SortKey
 from ydb_tpu.storage.mvcc import MAX_SNAPSHOT, Snapshot
 
@@ -31,48 +36,52 @@ DEFAULT_BLOCK_ROWS = 1 << 20
 
 
 class Executor:
-    def __init__(self, catalog, block_rows: int = DEFAULT_BLOCK_ROWS):
+    def __init__(self, catalog, block_rows: int = DEFAULT_BLOCK_ROWS,
+                 device_cache=None):
+        from ydb_tpu.storage.device_cache import DeviceColumnCache
         self.catalog = catalog
         self.block_rows = block_rows
+        self.device_cache = device_cache or DeviceColumnCache()
+        self._finalize_cache: dict = {}
 
     # -- entry -------------------------------------------------------------
 
     def execute(self, plan: QueryPlan,
                 snapshot: Snapshot = MAX_SNAPSHOT) -> HostBlock:
-        partials = self._run_pipeline(plan.pipeline, plan.params, snapshot)
-        merged = HostBlock.concat(partials)
+        params = dict(plan.params)
+        # precompute stage: uncorrelated scalar subqueries → params
+        for (pname, subplan) in plan.init_subplans:
+            sub = self.execute(subplan, snapshot)
+            if sub.length > 1:
+                raise RuntimeError("scalar subquery produced more than one row")
+            if sub.length == 0 or (
+                    sub.columns[sub.schema.names[0]].valid is not None
+                    and not sub.columns[sub.schema.names[0]].valid[0]):
+                params[pname] = np.nan   # NULL scalar: comparisons are false
+            else:
+                params[pname] = sub.columns[sub.schema.names[0]].data[0]
 
-        if plan.final_program is not None:
-            merged = to_host(run_on_device(plan.final_program,
-                                           to_device(merged), plan.params))
-
-        if plan.sort:
-            merged = self._sort(merged, plan.sort, plan.limit, plan.offset)
-        elif plan.limit is not None or plan.offset:
-            lo = plan.offset or 0
-            hi = lo + plan.limit if plan.limit is not None else merged.length
-            merged = merged.slice(lo, min(hi, merged.length))
-
+        partials = self._run_pipeline(plan.pipeline, params, snapshot)
+        merged = self._finalize(plan, partials, params)
         return self._project_output(merged, plan.output)
 
     # -- pipelines ---------------------------------------------------------
 
     def _run_pipeline(self, pipe: Pipeline, params: dict,
                       snapshot: Snapshot) -> list:
-        """Partial-result HostBlocks for a pipeline (≥1 block: an empty scan
-        still runs the programs once so global aggregates emit their row)."""
+        """Partial-result DeviceBlocks (≥1: an empty scan still runs the
+        programs once so global aggregates emit their row)."""
         builds = [self._prepare_join(step, params, snapshot)
                   for kind, step in pipe.steps if kind == "join"]
-        out = [self._run_block(pipe, block, builds, params)
-               for block in self._scan_blocks(pipe, snapshot)]
+        out = [self._run_block(pipe, d, builds, params)
+               for d in self._scan_device_blocks(pipe, snapshot)]
         if not out:
-            out = [self._run_block(pipe, self._empty_scan_block(pipe),
+            out = [self._run_block(pipe, to_device(self._empty_scan_block(pipe)),
                                    builds, params)]
         return out
 
-    def _run_block(self, pipe: Pipeline, block: HostBlock, builds: list,
-                   params: dict) -> HostBlock:
-        d = to_device(block)
+    def _run_block(self, pipe: Pipeline, d: DeviceBlock, builds: list,
+                   params: dict) -> DeviceBlock:
         if pipe.pre_program is not None:
             d = run_on_device(pipe.pre_program, d, params)
         bi = 0
@@ -80,31 +89,50 @@ class Executor:
             if kind == "join":
                 table = builds[bi]
                 bi += 1
-                rename = {}
                 d, sel = J.probe(d, table, step.probe_key, step.kind,
-                                 sel=None, rename=rename)
-                d = compress_block(d, sel)
+                                 sel=None, mark_col=step.mark_col or None)
+                if step.kind != "mark":
+                    d = compress_block(d, sel)
             else:
                 d = run_on_device(step, d, params)
         if pipe.partial is not None:
             d = run_on_device(pipe.partial, d, params)
-        return to_host(d)
+        return d
 
     def _prepare_join(self, step: JoinStep, params: dict,
                       snapshot: Snapshot) -> J.BuildTable:
-        built = HostBlock.concat(self._run_pipeline(step.build, params,
-                                                    snapshot))
+        if isinstance(step.build, QueryPlan):
+            built = self.execute(step.build, snapshot)
+        else:
+            built = HostBlock.concat(
+                [to_host(d) for d in
+                 self._run_pipeline(step.build, params, snapshot)])
+        if step.build_hash_keys:
+            built = _add_hash_column(built, step.build_hash_keys,
+                                     step.build_key)
+        if step.anti_null_check:
+            cd = built.columns[step.build_key]
+            if cd.valid is not None and not cd.valid.all():
+                raise NotImplementedError(
+                    "NOT IN over a subquery producing NULLs (SQL: always "
+                    "empty) is not supported yet")
         return J.build(built, step.build_key, list(step.payload))
 
-    def _scan_blocks(self, pipe: Pipeline, snapshot: Snapshot):
+    def _scan_device_blocks(self, pipe: Pipeline, snapshot: Snapshot):
+        """Per-portion device blocks via the HBM column cache; committed but
+        unindexed inserts upload uncached (they are transient — indexation
+        turns them into portions)."""
         table = self.catalog.table(pipe.scan.table)
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
         for shard in table.shards:
-            for block in shard.scan(storage_names, snapshot,
-                                    prune_predicates=pipe.scan.prune or None,
-                                    block_rows=self.block_rows):
-                yield _rename_block(block, rename)
+            portions, insert_blocks = shard.scan_sources(
+                snapshot, pipe.scan.prune or None)
+            for p in portions:
+                yield self.device_cache.device_block(p, storage_names, rename)
+            for blk in insert_blocks:
+                yield to_device(_rename_block(blk.select(storage_names),
+                                              rename))
 
     def _empty_scan_block(self, pipe: Pipeline) -> HostBlock:
         """Zero-row block with the scan's schema and dictionaries."""
@@ -118,48 +146,153 @@ class Executor:
             schema_cols.append(Column(internal, c.dtype))
         return HostBlock(Schema(schema_cols), cols, 0)
 
-    # -- final sort / output ----------------------------------------------
+    # -- fused finalize ----------------------------------------------------
 
-    def _sort(self, block: HostBlock, sort_keys: list,
-              limit: Optional[int], offset: Optional[int]) -> HostBlock:
-        if block.length == 0:
-            return block
-        prog = ir.Program()
-        keys = []
-        drop = []
-        pool_params = {}
-        for j, sk in enumerate(sort_keys):
-            dtype = block.schema.dtype(sk.name)
-            cd = block.columns[sk.name]
-            if dtype.is_string and cd.dictionary is not None:
-                # order by lexicographic rank, not dictionary code
-                vals = cd.dictionary.values_array()
+    def _finalize(self, plan: QueryPlan, dblocks: list,
+                  params: dict) -> HostBlock:
+        """Concat partials + final program + sort + limit in ONE device
+        call, then one batched transfer."""
+        in_schema = dblocks[0].schema
+        sort_params, sort_spec, rank_assigns = self._sort_setup(
+            plan, in_schema, dblocks)
+        all_params = {**params, **sort_params}
+
+        blocks_sig = tuple(
+            (tuple(sorted(d.arrays)), tuple(sorted(d.valids)), d.capacity)
+            for d in dblocks)
+        key = (plan.final_program.fingerprint() if plan.final_program else "",
+               ir.Program(rank_assigns).fingerprint() if rank_assigns else "",
+               sort_spec, plan.limit, plan.offset, blocks_sig,
+               tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
+                     for c in in_schema.columns),
+               tuple(sorted(all_params)),
+               tuple(n for (n, _lbl) in plan.output))
+        entry = self._finalize_cache.get(key)
+        if entry is None:
+            entry = self._build_finalize(plan, in_schema, blocks_sig,
+                                         sort_spec, rank_assigns)
+            self._finalize_cache[key] = entry
+        fn, out_schema = entry
+
+        blocks_in = tuple((d.arrays, d.valids, d.length) for d in dblocks)
+        dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
+                      for k, v in all_params.items()}
+        out_d, out_v, length = fn(blocks_in, dev_params)
+
+        dicts = {}
+        for d in dblocks:
+            dicts.update(d.dictionaries)
+        dicts.update(plan.result_dicts)
+        dicts = {n: dc for n, dc in dicts.items() if out_schema.has(n)}
+        out_cap = (next(iter(out_d.values())).shape[0] if out_d else 0)
+        dblock = DeviceBlock(out_schema, out_d, out_v, length, out_cap, dicts)
+        block = to_host(dblock)
+        lo = plan.offset or 0
+        if lo:
+            hi = lo + plan.limit if plan.limit is not None else block.length
+            block = block.slice(lo, min(hi, block.length))
+        return block
+
+    def _sort_setup(self, plan: QueryPlan, in_schema: Schema, dblocks: list):
+        """Rank-LUT params for string sort keys (lexicographic order over
+        dictionary codes) + the static sort spec."""
+        from ydb_tpu.core import dtypes as dt
+        sort_params = {}
+        rank_assigns = []
+        spec = []
+        schema = in_schema
+        if plan.final_program is not None:
+            schema = ir.infer_schema(plan.final_program, in_schema)
+        dicts = {}
+        for d in dblocks:
+            dicts.update(d.dictionaries)
+        dicts.update(plan.result_dicts)
+        for j, sk in enumerate(plan.sort):
+            dtype = schema.dtype(sk.name)
+            dic = dicts.get(sk.name)
+            if dtype.is_string and dic is not None:
+                vals = dic.values_array()
                 ranks = np.argsort(np.argsort(vals)).astype(np.int32) \
-                    if len(vals) else np.zeros(0, np.int32)
+                    if len(vals) else np.zeros(1, np.int32)
                 pname = f"__rank{j}"
-                pool_params[pname] = ranks
+                sort_params[pname] = ranks
                 rank_col = f"__sortrank{j}"
-                from ydb_tpu.core import dtypes as dt
-                prog.assign(rank_col, ir.call(
+                rank_assigns.append(ir.Assign(rank_col, ir.call(
                     "take_lut", ir.Col(sk.name),
                     ir.Param(pname, dt.DType(dt.Kind.INT32, False),
-                             is_array=True)))
-                keys.append((rank_col, sk.ascending, sk.nulls_first))
-                drop.append(rank_col)
+                             is_array=True))))
+                spec.append((rank_col, sk.ascending, sk.nulls_first))
             else:
-                keys.append((sk.name, sk.ascending, sk.nulls_first))
-        d = to_device(block)
-        if prog.commands:
-            d = run_on_device(prog, d, pool_params)
-        d = sort_block(d, keys, limit=(None if offset else limit))
-        out = to_host(d)
-        if drop:
-            out = out.select([n for n in out.schema.names if n not in drop])
-        lo = offset or 0
-        if lo or limit is not None:
-            hi = lo + limit if limit is not None else out.length
-            out = out.slice(lo, min(hi, out.length))
-        return out
+                spec.append((sk.name, sk.ascending, sk.nulls_first))
+        return sort_params, tuple(spec), rank_assigns
+
+    def _build_finalize(self, plan: QueryPlan, in_schema: Schema,
+                        blocks_sig: tuple, sort_spec: tuple,
+                        rank_assigns: list):
+        final_prog = plan.final_program
+        in_cols = list(in_schema.columns)
+        names = [c.name for c in in_cols]
+        out_schema = ir.infer_schema(final_prog, in_schema) \
+            if final_prog is not None else in_schema
+        limit = plan.limit
+        lim2 = None if limit is None else limit + (plan.offset or 0)
+        keep = [n for (n, _lbl) in plan.output]
+        keep = list(dict.fromkeys(keep))
+
+        @jax.jit
+        def fn(blocks, params):
+            datas, valid_arrays, masks = {n: [] for n in names}, \
+                {n: [] for n in names}, []
+            total = 0
+            for (arrays, valids, length), (_an, _vn, cap) in zip(blocks,
+                                                                 blocks_sig):
+                iota = jnp.arange(cap, dtype=jnp.int32)
+                masks.append(iota < length)
+                total += cap
+                for n in names:
+                    datas[n].append(arrays[n])
+                    v = valids.get(n)
+                    valid_arrays[n].append(
+                        v if v is not None else jnp.ones((cap,), jnp.bool_))
+            env = {n: (jnp.concatenate(datas[n]),
+                       jnp.concatenate(valid_arrays[n])) for n in names}
+            mask = jnp.concatenate(masks)
+            env, length = compress(env, jnp.int32(total), mask, total)
+            cap = total
+            if final_prog is not None:
+                env, length, sel, _schema = _trace_program(
+                    final_prog, in_cols, cap, env, length, params)
+                if env:
+                    cap = next(iter(env.values()))[0].shape[0]
+                if sel is not None:
+                    env, length = compress(env, length, sel, cap)
+            for a in rank_assigns:
+                from ydb_tpu.ops.xla_exec import _eval
+                env[a.name] = _eval(a.expr, env, params, cap)
+            if sort_spec:
+                arrays = {n: d for n, (d, _v) in env.items()}
+                valids = {n: v for n, (d, v) in env.items() if v is not None}
+                arrays2, valids2, length = sort_env(
+                    arrays, valids, length, None, sort_spec,
+                    tuple(arrays.keys()))
+                env = {n: (arrays2[n], valids2.get(n)) for n in arrays2}
+            if lim2 is not None:
+                length = jnp.minimum(length, jnp.int32(lim2))
+                out_cap = min(bucket_capacity(lim2, minimum=128), cap)
+                env = {n: (d[:out_cap], v[:out_cap] if v is not None else None)
+                       for n, (d, v) in env.items()}
+            out_names = [n for n in keep if n in env] or list(env.keys())
+            out_d = {n: env[n][0] for n in out_names}
+            out_v = {n: env[n][1] for n in out_names
+                     if env[n][1] is not None}
+            return out_d, out_v, length
+
+        out_cols = [c for c in out_schema.columns if c.name in keep] \
+            or list(out_schema.columns)
+        return fn, Schema(out_cols)
+
+
+    # -- output ------------------------------------------------------------
 
     def _project_output(self, block: HostBlock, output: list) -> HostBlock:
         cols = {}
@@ -176,6 +309,28 @@ class Executor:
             cols[lbl] = ColumnData(cd.data, cd.valid, cd.dictionary)
             schema_cols.append(Column(lbl, block.schema.dtype(internal)))
         return HostBlock(Schema(schema_cols), cols, block.length)
+
+
+def _add_hash_column(block: HostBlock, key_cols: list, out: str) -> HostBlock:
+    """Host-side mirror of the device hash-key expression
+    (`hash_combine(hash64(c0), hash64(c1), ...)`) — bit-identical by
+    construction (`ydb_tpu/utils/hashing.py`)."""
+    from ydb_tpu.core.dtypes import DType, Kind
+    from ydb_tpu.utils.hashing import hash_combine, splitmix64
+
+    h = None
+    valid = None
+    for name in key_cols:
+        cd = block.columns[name]
+        x = splitmix64(np, cd.data.astype(np.int64))
+        h = x if h is None else hash_combine(np, h, x)
+        if cd.valid is not None:
+            valid = cd.valid if valid is None else (valid & cd.valid)
+    cols = dict(block.columns)
+    cols[out] = ColumnData(h, valid, None)
+    schema = block.schema.extend([Column(out, DType(Kind.UINT64,
+                                                    valid is not None))])
+    return HostBlock(schema, cols, block.length)
 
 
 def _rename_block(block: HostBlock, rename: dict) -> HostBlock:

@@ -26,11 +26,16 @@ class ScanSpec:
 
 @dataclass
 class JoinStep:
-    build: "Pipeline"                # materialized build side
+    build: object                    # Pipeline | QueryPlan (subquery build)
     build_key: str                   # internal name in build output
     probe_key: str                   # internal name in probe pipeline
-    kind: str                        # inner | left | left_semi | left_anti
+    kind: str                        # inner | left | left_semi | left_anti | mark
     payload: list = field(default_factory=list)  # build columns to attach
+    mark_col: str = ""               # for kind=mark: bool match-flag column
+    anti_null_check: bool = False    # NOT IN: reject NULLs in the build key
+    # composite keys: executor hashes these build columns host-side into
+    # `build_key` before building (probe side hashes in its program)
+    build_hash_keys: list = field(default_factory=list)
 
 
 @dataclass
@@ -59,6 +64,13 @@ class QueryPlan:
     offset: Optional[int] = None
     output: list = field(default_factory=list)    # [(internal_name, label)]
     params: dict = field(default_factory=dict)    # param name -> value
+    # uncorrelated scalar subqueries: executed first, their single value
+    # becomes a runtime param (the KQP precompute-stage analog,
+    # `KqpPhysicalTx` TxResultBinding)
+    init_subplans: list = field(default_factory=list)  # [(param, QueryPlan)]
+    # dictionaries for derived string columns (substring/concat results):
+    # internal column name -> Dictionary
+    result_dicts: dict = field(default_factory=dict)
 
 
 def explain(plan: QueryPlan, indent: int = 0) -> str:
@@ -76,7 +88,11 @@ def explain(plan: QueryPlan, indent: int = 0) -> str:
             if kind == "join":
                 lines.append(f"{pp}  {step.kind.upper()} JOIN probe={step.probe_key} "
                              f"build={step.build_key} payload={step.payload}")
-                pipe(step.build, d + 2)
+                if isinstance(step.build, QueryPlan):
+                    lines.append(f"{pp}    subplan:")
+                    lines.append(explain(step.build, d + 3))
+                else:
+                    pipe(step.build, d + 2)
             else:
                 lines.append(f"{pp}  program: {_prog(step)}")
         if p.partial:

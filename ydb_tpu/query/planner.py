@@ -148,6 +148,15 @@ def walk_aggs(e, out: list):
         walk_aggs(e.hi, out)
 
 
+def _hash_key_expr(cols: list) -> ir.Expr:
+    """Combined 64-bit hash over key columns (both join sides use this same
+    expression, mirroring `ydb/core/formats/arrow/hash/calcer.cpp`)."""
+    parts = [ir.call("hash64", ir.Col(c)) for c in cols]
+    if len(parts) == 1:
+        return parts[0]
+    return ir.call("hash_combine", *parts)
+
+
 @dataclass
 class _Rel:
     alias: str
@@ -165,6 +174,7 @@ class Planner:
         if sel.relation is None:
             raise PlanError("SELECT without FROM is not supported yet")
         pool = B.ParamPool()
+        self._jk_counter = 0
 
         rels, join_conds, left_joins = self._flatten_relations(sel.relation)
         if left_joins:
@@ -185,6 +195,24 @@ class Planner:
         preds = []
         for p in conjuncts(sel.where) + join_conds:
             preds.extend(hoist_or_common(p))
+
+        # subquery extraction: IN/EXISTS → semi/anti join specs; scalar
+        # subqueries → precompute params (uncorrelated) or decorrelated
+        # aggregate joins (the KqpRewrite*-style flattening the reference
+        # does in logical opt, `dq_opt_join.cpp` / kqp_opt_log)
+        self._sub_specs: list = []
+        self._init_subplans: list = []
+        self._post_preds: list = []
+        kept = []
+        for p in preds:
+            q = self._extract_subqueries(p, rels)
+            if q is not None:
+                kept.append(q)
+        preds = kept
+        if sel.having is not None:
+            sel = ast.Select(**{**sel.__dict__})
+            sel.having = self._rewrite_scalar_subqueries(
+                sel.having, rels, allow_correlated=False)
         edges: list = []           # (alias_a, col_a, alias_b, col_b)
         residuals: list = []
         for p in preds:
@@ -221,11 +249,27 @@ class Planner:
             self._demand(sel.having, needed)
         for p in residuals:
             self._demand(p, needed)
+        for spec in self._sub_specs:
+            for (oexpr, _lbl) in spec["keys"]:
+                self._demand(oexpr, needed)
+        for p in self._post_preds:
+            self._demand(p, needed)
 
         # fact table and join spanning tree (PK edges preferred: MapJoin
-        # needs unique build keys; leftover edges become residual filters)
-        fact = max(rels.values(), key=lambda r: r.table.num_rows).alias
-        children, in_tree, leftovers = self._spanning_tree(fact, rels, edges)
+        # needs unique build keys; leftover edges become residual filters).
+        # Try every candidate fact and keep the tree with the fewest
+        # non-PK build sides, largest-table tie-break (a micro-CBO; the
+        # DPhyp-style join-order search of `dq_opt_join_cost_based.cpp`
+        # replaces this later).
+        best = None
+        for cand in rels:
+            children_c, in_tree_c, leftovers_c, bad = self._spanning_tree(
+                cand, rels, edges)
+            unreachable = set(rels) - in_tree_c
+            rank = (len(unreachable), bad, -rels[cand].table.num_rows)
+            if best is None or rank < best[0]:
+                best = (rank, cand, children_c, in_tree_c, leftovers_c)
+        (rank, fact, children, in_tree, leftovers) = best
         unreachable = set(rels) - in_tree
         if unreachable:
             raise PlanError(f"no join path to {sorted(unreachable)} "
@@ -245,7 +289,11 @@ class Planner:
                 prog.filter(binder.bind(p))
             pipeline.steps.append(("program", prog))
 
-        plan = QueryPlan(pipeline=pipeline, params=pool.values)
+        # semi/anti/scalar subquery joins + their filters
+        self._attach_sub_specs(pipeline, binder)
+
+        plan = QueryPlan(pipeline=pipeline, params=pool.values,
+                         init_subplans=list(self._init_subplans))
         self._plan_projection_agg(sel, plan, binder)
         return plan
 
@@ -310,38 +358,54 @@ class Planner:
     # -- join tree ---------------------------------------------------------
 
     def _spanning_tree(self, fact: str, rels, edges):
-        """Prim-style tree from the fact outward; prefer edges whose child
-        column is the child table's (single-column) primary key so the
-        broadcast-join build side has unique keys."""
+        """Prim-style tree from the fact outward over alias-pair edge
+        GROUPS (all equi-conditions between a pair join together — composite
+        keys). Prefer groups whose child columns cover the child table's
+        primary key, so the broadcast-join build side has unique keys."""
+        groups: dict[tuple, list] = {}
+        for (la, lname, ra, rname) in edges:
+            key = (la, ra) if la <= ra else (ra, la)
+            pair = (lname, rname) if la <= ra else (rname, lname)
+            groups.setdefault(key, []).append(pair)
+        group_list = list(groups.items())
+
         in_tree = {fact}
         children: dict[str, list] = {a: [] for a in rels}
-        used = [False] * len(edges)
+        used = [False] * len(group_list)
+        bad = 0   # attachments whose build side is not PK-unique
         while True:
             best = None
-            for i, (la, lname, ra, rname) in enumerate(edges):
+            for i, ((a1, a2), pairs) in enumerate(group_list):
                 if used[i]:
                     continue
-                for (pa, pname, ca, cname) in ((la, lname, ra, rname),
-                                               (ra, rname, la, lname)):
+                for (pa, ca, flip) in ((a1, a2, False), (a2, a1, True)):
                     if pa in in_tree and ca not in in_tree:
-                        col = self.scope.resolve(cname.parts).internal \
-                            .split(".", 1)[1]
-                        pk = rels[ca].table.key_columns
-                        score = 2 if (len(pk) == 1 and pk[0] == col) \
-                            else (1 if col in pk else 0)
-                        cand = (score, -rels[ca].table.num_rows,
-                                -i, pa, pname, ca, cname)
+                        child_cols = {
+                            self.scope.resolve((p[1] if not flip else p[0]).parts)
+                            .internal.split(".", 1)[1] for p in pairs}
+                        pk = set(rels[ca].table.key_columns)
+                        score = 2 if pk <= child_cols \
+                            else (1 if child_cols & pk else 0)
+                        cand = (score, -rels[ca].table.num_rows, -i,
+                                pa, ca, flip)
                         if best is None or cand[:3] > best[:3]:
                             best = cand
             if best is None:
                 break
-            _s, _r, neg_i, pa, pname, ca, cname = best
+            _s, _r, neg_i, pa, ca, flip = best
+            if _s < 2:
+                bad += 1
             used[-neg_i] = True
             in_tree.add(ca)
-            children[pa].append((ca, pname, cname))
-        # drop used edges; also edges between two in-tree tables stay residual
-        leftovers = [e for i, e in enumerate(edges) if not used[i]]
-        return children, in_tree, leftovers
+            pairs = group_list[-neg_i][1]
+            oriented = [(cn, pn) if flip else (pn, cn) for (pn, cn) in pairs]
+            children[pa].append((ca, oriented))   # [(parent_name, child_name)]
+        leftovers = []
+        for i, ((a1, a2), pairs) in enumerate(group_list):
+            if not used[i]:
+                for (lname, rname) in pairs:
+                    leftovers.append((a1, lname, a2, rname))
+        return children, in_tree, leftovers, bad
 
     def _build_pipeline(self, alias: str, rels, children, needed,
                         binder, top: bool) -> Pipeline:
@@ -354,23 +418,49 @@ class Planner:
             self._demand(p, scan_cols)
 
         # recurse into children first (they register join-key demand)
-        join_steps = []
-        for (child, my_name, child_name) in children[alias]:
-            probe_b = self.scope.resolve(my_name.parts)
-            build_b = self.scope.resolve(child_name.parts)
-            scan_cols.add(probe_b.internal)
+        join_steps = []       # [(JoinStep, post_program | None)]
+        for (child, pairs) in children[alias]:
+            probe_bs = [self.scope.resolve(pn.parts) for (pn, _cn) in pairs]
+            build_bs = [self.scope.resolve(cn.parts) for (_pn, cn) in pairs]
+            for b in probe_bs:
+                scan_cols.add(b.internal)
             child_needed = set(needed)
-            child_needed.add(build_b.internal)
+            for b in build_bs:
+                child_needed.add(b.internal)
             sub = self._build_pipeline(child, rels, children,
                                        child_needed, binder, top=False)
-            # keep the build key in the payload when referenced above
-            # (e.g. it is a group key)
-            payload = [c for c in sub.out_names
-                       if c in needed
-                       and (c != build_b.internal or build_b.internal in needed)]
-            kind = "inner" if payload else "left_semi"
-            join_steps.append(JoinStep(sub, build_b.internal,
-                                       probe_b.internal, kind, payload))
+            if len(pairs) == 1:
+                build_key, probe_key = build_bs[0].internal, probe_bs[0].internal
+                # keep the build key in the payload when referenced above
+                payload = [c for c in sub.out_names
+                           if c in needed
+                           and (c != build_key or build_key in needed)]
+                kind = "inner" if payload else "left_semi"
+                join_steps.append((JoinStep(sub, build_key, probe_key,
+                                            kind, payload), None))
+            else:
+                # composite key: join on a combined 64-bit hash of the key
+                # columns on both sides, then verify each equality post-join
+                # (collision guard) — the packed-key analog of GraceJoin's
+                # multi-column keys (`mkql_grace_join.cpp`)
+                jk = f"__jk{self._jk_counter}"
+                self._jk_counter += 1
+                pre.assign(jk, _hash_key_expr([b.internal for b in probe_bs]))
+                bjk = f"{jk}b"
+                sub_partial = ir.Program()
+                sub_partial.assign(bjk, _hash_key_expr(
+                    [b.internal for b in build_bs]))
+                sub_partial.project(sub.out_names + [bjk])
+                sub.partial = sub_partial
+                payload = list(dict.fromkeys(
+                    [c for c in sub.out_names if c in needed]
+                    + [b.internal for b in build_bs]))
+                verify = ir.Program()
+                for pb, bb in zip(probe_bs, build_bs):
+                    verify.filter(ir.call("eq", ir.Col(pb.internal),
+                                          ir.Col(bb.internal)))
+                join_steps.append((JoinStep(sub, bjk, jk, "inner", payload),
+                                   verify))
 
         # own columns demanded from above
         own_cols = {n for n in needed
@@ -387,11 +477,15 @@ class Planner:
         self._extract_prune(pre, scan, r.table)
 
         out_names = sorted(own_cols)
-        for js in join_steps:
-            out_names.extend(js.payload)
+        steps = []
+        for (js, verify) in join_steps:
+            out_names.extend(c for c in js.payload if c not in out_names)
+            steps.append(("join", js))
+            if verify is not None:
+                steps.append(("program", verify))
         pipe = Pipeline(scan=scan,
                         pre_program=pre if pre.commands else None,
-                        steps=[("join", js) for js in join_steps],
+                        steps=steps,
                         out_names=out_names)
         if not top:
             # build fragments materialize: project to outputs
@@ -410,6 +504,281 @@ class Planner:
             if dtype.is_string and op != "eq":
                 continue   # dictionary codes are unordered
             scan.prune.append((storage, op, val))
+
+    # -- subqueries --------------------------------------------------------
+
+    def _inner_scope(self, inner_sel: ast.Select):
+        """Scope + relation map for a subquery's own tables."""
+        inner_rels, _conds, _lj = self._flatten_relations(inner_sel.relation)
+        scope = B.Scope()
+        for r in inner_rels.values():
+            for col in r.table.schema:
+                scope.add(r.alias, col.name, B.ColumnBinding(
+                    f"{r.alias}.{col.name}", col.dtype,
+                    r.table.dictionaries.get(col.name)))
+        return scope
+
+    def _split_correlations(self, inner_sel: ast.Select):
+        """Pull `inner_col = outer_col` conjuncts out of the subquery's
+        WHERE (the equality-decorrelation the reference performs in logical
+        optimization). Returns (inner select w/o them, [(inner_name_ast,
+        outer_name_ast)])."""
+        inner_scope = self._inner_scope(inner_sel)
+        rest, pairs = [], []
+        for c in conjuncts(inner_sel.where):
+            names: set = set()
+            walk_names(c, names)
+            outer = [p for p in names if inner_scope.try_resolve(p) is None]
+            if not outer:
+                rest.append(c)
+                continue
+            ok = (isinstance(c, ast.BinOp) and c.op == "="
+                  and isinstance(c.left, ast.Name)
+                  and isinstance(c.right, ast.Name))
+            if not ok:
+                raise PlanError(
+                    f"unsupported correlated predicate {c!r} (only "
+                    "inner_col = outer_col correlation is decorrelated)")
+            if inner_scope.try_resolve(c.left.parts) is not None:
+                pairs.append((c.left, c.right))
+            elif inner_scope.try_resolve(c.right.parts) is not None:
+                pairs.append((c.right, c.left))
+            else:
+                raise PlanError(f"correlated predicate {c!r} references no "
+                                "subquery column")
+        new_sel = ast.Select(**{**inner_sel.__dict__})
+        new_sel.where = _and_fold(rest)
+        return new_sel, pairs
+
+    def _expr_dtype(self, e: ast.Expr, scope: B.Scope):
+        """Static result dtype of a (possibly aggregate) expression."""
+        from ydb_tpu.core import dtypes as dt
+        from ydb_tpu.ops.ir import agg_result_dtype
+        if isinstance(e, ast.FuncCall) and e.name in B.AGG_NAMES:
+            if e.star or not e.args:
+                return dt.DType(dt.Kind.UINT64, False)
+            if e.name == "avg":
+                return dt.DType(dt.Kind.FLOAT64, True)
+            arg = self._expr_dtype(e.args[0], scope)
+            return agg_result_dtype("sum" if e.name == "sum" else "some",
+                                    arg).with_nullable(True)
+        if isinstance(e, ast.BinOp):
+            if e.op in ("and", "or", "=", "<>", "<", "<=", ">", ">="):
+                return dt.DType(dt.Kind.BOOL, True)
+            lt = self._expr_dtype(e.left, scope)
+            rt = self._expr_dtype(e.right, scope)
+            if e.op == "/":
+                return dt.DType(dt.Kind.FLOAT64, lt.nullable or rt.nullable)
+            return dt.common_numeric(lt, rt)
+        if isinstance(e, ast.UnaryOp):
+            return self._expr_dtype(e.arg, scope)
+        if isinstance(e, ast.Name):
+            return scope.resolve(e.parts).dtype
+        f = B._try_fold(e)
+        if f is not None:
+            return f.dtype
+        raise PlanError(f"cannot type subquery expression {e!r}")
+
+    def _plan_inner(self, inner_sel: ast.Select) -> "QueryPlan":
+        return Planner(self.catalog).plan_select(inner_sel)
+
+    def _extract_subqueries(self, p: ast.Expr, rels):
+        """Consume IN/EXISTS predicates into semi/anti-join specs; rewrite
+        scalar subqueries. Returns the remaining predicate (or None if the
+        conjunct was fully consumed)."""
+        if isinstance(p, ast.UnaryOp) and p.op == "not":
+            a = p.arg
+            if isinstance(a, ast.Exists):
+                p = ast.Exists(a.query, not a.negated)
+            elif isinstance(a, ast.InSubquery):
+                p = ast.InSubquery(a.arg, a.query, not a.negated)
+        if isinstance(p, ast.InSubquery):
+            self._add_semi_spec([p.arg], p.query, p.negated,
+                                first_item_key=True)
+            return None
+        if isinstance(p, ast.Exists):
+            self._add_semi_spec([], p.query, p.negated, first_item_key=False)
+            return None
+        rewritten, correlated = self._rewrite_scalars(p)
+        if rewritten is None:
+            return p
+        if correlated:
+            self._post_preds.append(rewritten)
+            return None
+        return rewritten
+
+    def _rewrite_scalar_subqueries(self, p, rels, allow_correlated):
+        rewritten, correlated = self._rewrite_scalars(
+            p, allow_correlated=allow_correlated)
+        return p if rewritten is None else rewritten
+
+    def _rewrite_scalars(self, p, allow_correlated=True):
+        """Replace every ScalarSubquery in `p`: uncorrelated → BoundParam
+        (precomputed), correlated → reference to a decorrelated aggregate
+        join column. Returns (rewritten or None-if-unchanged, any_correlated)."""
+        state = {"changed": False, "correlated": False}
+
+        def walk(e):
+            if isinstance(e, ast.ScalarSubquery):
+                state["changed"] = True
+                inner, pairs = self._split_correlations(e.query)
+                if len(inner.items) != 1:
+                    raise PlanError("scalar subquery must select one column")
+                inner_scope = self._inner_scope(inner)
+                dtype = self._expr_dtype(inner.items[0].expr, inner_scope) \
+                    .with_nullable(True)
+                n = len(self._sub_specs) + len(self._init_subplans)
+                if not pairs:
+                    pname = f"__sp{n}"
+                    self._init_subplans.append(
+                        (pname, self._plan_inner(inner)))
+                    return ast.BoundParam(pname, dtype)
+                if not allow_correlated:
+                    raise PlanError("correlated scalar subquery not "
+                                    "supported in this clause")
+                state["correlated"] = True
+                agg_label = f"__s{n}agg"
+                items = [ast.SelectItem(inner.items[0].expr, agg_label)]
+                key_labels = []
+                for i, (iname, _oname) in enumerate(pairs):
+                    lbl = f"__s{n}k{i}"
+                    items.append(ast.SelectItem(iname, lbl))
+                    key_labels.append(lbl)
+                sub_sel = ast.Select(
+                    items=items, relation=inner.relation, where=inner.where,
+                    group_by=[iname for (iname, _o) in pairs])
+                spec = {
+                    "kind": "scalar", "n": n,
+                    "plan": self._plan_inner(sub_sel),
+                    "keys": [(oname, lbl) for (_i, oname), lbl
+                             in zip(pairs, key_labels)],
+                    "payload": [agg_label],
+                }
+                self._sub_specs.append(spec)
+                self.scope.add("__sub", agg_label,
+                               B.ColumnBinding(agg_label, dtype))
+                return ast.Name((agg_label,))
+            # structural rebuild
+            if isinstance(e, ast.BinOp):
+                return ast.BinOp(e.op, walk(e.left), walk(e.right))
+            if isinstance(e, ast.UnaryOp):
+                return ast.UnaryOp(e.op, walk(e.arg))
+            if isinstance(e, ast.Between):
+                return ast.Between(walk(e.arg), walk(e.lo), walk(e.hi),
+                                   e.negated)
+            if isinstance(e, ast.FuncCall):
+                return ast.FuncCall(e.name, tuple(walk(a) for a in e.args),
+                                    e.distinct, e.star)
+            if isinstance(e, ast.Case):
+                return ast.Case(
+                    walk(e.operand) if e.operand is not None else None,
+                    tuple((walk(c), walk(r)) for (c, r) in e.whens),
+                    walk(e.default) if e.default is not None else None)
+            if isinstance(e, ast.Cast):
+                return ast.Cast(walk(e.arg), e.to)
+            return e
+
+        out = walk(p)
+        if not state["changed"]:
+            return None, False
+        return out, state["correlated"]
+
+    def _add_semi_spec(self, outer_exprs, inner_sel: ast.Select,
+                       negated: bool, first_item_key: bool):
+        inner, pairs = self._split_correlations(inner_sel)
+        n = len(self._sub_specs) + len(self._init_subplans)
+        items = []
+        keys = []        # [(outer_ast_expr, build_label)]
+        i = 0
+        if first_item_key:
+            if len(inner.items) != 1:
+                raise PlanError("IN subquery must select one column")
+            lbl = f"__s{n}k{i}"; i += 1
+            items.append(ast.SelectItem(inner.items[0].expr, lbl))
+            keys.append((outer_exprs[0], lbl))
+        for (iname, oname) in pairs:
+            lbl = f"__s{n}k{i}"; i += 1
+            items.append(ast.SelectItem(iname, lbl))
+            keys.append((oname, lbl))
+        if not keys:
+            raise PlanError("uncorrelated EXISTS is not supported yet")
+        has_aggs: list = []
+        for it in inner.items:
+            walk_aggs(it.expr, has_aggs)
+        grouped = bool(inner.group_by) or bool(has_aggs) \
+            or inner.having is not None
+        sub_sel = ast.Select(
+            items=items, relation=inner.relation, where=inner.where,
+            group_by=list(inner.group_by), having=inner.having,
+            distinct=not grouped)
+        if grouped and pairs:
+            # correlated grouped subquery: correlation keys join the groups
+            sub_sel.group_by = list(inner.group_by) + \
+                [iname for (iname, _o) in pairs]
+        self._sub_specs.append({
+            "kind": "anti" if negated else "semi", "n": n,
+            "plan": self._plan_inner(sub_sel),
+            "keys": keys, "payload": [],
+        })
+
+    def _attach_sub_specs(self, pipeline, binder: B.ExprBinder):
+        for spec in self._sub_specs:
+            n = spec["n"]
+            bound = []
+            pre = ir.Program()
+            for (oexpr, _lbl) in spec["keys"]:
+                e = binder.bind(oexpr)
+                bound.append(e)
+            if len(spec["keys"]) == 1:
+                e = bound[0]
+                if isinstance(e, ir.Col):
+                    probe_key = e.name
+                else:
+                    probe_key = f"__s{n}p"
+                    pre.assign(probe_key, e)
+                if pre.commands:
+                    pipeline.steps.append(("program", pre))
+                build_key = spec["keys"][0][1]
+                if spec["kind"] == "scalar":
+                    js = JoinStep(spec["plan"], build_key, probe_key,
+                                  "inner", list(spec["payload"]))
+                else:
+                    kind = "left_semi" if spec["kind"] == "semi" \
+                        else "left_anti"
+                    js = JoinStep(spec["plan"], build_key, probe_key, kind,
+                                  [], anti_null_check=(kind == "left_anti"))
+                pipeline.steps.append(("join", js))
+            else:
+                # composite: hash-key mark join + per-key verification
+                probe_key = f"__s{n}p"
+                hashed = [ir.call("hash64", e) for e in bound]
+                pre.assign(probe_key,
+                           hashed[0] if len(hashed) == 1
+                           else ir.call("hash_combine", *hashed))
+                pipeline.steps.append(("program", pre))
+                mark = f"__s{n}m"
+                key_labels = [lbl for (_o, lbl) in spec["keys"]]
+                js = JoinStep(spec["plan"], f"__s{n}bh", probe_key, "mark",
+                              key_labels + list(spec["payload"]),
+                              mark_col=mark,
+                              build_hash_keys=key_labels)
+                pipeline.steps.append(("join", js))
+                matched = ir.Col(mark)
+                for e, lbl in zip(bound, key_labels):
+                    matched = ir.call("and", matched,
+                                      ir.call("eq", e, ir.Col(lbl)))
+                verify = ir.Program()
+                if spec["kind"] == "anti":
+                    verify.filter(ir.call("not", matched))
+                else:          # semi or scalar
+                    verify.filter(matched)
+                pipeline.steps.append(("program", verify))
+
+        if self._post_preds:
+            prog = ir.Program()
+            for p in self._post_preds:
+                prog.filter(binder.bind(p))
+            pipeline.steps.append(("program", prog))
 
     # -- aggregation & projection ------------------------------------------
 
@@ -470,6 +839,9 @@ class Planner:
             else:
                 name = f"expr{i}"
                 prog.assign(name, e)
+                d = self._maybe_result_dict(e)
+                if d is not None:
+                    plan.result_dicts[name] = d
             output.append((name, label))
             out_names.append(name)
 
@@ -477,9 +849,10 @@ class Planner:
         if sel.distinct:
             # dedup per block, then globally; sort expressions are computed
             # after the final dedup (they would be dropped by the GroupBy)
-            prog.group_by(uniq_outs, [])
+            domains = self._key_domains(uniq_outs)
+            prog.group_by(uniq_outs, [], domains)
             plan.pipeline.partial = prog
-            final = ir.Program().group_by(uniq_outs, [])
+            final = ir.Program().group_by(uniq_outs, [], domains)
             sort_keys, _extra = self._bind_sort(sel, binder.bind, out_names,
                                                 final, alias_deref=deref)
             plan.final_program = final
@@ -505,6 +878,9 @@ class Planner:
             else:
                 name = f"gk{i}"
                 partial.assign(name, e)
+                d = self._maybe_result_dict(e)
+                if d is not None:
+                    plan.result_dicts[name] = d
             key_specs.append((ge, e, name))
         key_names = [k[2] for k in key_specs]
 
@@ -571,12 +947,13 @@ class Planner:
         for call in agg_calls:
             register(call)
 
-        partial.group_by(key_names, partial_aggs)
+        domains = self._key_domains(key_names)
+        partial.group_by(key_names, partial_aggs, domains)
         sealed[0] = True
         plan.pipeline.partial = partial
 
         # -- final stage: merge aggs, having, outputs, sort ---------------
-        final = ir.Program().group_by(key_names, final_aggs)
+        final = ir.Program().group_by(key_names, final_aggs, domains)
 
         planner = self
 
@@ -619,6 +996,9 @@ class Planner:
             else:
                 name = f"out{i}"
                 final.assign(name, e)
+                d = self._maybe_result_dict(e)
+                if d is not None:
+                    plan.result_dicts[name] = d
             output.append((name, label))
             out_names.append(name)
 
@@ -629,6 +1009,33 @@ class Planner:
         plan.sort = sort_keys
         plan.limit, plan.offset = sel.limit, sel.offset
         plan.output = output
+
+    def _maybe_result_dict(self, e) -> object:
+        """Dictionary of a derived string expression (take_lut through a
+        pool param), or the source column's dictionary for plain columns."""
+        if isinstance(e, ir.Call) and e.op == "take_lut" \
+                and len(e.args) == 2 and isinstance(e.args[1], ir.Param):
+            return self.pool.param_dicts.get(e.args[1].name)
+        return None
+
+    def _key_domains(self, key_names: list) -> tuple:
+        """Static key-domain sizes for the scatter aggregation path:
+        dictionary-coded strings (len(dict)) and bools (2); 0 = unbounded.
+        Domains snapshot the dictionary size at plan time — plans are built
+        per query, so codes cannot exceed them during execution."""
+        from ydb_tpu.core.dtypes import Kind
+        domains = []
+        for name in key_names:
+            b = self.scope.by_internal(name)
+            if b is None:
+                domains.append(0)
+            elif b.dtype.is_string and b.dictionary is not None:
+                domains.append(max(len(b.dictionary), 1))
+            elif b.dtype.kind is Kind.BOOL:
+                domains.append(2)
+            else:
+                domains.append(0)
+        return tuple(domains)
 
     def _bind_sort(self, sel, bind_fn, out_names: list, prog: ir.Program,
                    alias_deref) -> tuple[list, list]:

@@ -114,6 +114,14 @@ class Star:
     table: Optional[str] = None
 
 
+@dataclass(frozen=True)
+class BoundParam:
+    """Planner-synthesized runtime parameter (uncorrelated scalar subquery
+    result). Never produced by the parser."""
+    name: str
+    dtype: object                  # core.dtypes.DType
+
+
 Expr = Union[Name, Literal, BinOp, UnaryOp, FuncCall, Case, Cast, Between,
              InList, InSubquery, Exists, ScalarSubquery, Like, IsNull, Star]
 

@@ -1,0 +1,84 @@
+"""Device-resident (HBM) column cache.
+
+The TPU counterpart of the reference's shared page cache for tablet data
+(`ydb/core/tablet_flat` shared cache / `columnshard` blob cache
+`blobs_reader/`): immutable portion columns are uploaded to device memory
+once and reused across queries, so repeated scans stream from HBM instead
+of re-crossing the host↔device link every query. LRU-evicted under a byte
+budget. Portions are immutable (compaction replaces them with new ids), so
+entries never go stale — eviction of dropped portions happens lazily.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ydb_tpu.core.block import HostBlock
+from ydb_tpu.ops.device import DeviceBlock, bucket_capacity
+from ydb_tpu.storage.portion import Portion
+
+DEFAULT_BUDGET = 6 << 30          # bytes of HBM for cached columns
+
+
+class DeviceColumnCache:
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET):
+        self.budget = budget_bytes
+        self._entries: OrderedDict = OrderedDict()  # (pid, col) -> (data, valid, nbytes)
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def _evict(self):
+        while self.bytes > self.budget and self._entries:
+            _key, (_d, _v, nbytes) = self._entries.popitem(last=False)
+            self.bytes -= nbytes
+
+    def column(self, portion: Portion, col: str):
+        """(device data, device valid | None), padded to the portion's
+        capacity bucket."""
+        key = (portion.id, col)
+        hit = self._entries.get(key)
+        if hit is not None:
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return hit[0], hit[1]
+        self.misses += 1
+        cd = portion.block.columns[col]
+        cap = bucket_capacity(max(portion.num_rows, 1))
+        pad = cap - portion.num_rows
+        data = jnp.asarray(np.pad(cd.data, (0, pad)) if pad else cd.data)
+        valid = None
+        nbytes = data.nbytes
+        if cd.valid is not None:
+            valid = jnp.asarray(np.pad(cd.valid, (0, pad)) if pad
+                                else cd.valid)
+            nbytes += valid.nbytes
+        self._entries[key] = (data, valid, nbytes)
+        self.bytes += nbytes
+        self._evict()
+        return data, valid
+
+    def device_block(self, portion: Portion, columns: list,
+                     rename: Optional[dict] = None) -> DeviceBlock:
+        """Assemble a DeviceBlock for a portion from cached columns."""
+        rename = rename or {}
+        from ydb_tpu.core.schema import Column, Schema
+        cap = bucket_capacity(max(portion.num_rows, 1))
+        arrays, valids, dicts = {}, {}, {}
+        cols = []
+        for name in columns:
+            out = rename.get(name, name)
+            d, v = self.column(portion, name)
+            arrays[out] = d
+            if v is not None:
+                valids[out] = v
+            cd = portion.block.columns[name]
+            if cd.dictionary is not None:
+                dicts[out] = cd.dictionary
+            cols.append(Column(out, portion.block.schema.dtype(name)))
+        return DeviceBlock(Schema(cols), arrays, valids,
+                           jnp.int32(portion.num_rows), cap, dicts)

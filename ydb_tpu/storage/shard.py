@@ -110,6 +110,22 @@ class ColumnShard:
         return sum(p.num_rows for p in self.portions) + sum(
             e.block.length for e in self.inserts if e.committed_version)
 
+    def scan_sources(self, snapshot: Snapshot = MAX_SNAPSHOT,
+                     prune_predicates: Optional[list[tuple]] = None
+                     ) -> tuple[list, list]:
+        """(visible portions, visible committed-but-unindexed insert blocks)
+        under the snapshot, after min/max pruning."""
+        prune_predicates = prune_predicates or []
+        portions = [
+            p for p in self.portions
+            if snapshot.includes(p.version)
+            and not any(prune_by_range(p, c, op, v)
+                        for (c, op, v) in prune_predicates)]
+        inserts = [e.block for e in self.inserts
+                   if e.committed_version
+                   and snapshot.includes(e.committed_version)]
+        return portions, inserts
+
     def scan(self, columns: list[str],
              snapshot: Snapshot = MAX_SNAPSHOT,
              prune_predicates: Optional[list[tuple]] = None,
@@ -119,7 +135,6 @@ class ColumnShard:
         prune_predicates: [(col, op, value)] conjuncts for min/max pruning.
         """
         block_rows = block_rows or self.portion_rows
-        prune_predicates = prune_predicates or []
         pending: list[HostBlock] = []
         pending_rows = 0
 
@@ -131,16 +146,8 @@ class ColumnShard:
                 return out
             return None
 
-        sources: list[HostBlock] = []
-        for p in self.portions:
-            if not snapshot.includes(p.version):
-                continue
-            if any(prune_by_range(p, c, op, v) for (c, op, v) in prune_predicates):
-                continue
-            sources.append(p.block)
-        for e in self.inserts:  # committed-but-unindexed inserts are visible
-            if e.committed_version and snapshot.includes(e.committed_version):
-                sources.append(e.block)
+        portions, insert_blocks = self.scan_sources(snapshot, prune_predicates)
+        sources = [p.block for p in portions] + insert_blocks
 
         for src in sources:
             blk = src.select(columns)

@@ -91,3 +91,22 @@ def test_distributed_minmax_with_filter(mesh, rng):
         mask = (k == row.k) & sel
         np.testing.assert_allclose(row.mn, v[mask].min(), rtol=1e-12)
         np.testing.assert_allclose(row.mx, v[mask].max(), rtol=1e-12)
+
+
+def test_overflow_fallback_rerun(mesh, rng):
+    # tiny seg_rows forces segment overflow; run() must discard the
+    # truncated device result, rebuild full-capacity and return exact sums
+    partial = ir.Program().group_by(
+        ["k"], [ir.Agg("s", "sum", "v"), ir.Agg("n", "count_all")])
+    final = ir.Program().group_by(
+        ["k"], [ir.Agg("s", "sum", "s"), ir.Agg("n", "sum", "n")])
+    dag = DistributedAgg(partial, final, _schema(), mesh, seg_rows=2)
+    # 37 keys over 8 buckets → ~5 partial rows per bucket > seg_rows=2
+    blocks, k, v, m = _blocks(rng, 8, 200, 37)
+    out = dag.run(blocks).to_pandas().sort_values("k").reset_index(drop=True)
+    assert dag.seg_rows == 0                     # fallback happened
+    assert len(out) == len(np.unique(k))
+    for row in out.itertuples():
+        mask = (k == row.k) & m
+        np.testing.assert_allclose(row.s, v[mask].sum(), rtol=1e-9)
+        assert row.n == (k == row.k).sum()

@@ -186,3 +186,50 @@ def test_insert_negative_and_cast():
 def test_distinct_order_by_expr(eng):
     df = eng.query("select distinct grp from t order by grp + 1 desc")
     assert list(df.grp) == [3, 2, 1, 0]
+
+
+def test_not_in_null_probe():
+    # x NOT IN (non-empty set) is NULL when x is NULL → row excluded;
+    # x NOT IN (empty set) is TRUE even for NULL x → row kept
+    e = QueryEngine()
+    e.execute("create table nia (id Int32 not null, x Int32, primary key (id))")
+    e.execute("create table nib (id Int32 not null, y Int32, primary key (id))")
+    e.execute("create table nic (id Int32 not null, z Int32, primary key (id))")
+    e.execute("insert into nia (id, x) values (1, 10), (2, 20), (3, null)")
+    e.execute("insert into nib (id, y) values (1, 10), (2, 99)")
+    assert e.query(
+        "select count(*) as c from nia where x not in (select y from nib)"
+    ).c[0] == 1
+    assert e.query(
+        "select count(*) as c from nia where x not in (select z from nic)"
+    ).c[0] == 3
+
+
+def test_qualified_star_join():
+    e = QueryEngine()
+    e.execute("create table qa (id Int32 not null, x Int32, primary key (id))")
+    e.execute("create table qb (id Int32 not null, y Int32, primary key (id))")
+    e.execute("insert into qa (id, x) values (1, 10)")
+    e.execute("insert into qb (id, y) values (1, 7)")
+    df = e.query("select qa.* from qa, qb where qa.id = qb.id")
+    assert list(df.columns) == ["id", "x"]
+    with pytest.raises(QueryError):
+        e.query("select nosuch.* from qa, qb where qa.id = qb.id")
+
+
+def test_not_in_correlated_null_probe():
+    # composite-key path: x NOT IN (correlated subquery). NULL x row is
+    # excluded when its per-key set is non-empty, kept when empty.
+    e = QueryEngine()
+    e.execute("create table ca (id Int32 not null, k Int32 not null, "
+              "x Int32, primary key (id))")
+    e.execute("create table cb (id Int32 not null, k Int32 not null, "
+              "y Int32 not null, primary key (id))")
+    e.execute("insert into ca (id, k, x) values "
+              "(1, 1, 10), (2, 1, 20), (3, 1, null), (4, 2, null)")
+    e.execute("insert into cb (id, k, y) values (1, 1, 10), (2, 1, 99)")
+    df = e.query("select id from ca where x not in "
+                 "(select y from cb where cb.k = ca.k) order by id")
+    # id=1: 10 in {10,99} → excluded; id=2: kept; id=3: NULL vs non-empty
+    # → excluded; id=4: NULL vs empty set → TRUE → kept
+    assert list(df.id) == [2, 4]

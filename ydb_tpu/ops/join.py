@@ -93,10 +93,11 @@ def _probe_enc(d):
     return d.astype(jnp.int64)
 
 
-@partial(jax.jit, static_argnames=("probe_key", "kind", "payload_names"))
+@partial(jax.jit, static_argnames=("probe_key", "kind", "payload_names",
+                                   "not_in"))
 def _probe(probe_arrays, probe_valids, length, sel, n_build,
            keys_sorted, payload, payload_valid,
-           probe_key, kind: str, payload_names: tuple):
+           probe_key, kind: str, payload_names: tuple, not_in: bool = False):
     cap = probe_arrays[probe_key].shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
     row_mask = iota < length
@@ -117,6 +118,10 @@ def _probe(probe_arrays, probe_valids, length, sel, n_build,
 
     out_sel = found if kind in ("inner", "left_semi") else (
         (~found) & active if kind == "left_anti" else active)
+    if kind == "left_anti" and not_in and v is not None:
+        # x NOT IN S: NULL when x is NULL and S non-empty (row excluded),
+        # TRUE when S is empty (row kept regardless of x)
+        out_sel = out_sel & (v | (n_build == 0))
 
     gathered, gathered_valid = {}, {}
     if kind in ("inner", "left", "mark"):
@@ -132,7 +137,8 @@ def _probe(probe_arrays, probe_valids, length, sel, n_build,
 def probe(dblock: DeviceBlock, table: BuildTable, probe_key: str,
           kind: str = "inner", sel=None,
           rename: Optional[dict] = None,
-          mark_col: Optional[str] = None) -> tuple[DeviceBlock, object]:
+          mark_col: Optional[str] = None,
+          not_in: bool = False) -> tuple[DeviceBlock, object]:
     """Probe a device block against a build table.
 
     Returns (new DeviceBlock with payload columns appended, new selection
@@ -152,7 +158,7 @@ def probe(dblock: DeviceBlock, table: BuildTable, probe_key: str,
     out_sel, gathered, gathered_valid, found = _probe(
         dblock.arrays, dblock.valids, dblock.length, sel, jnp.int32(table.n),
         table.keys_sorted, table.payload, table.payload_valid,
-        probe_key, kind, names)
+        probe_key, kind, names, not_in)
 
     arrays = dict(dblock.arrays)
     valids = dict(dblock.valids)

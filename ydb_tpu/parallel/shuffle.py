@@ -17,8 +17,10 @@ Group keys are disjoint across devices after the shuffle, so the final
 merge is local and the host only concatenates per-device results.
 
 Everything is static-shape: segments have a fixed per-edge capacity and
-carry a row count; overflow falls back to a larger bucket (recompile), the
-analog of DQ channel spilling (`dq/actors/spilling/channel_storage.cpp`).
+carry a row count. Overflow is detected on device (a bool reduced across
+segments); `run` then rebuilds with full-capacity segments — which cannot
+overflow — and reruns the batch, the analog of DQ channel spilling
+(`dq/actors/spilling/channel_storage.cpp`).
 """
 
 from __future__ import annotations
@@ -250,10 +252,13 @@ class DistributedAgg:
         out_d, out_v, flens, overflow = self._fn(arrays, valids, lengths,
                                                  dev_params)
         if bool(np.any(np.asarray(overflow))):
-            raise RuntimeError(
-                f"hash-shuffle segment overflow (seg_rows={self.seg_rows}): "
-                "rerun with larger seg_rows (0 = full capacity, never "
-                "overflows)")
+            # overflowed rows were clamped on device, so that result is
+            # partial — discard it, rebuild with full-capacity segments
+            # (seg = pcap ≥ any per-bucket count: cannot overflow) and rerun
+            assert self.seg_rows, "full-capacity segments cannot overflow"
+            self.seg_rows = 0
+            self._fn = None
+            return self.run(blocks_per_device, params)
         out_sig = self._holder["sig"]
         out_cols = [Column(n, DType(Kind(k), nullable))
                     for (n, k, nullable) in out_sig]

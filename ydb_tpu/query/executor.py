@@ -90,7 +90,8 @@ class Executor:
                 table = builds[bi]
                 bi += 1
                 d, sel = J.probe(d, table, step.probe_key, step.kind,
-                                 sel=None, mark_col=step.mark_col or None)
+                                 sel=None, mark_col=step.mark_col or None,
+                                 not_in=step.not_in)
                 if step.kind != "mark":
                     d = compress_block(d, sel)
             else:
@@ -111,7 +112,7 @@ class Executor:
             built = _add_hash_column(built, step.build_hash_keys,
                                      step.build_key)
         if step.anti_null_check:
-            cd = built.columns[step.build_key]
+            cd = built.columns[step.anti_null_col or step.build_key]
             if cd.valid is not None and not cd.valid.all():
                 raise NotImplementedError(
                     "NOT IN over a subquery producing NULLs (SQL: always "

@@ -33,6 +33,10 @@ class JoinStep:
     payload: list = field(default_factory=list)  # build columns to attach
     mark_col: str = ""               # for kind=mark: bool match-flag column
     anti_null_check: bool = False    # NOT IN: reject NULLs in the build key
+    anti_null_col: str = ""          # column to null-check (default build_key)
+    # NOT IN semantics: a NULL probe key is excluded unless the build set is
+    # empty (x NOT IN S is NULL when x is NULL and S != {}, TRUE when S = {})
+    not_in: bool = False
     # composite keys: executor hashes these build columns host-side into
     # `build_key` before building (probe side hashes in its program)
     build_hash_keys: list = field(default_factory=list)

@@ -236,7 +236,15 @@ class Planner:
         needed: set = set()        # internal names
         for item in sel.items:
             if isinstance(item.expr, ast.Star):
-                for r in rels.values():
+                if item.expr.table is not None:
+                    if item.expr.table not in rels:
+                        raise PlanError(
+                            f"unknown table alias {item.expr.table!r} in "
+                            f"{item.expr.table}.*")
+                    star_rels = [rels[item.expr.table]]
+                else:
+                    star_rels = list(rels.values())
+                for r in star_rels:
                     for col in r.table.schema:
                         needed.add(f"{r.alias}.{col.name}")
             else:
@@ -715,11 +723,31 @@ class Planner:
             # correlated grouped subquery: correlation keys join the groups
             sub_sel.group_by = list(inner.group_by) + \
                 [iname for (iname, _o) in pairs]
-        self._sub_specs.append({
+        spec = {
             "kind": "anti" if negated else "semi", "n": n,
             "plan": self._plan_inner(sub_sel),
             "keys": keys, "payload": [],
-        })
+            # NOT IN (vs NOT EXISTS): NULL probe keys must be excluded when
+            # the build set is non-empty — x NOT IN S is NULL, not TRUE
+            "not_in": negated and first_item_key,
+        }
+        if spec["not_in"] and pairs:
+            # correlated NOT IN additionally needs a per-correlation-key
+            # set-emptiness probe (x NOT IN {} is TRUE even for NULL x):
+            # a distinct projection of the correlation keys alone
+            if grouped:
+                raise PlanError(
+                    "correlated NOT IN over a grouped subquery is not "
+                    "supported yet")
+            corr_items = [ast.SelectItem(iname, f"__s{n}c{i}")
+                          for i, (iname, _o) in enumerate(pairs)]
+            sub2 = ast.Select(
+                items=corr_items, relation=inner.relation, where=inner.where,
+                group_by=[iname for (iname, _o) in pairs])
+            spec["plan2"] = self._plan_inner(sub2)
+            spec["keys2"] = [(oname, f"__s{n}c{i}")
+                             for i, (_i, oname) in enumerate(pairs)]
+        self._sub_specs.append(spec)
 
     def _attach_sub_specs(self, pipeline, binder: B.ExprBinder):
         for spec in self._sub_specs:
@@ -746,7 +774,9 @@ class Planner:
                     kind = "left_semi" if spec["kind"] == "semi" \
                         else "left_anti"
                     js = JoinStep(spec["plan"], build_key, probe_key, kind,
-                                  [], anti_null_check=(kind == "left_anti"))
+                                  [], anti_null_check=(kind == "left_anti"),
+                                  not_in=(kind == "left_anti"
+                                          and spec.get("not_in", False)))
                 pipeline.steps.append(("join", js))
             else:
                 # composite: hash-key mark join + per-key verification
@@ -758,15 +788,25 @@ class Planner:
                 pipeline.steps.append(("program", pre))
                 mark = f"__s{n}m"
                 key_labels = [lbl for (_o, lbl) in spec["keys"]]
+                not_in = spec["kind"] == "anti" and spec.get("not_in", False)
                 js = JoinStep(spec["plan"], f"__s{n}bh", probe_key, "mark",
                               key_labels + list(spec["payload"]),
                               mark_col=mark,
-                              build_hash_keys=key_labels)
+                              build_hash_keys=key_labels,
+                              # correlated NOT IN: a NULL build value poisons
+                              # its whole per-key set — raise like the
+                              # single-key path does
+                              anti_null_check=not_in,
+                              anti_null_col=key_labels[0] if not_in else "")
                 pipeline.steps.append(("join", js))
                 matched = ir.Col(mark)
                 for e, lbl in zip(bound, key_labels):
                     matched = ir.call("and", matched,
                                       ir.call("eq", e, ir.Col(lbl)))
+                if not_in:
+                    self._attach_not_in_verify(pipeline, spec, bound,
+                                               matched, n)
+                    continue
                 verify = ir.Program()
                 if spec["kind"] == "anti":
                     verify.filter(ir.call("not", matched))
@@ -779,6 +819,43 @@ class Planner:
             for p in self._post_preds:
                 prog.filter(binder.bind(p))
             pipeline.steps.append(("program", prog))
+
+    def _attach_not_in_verify(self, pipeline, spec, bound, matched, n):
+        """Correlated NOT IN (composite-key mark join): `x NOT IN S_k` is
+        NULL — row excluded — when x is NULL and the per-correlation-key
+        set S_k is non-empty, but TRUE when S_k is empty. Emptiness is
+        probed with a second mark join on the correlation keys alone;
+        Kleene AND/OR then give keep = NOT matched AND
+        (x IS NOT NULL OR NOT any_corr)."""
+        # snapshot `matched` before the second join clobbers columns
+        mcol = f"__s{n}mt"
+        snap = ir.Program()
+        snap.assign(mcol, matched)
+        pipeline.steps.append(("program", snap))
+
+        corr_bound = bound[1:]
+        corr_labels = [lbl for (_o, lbl) in spec["keys2"]]
+        probe2 = f"__s{n}p2"
+        h2 = [ir.call("hash64", e) for e in corr_bound]
+        pre2 = ir.Program()
+        pre2.assign(probe2, h2[0] if len(h2) == 1
+                    else ir.call("hash_combine", *h2))
+        pipeline.steps.append(("program", pre2))
+        mark2 = f"__s{n}m2"
+        js2 = JoinStep(spec["plan2"], f"__s{n}bh2", probe2, "mark",
+                       list(corr_labels), mark_col=mark2,
+                       build_hash_keys=list(corr_labels))
+        pipeline.steps.append(("join", js2))
+        any_corr = ir.Col(mark2)
+        for e, lbl in zip(corr_bound, corr_labels):
+            any_corr = ir.call("and", any_corr,
+                               ir.call("eq", e, ir.Col(lbl)))
+        verify = ir.Program()
+        verify.filter(ir.call(
+            "and", ir.call("not", ir.Col(mcol)),
+            ir.call("or", ir.call("is_not_null", bound[0]),
+                    ir.call("not", any_corr))))
+        pipeline.steps.append(("program", verify))
 
     # -- aggregation & projection ------------------------------------------
 
@@ -826,7 +903,15 @@ class Planner:
         out_names = []
         for i, item in enumerate(sel.items):
             if isinstance(item.expr, ast.Star):
-                for name in plan.pipeline.out_names:
+                names = plan.pipeline.out_names
+                if item.expr.table is not None:
+                    prefix = item.expr.table + "."
+                    names = [n for n in names if n.startswith(prefix)]
+                    if not names:
+                        raise PlanError(
+                            f"unknown table alias {item.expr.table!r} in "
+                            f"{item.expr.table}.*")
+                for name in names:
                     output.append((name, name.split(".", 1)[1]))
                     out_names.append(name)
                 continue

@@ -41,21 +41,30 @@ class DeviceBlock:
                      for c in self.schema)
 
 
-def to_device(block: HostBlock, capacity: Optional[int] = None) -> DeviceBlock:
+def to_device(block: HostBlock, capacity: Optional[int] = None,
+              device=None) -> DeviceBlock:
+    """Upload a host block, optionally committed to a specific device
+    (row-partition placement on a mesh: jit'd programs follow committed
+    inputs, so per-portion work lands on the portion's device)."""
+    import jax
+
     cap = capacity or bucket_capacity(max(block.length, 1))
+    put = (lambda x: jax.device_put(x, device)) if device is not None \
+        else jnp.asarray
     arrays, valids, dicts = {}, {}, {}
     pad = cap - block.length
     for c in block.schema:
         cd = block.columns[c.name]
         data = np.pad(cd.data, (0, pad)) if pad else cd.data
-        arrays[c.name] = jnp.asarray(data)
+        arrays[c.name] = put(data)
         if cd.valid is not None:
             v = np.pad(cd.valid, (0, pad)) if pad else cd.valid
-            valids[c.name] = jnp.asarray(v)
+            valids[c.name] = put(v)
         if cd.dictionary is not None:
             dicts[c.name] = cd.dictionary
-    return DeviceBlock(block.schema, arrays, valids, jnp.int32(block.length),
-                       cap, dicts)
+    length = put(np.int32(block.length)) if device is not None \
+        else jnp.int32(block.length)
+    return DeviceBlock(block.schema, arrays, valids, length, cap, dicts)
 
 
 def to_host(dblock: DeviceBlock) -> HostBlock:

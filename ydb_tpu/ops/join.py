@@ -87,6 +87,17 @@ def build(block: HostBlock, key: str, payload_names: list[str]) -> BuildTable:
                       block.schema.select(payload_names), dicts, unique)
 
 
+def place(table: BuildTable, device) -> BuildTable:
+    """Replicate a build table onto a specific device (the broadcast leg of
+    MapJoin on a mesh: every device probes its own copy)."""
+    put = lambda x: jax.device_put(x, device)  # noqa: E731
+    return BuildTable(
+        put(table.keys_sorted), table.n,
+        {k: put(v) for k, v in table.payload.items()},
+        {k: put(v) for k, v in table.payload_valid.items()},
+        table.schema, table.dictionaries, table.unique)
+
+
 def _probe_enc(d):
     if d.dtype in (jnp.float64, jnp.float32):
         return d.astype(jnp.float64)

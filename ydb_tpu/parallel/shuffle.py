@@ -51,6 +51,40 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devs[:n]), (AXIS,))
 
 
+@partial(jax.jit, static_argnames=("caps", "pcap", "names"))
+def _fuse_device_blocks(blocks, caps, pcap, names):
+    """Concat + compact a device's partial blocks into one [pcap] buffer
+    (runs on the device that owns the blocks — committed inputs pin the
+    execution there)."""
+    datas = {n: [] for n in names}
+    vals = {n: [] for n in names}
+    masks = []
+    total = 0
+    for (arrays, valids, length), cap in zip(blocks, caps):
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        masks.append(iota < length)
+        total += cap
+        for n in names:
+            datas[n].append(arrays[n])
+            v = valids.get(n)
+            vals[n].append(v if v is not None
+                           else jnp.ones((cap,), jnp.bool_))
+    env = {n: (jnp.concatenate(datas[n]), jnp.concatenate(vals[n]))
+           for n in names}
+    mask = jnp.concatenate(masks)
+    env, cnt = compress(env, jnp.int32(total), mask, total)
+    out_d, out_v = {}, {}
+    for n in names:
+        d, v = env[n]
+        if total < pcap:
+            d = jnp.pad(d, (0, pcap - total))
+            v = jnp.pad(v, (0, pcap - total))
+        else:
+            d, v = d[:pcap], v[:pcap]
+        out_d[n], out_v[n] = d, v
+    return out_d, out_v, cnt
+
+
 def _bucket_of(env, key_names, ndev):
     """Hash-partition bucket id per row (device-side, same hash family as
     host shard routing — `ydb_tpu/utils/hashing.py`)."""
@@ -259,19 +293,76 @@ class DistributedAgg:
             self.seg_rows = 0
             self._fn = None
             return self.run(blocks_per_device, params)
-        out_sig = self._holder["sig"]
-        out_cols = [Column(n, DType(Kind(k), nullable))
-                    for (n, k, nullable) in out_sig]
-        schema = Schema(out_cols)
-
-        # per-device results → host concat (groups are disjoint)
-        flens = np.asarray(flens)
-        blocks = []
         dicts = {}
         for b in blocks_per_device:
             for name, cd in b.columns.items():
                 if cd.dictionary is not None:
                     dicts[name] = cd.dictionary
+        return self._finish(out_d, out_v, flens, dicts)
+
+    def run_device_blocks(self, per_dev_blocks: list,
+                          params: Optional[dict] = None) -> HostBlock:
+        """Distributed merge over ALREADY device-resident partials.
+
+        ``per_dev_blocks[d]`` is a list of DeviceBlocks committed to mesh
+        device d (the per-portion partial-aggregation outputs of the SQL
+        executor). Each device fuses its partials locally (concat +
+        compress, jit'd on that device), the fused buffers are assembled
+        into one globally-sharded array — no host round-trip — and the
+        shard-mapped shuffle+merge runs over it.
+        """
+        ndev = self.mesh.devices.size
+        assert len(per_dev_blocks) == ndev
+        assert all(blks for blks in per_dev_blocks), \
+            "every device needs at least one (possibly empty) partial block"
+        params = params or {}
+        names = tuple(self.in_schema.names)
+        total_caps = [sum(b.capacity for b in blks)
+                      for blks in per_dev_blocks]
+        pcap = bucket_capacity(max(total_caps), minimum=128)
+        fused = []
+        for blks in per_dev_blocks:
+            blocks_in = tuple((b.arrays, b.valids, b.length) for b in blks)
+            caps = tuple(b.capacity for b in blks)
+            fused.append(_fuse_device_blocks(blocks_in, caps, pcap, names))
+
+        sh2 = NamedSharding(self.mesh, P(AXIS, None))
+        sh1 = NamedSharding(self.mesh, P(AXIS))
+        arrays = {n: jax.make_array_from_single_device_arrays(
+            (ndev, pcap), sh2, [fused[d][0][n][None] for d in range(ndev)])
+            for n in names}
+        valids = {n: jax.make_array_from_single_device_arrays(
+            (ndev, pcap), sh2, [fused[d][1][n][None] for d in range(ndev)])
+            for n in names}
+        lengths = jax.make_array_from_single_device_arrays(
+            (ndev,), sh1, [fused[d][2][None] for d in range(ndev)])
+
+        sig = (pcap, tuple(sorted(names)), tuple(sorted(params)))
+        if self._fn is None or self._sig != sig:
+            self._fn, self._holder = self._build(pcap, tuple(sorted(names)),
+                                                 tuple(sorted(params)))
+            self._sig = sig
+        dev_params = {k: jnp.asarray(v) for k, v in params.items()}
+        out_d, out_v, flens, overflow = self._fn(arrays, valids, lengths,
+                                                 dev_params)
+        # seg_rows=0 (full capacity) is the only mode used here — overflow
+        # is impossible, but keep the invariant checked
+        assert not bool(np.any(np.asarray(overflow)))
+        dicts = {}
+        for blks in per_dev_blocks:
+            for b in blks:
+                dicts.update(b.dictionaries)
+        return self._finish(out_d, out_v, flens, dicts)
+
+    def _finish(self, out_d, out_v, flens, dicts) -> HostBlock:
+        """Per-device results → host concat (groups are disjoint)."""
+        ndev = self.mesh.devices.size
+        out_sig = self._holder["sig"]
+        out_cols = [Column(n, DType(Kind(k), nullable))
+                    for (n, k, nullable) in out_sig]
+        schema = Schema(out_cols)
+        flens = np.asarray(flens)
+        blocks = []
         for d in range(ndev):
             n = int(flens[d])
             cols = {}

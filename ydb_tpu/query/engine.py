@@ -30,12 +30,21 @@ class QueryError(Exception):
 
 class QueryEngine:
     def __init__(self, catalog: Optional[Catalog] = None,
-                 block_rows: int = 1 << 20):
+                 block_rows: int = 1 << 20, mesh=None):
+        """`mesh`: a jax.sharding.Mesh for distributed execution — scans are
+        row-partitioned across its devices and aggregation boundaries become
+        ICI hash shuffles (`ydb_tpu.parallel.make_mesh(n)` builds one)."""
         self.catalog = catalog or Catalog()
         self.planner = Planner(self.catalog)
-        self.executor = Executor(self.catalog, block_rows)
+        self.executor = Executor(self.catalog, block_rows, mesh=mesh)
         self._plan_step = 1
         self._tx_id = 1
+        # plan cache (compile-service LRU analog, `kqp_compile_service.cpp:411`):
+        # keyed by SQL text + catalog epoch — any DDL/DML bumps the epoch
+        # because plans snapshot dictionary domains at plan time
+        self._plan_cache: dict = {}
+        self._epoch = 0
+        self.plan_cache_hits = 0
 
     # -- versions (standing in for coordinator/mediator time) -------------
 
@@ -52,16 +61,25 @@ class QueryEngine:
         stmt = parse(sql)
         try:
             if isinstance(stmt, ast.Select):
-                plan = self.planner.plan_select(stmt)
+                cached = self._plan_cache.get(sql)
+                if cached is not None and cached[0] == self._epoch:
+                    plan = cached[1]
+                    self.plan_cache_hits += 1
+                else:
+                    plan = self.planner.plan_select(stmt)
+                    self._plan_cache[sql] = (self._epoch, plan)
                 return self.executor.execute(plan, self.snapshot())
             if isinstance(stmt, ast.CreateTable):
+                self._epoch += 1
                 return self._create_table(stmt)
             if isinstance(stmt, ast.DropTable):
                 if stmt.if_exists and not self.catalog.has(stmt.name):
                     return _unit_block()
+                self._epoch += 1
                 self.catalog.drop_table(stmt.name)
                 return _unit_block()
             if isinstance(stmt, ast.Insert):
+                self._epoch += 1
                 return self._insert(stmt)
             raise QueryError(f"unsupported statement {type(stmt).__name__}")
         except (BindError, PlanError) as e:

@@ -37,12 +37,19 @@ DEFAULT_BLOCK_ROWS = 1 << 20
 
 class Executor:
     def __init__(self, catalog, block_rows: int = DEFAULT_BLOCK_ROWS,
-                 device_cache=None):
+                 device_cache=None, mesh=None):
         from ydb_tpu.storage.device_cache import DeviceColumnCache
         self.catalog = catalog
         self.block_rows = block_rows
         self.device_cache = device_cache or DeviceColumnCache()
         self._finalize_cache: dict = {}
+        # device mesh for distributed execution (None / size-1 mesh →
+        # single-device). The analog of the KQP task graph + DQ hash-shuffle
+        # channels (`dq_tasks_graph.h:43`): scans are row-partitioned across
+        # mesh devices, the partial→final aggregation boundary is an ICI
+        # all_to_all hash shuffle.
+        self.mesh = mesh
+        self._dist_aggs: dict = {}
 
     # -- entry -------------------------------------------------------------
 
@@ -61,9 +68,79 @@ class Executor:
             else:
                 params[pname] = sub.columns[sub.schema.names[0]].data[0]
 
+        if self.mesh is not None and self.mesh.devices.size > 1 \
+                and self._can_distribute(plan):
+            merged = self._execute_distributed(plan, params, snapshot)
+            return self._project_output(merged, plan.output)
+
         partials = self._run_pipeline(plan.pipeline, params, snapshot)
         merged = self._finalize(plan, partials, params)
         return self._project_output(merged, plan.output)
+
+    # -- distributed (mesh) path -------------------------------------------
+
+    def _can_distribute(self, plan: QueryPlan) -> bool:
+        """Distributable = two-phase aggregation shape: the pipeline ends in
+        a partial GroupBy and the final program starts with the merge
+        GroupBy (hash-shuffle boundary sits between the two)."""
+        pipe = plan.pipeline
+        if pipe.partial is None or not pipe.partial.commands:
+            return False
+        if not isinstance(pipe.partial.commands[-1], ir.GroupBy):
+            return False
+        fp = plan.final_program
+        return (fp is not None and fp.commands
+                and isinstance(fp.commands[0], ir.GroupBy))
+
+    def _execute_distributed(self, plan: QueryPlan, params: dict,
+                             snapshot: Snapshot) -> HostBlock:
+        """Scan partitions round-robin across mesh devices, run the full
+        per-block pipeline (pushdown → joins → partial agg) on each
+        device, hash-shuffle the partials over the mesh, merge, then run
+        the remaining final program + sort/limit single-device (post-agg
+        tails are small)."""
+        import dataclasses
+
+        from ydb_tpu.parallel.shuffle import DistributedAgg
+
+        pipe = plan.pipeline
+        devs = list(self.mesh.devices.flat)
+        ndev = len(devs)
+        builds = [self._prepare_join(step, params, snapshot)
+                  for kind, step in pipe.steps if kind == "join"]
+        builds_by_dev = [[J.place(b, d) for b in builds] for d in devs]
+
+        per_dev = [[] for _ in range(ndev)]
+        for di, dblock in self._scan_device_blocks(pipe, snapshot,
+                                                   devices=devs):
+            per_dev[di].append(
+                self._run_block(pipe, dblock, builds_by_dev[di], params))
+        for di in range(ndev):
+            if not per_dev[di]:
+                empty = to_device(self._empty_scan_block(pipe),
+                                  device=devs[di])
+                per_dev[di].append(
+                    self._run_block(pipe, empty, builds_by_dev[di], params))
+
+        # merge GroupBy runs twice (pre-shuffle local combine + post-shuffle
+        # final merge) — merge aggregation is associative, so this is the
+        # BlockCombineHashed → BlockMergeFinalizeHashed split
+        gb = plan.final_program.commands[0]
+        merge_prog = ir.Program([gb])
+        in_schema = per_dev[0][0].schema
+        key = (merge_prog.fingerprint(),
+               tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
+                     for c in in_schema.columns), ndev)
+        dag = self._dist_aggs.get(key)
+        if dag is None:
+            dag = DistributedAgg(merge_prog, merge_prog, in_schema, self.mesh)
+            self._dist_aggs[key] = dag
+        merged = dag.run_device_blocks(per_dev, params)
+
+        rest = list(plan.final_program.commands[1:])
+        plan2 = dataclasses.replace(
+            plan, final_program=ir.Program(rest) if rest else None)
+        return self._finalize(plan2, [to_device(merged)], params)
 
     # -- pipelines ---------------------------------------------------------
 
@@ -119,21 +196,39 @@ class Executor:
                     "empty) is not supported yet")
         return J.build(built, step.build_key, list(step.payload))
 
-    def _scan_device_blocks(self, pipe: Pipeline, snapshot: Snapshot):
+    def _scan_device_blocks(self, pipe: Pipeline, snapshot: Snapshot,
+                            devices=None):
         """Per-portion device blocks via the HBM column cache; committed but
         unindexed inserts upload uncached (they are transient — indexation
-        turns them into portions)."""
+        turns them into portions).
+
+        With `devices`, sources are placed round-robin across the mesh and
+        (device_index, block) pairs are yielded instead (partition
+        parallelism — the DataShard/ColumnShard shard-spread analog)."""
         table = self.catalog.table(pipe.scan.table)
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
+        i = 0
         for shard in table.shards:
             portions, insert_blocks = shard.scan_sources(
                 snapshot, pipe.scan.prune or None)
             for p in portions:
-                yield self.device_cache.device_block(p, storage_names, rename)
+                if devices is None:
+                    yield self.device_cache.device_block(p, storage_names,
+                                                         rename)
+                else:
+                    di = i % len(devices)
+                    i += 1
+                    yield di, self.device_cache.device_block(
+                        p, storage_names, rename, device=devices[di])
             for blk in insert_blocks:
-                yield to_device(_rename_block(blk.select(storage_names),
-                                              rename))
+                hb = _rename_block(blk.select(storage_names), rename)
+                if devices is None:
+                    yield to_device(hb)
+                else:
+                    di = i % len(devices)
+                    i += 1
+                    yield di, to_device(hb, device=devices[di])
 
     def _empty_scan_block(self, pipe: Pipeline) -> HostBlock:
         """Zero-row block with the scan's schema and dictionaries."""

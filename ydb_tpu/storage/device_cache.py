@@ -37,25 +37,28 @@ class DeviceColumnCache:
             _key, (_d, _v, nbytes) = self._entries.popitem(last=False)
             self.bytes -= nbytes
 
-    def column(self, portion: Portion, col: str):
+    def column(self, portion: Portion, col: str, device=None):
         """(device data, device valid | None), padded to the portion's
-        capacity bucket."""
-        key = (portion.id, col)
+        capacity bucket; committed to `device` when given (mesh placement)."""
+        import jax
+
+        key = (portion.id, col, None if device is None else device.id)
         hit = self._entries.get(key)
         if hit is not None:
             self._entries.move_to_end(key)
             self.hits += 1
             return hit[0], hit[1]
         self.misses += 1
+        put = (lambda x: jax.device_put(x, device)) if device is not None \
+            else jnp.asarray
         cd = portion.block.columns[col]
         cap = bucket_capacity(max(portion.num_rows, 1))
         pad = cap - portion.num_rows
-        data = jnp.asarray(np.pad(cd.data, (0, pad)) if pad else cd.data)
+        data = put(np.pad(cd.data, (0, pad)) if pad else cd.data)
         valid = None
         nbytes = data.nbytes
         if cd.valid is not None:
-            valid = jnp.asarray(np.pad(cd.valid, (0, pad)) if pad
-                                else cd.valid)
+            valid = put(np.pad(cd.valid, (0, pad)) if pad else cd.valid)
             nbytes += valid.nbytes
         self._entries[key] = (data, valid, nbytes)
         self.bytes += nbytes
@@ -63,8 +66,11 @@ class DeviceColumnCache:
         return data, valid
 
     def device_block(self, portion: Portion, columns: list,
-                     rename: Optional[dict] = None) -> DeviceBlock:
+                     rename: Optional[dict] = None,
+                     device=None) -> DeviceBlock:
         """Assemble a DeviceBlock for a portion from cached columns."""
+        import jax
+
         rename = rename or {}
         from ydb_tpu.core.schema import Column, Schema
         cap = bucket_capacity(max(portion.num_rows, 1))
@@ -72,7 +78,7 @@ class DeviceColumnCache:
         cols = []
         for name in columns:
             out = rename.get(name, name)
-            d, v = self.column(portion, name)
+            d, v = self.column(portion, name, device)
             arrays[out] = d
             if v is not None:
                 valids[out] = v
@@ -80,5 +86,6 @@ class DeviceColumnCache:
             if cd.dictionary is not None:
                 dicts[out] = cd.dictionary
             cols.append(Column(out, portion.block.schema.dtype(name)))
-        return DeviceBlock(Schema(cols), arrays, valids,
-                           jnp.int32(portion.num_rows), cap, dicts)
+        length = jax.device_put(np.int32(portion.num_rows), device) \
+            if device is not None else jnp.int32(portion.num_rows)
+        return DeviceBlock(Schema(cols), arrays, valids, length, cap, dicts)

@@ -92,3 +92,79 @@ def test_sort_nulls_last(rng):
     vals = res["x"].tolist()
     assert vals[:3] == [1.0, 2.0, 3.0]
     assert pd.isna(vals[3]) and pd.isna(vals[4])
+
+
+def _dup_build_block(rng, n_keys=40, avg_dup=3):
+    ks, names, prices = [], [], []
+    i = 0
+    for k in range(n_keys):
+        for _ in range(int(rng.integers(0, avg_dup * 2 + 1))):  # 0..6 dups
+            ks.append(k * 10)
+            names.append(f"v{i}")
+            prices.append(float(i) * 0.5)
+            i += 1
+    return HostBlock.from_pandas(pd.DataFrame({
+        "pk": np.array(ks, dtype=np.int64),
+        "name": names,
+        "price": np.array(prices, dtype=np.float64),
+    }))
+
+
+def test_expand_inner_join_duplicates(rng):
+    dim = _dup_build_block(rng)
+    fact = _fact_block(rng, n=3000, dim_n=60)
+    table = mj.build(dim, "pk", ["name", "price"])
+    assert not table.unique
+    out = mj.probe_expand(to_device(fact), table, "fk", kind="inner")
+    res = to_host(out).to_pandas()
+    expect = fact.to_pandas().merge(
+        dim.to_pandas(), left_on="fk", right_on="pk")[
+        ["fk", "qty", "name", "price"]]
+    res_s = res.sort_values(["fk", "qty", "name"]).reset_index(drop=True)
+    exp_s = expect.sort_values(["fk", "qty", "name"]).reset_index(drop=True)
+    assert len(res_s) == len(exp_s)
+    np.testing.assert_array_equal(res_s["fk"].to_numpy(),
+                                  exp_s["fk"].to_numpy())
+    np.testing.assert_allclose(res_s["price"].to_numpy(np.float64),
+                               exp_s["price"].to_numpy(np.float64))
+    assert (res_s["name"] == exp_s["name"]).all()
+
+
+def test_expand_left_join_duplicates(rng):
+    dim = _dup_build_block(rng)
+    fact = _fact_block(rng, n=2000, dim_n=60)
+    table = mj.build(dim, "pk", ["price"])
+    out = mj.probe_expand(to_device(fact), table, "fk", kind="left")
+    res = to_host(out).to_pandas()
+    expect = fact.to_pandas().merge(
+        dim.to_pandas()[["pk", "price"]], left_on="fk", right_on="pk",
+        how="left")[["fk", "qty", "price"]]
+    assert len(res) == len(expect)
+    res_s = res.sort_values(["fk", "qty", "price"]).reset_index(drop=True)
+    exp_s = expect.sort_values(["fk", "qty", "price"]).reset_index(drop=True)
+    np.testing.assert_array_equal(res_s["fk"].to_numpy(),
+                                  exp_s["fk"].to_numpy())
+    got_p = res_s["price"].to_numpy(np.float64)
+    want_p = exp_s["price"].to_numpy(np.float64)
+    both_nan = np.isnan(got_p) & np.isnan(want_p)
+    np.testing.assert_allclose(got_p[~both_nan], want_p[~both_nan])
+
+
+def test_expand_join_null_probe_keys(rng):
+    # NULL probe keys never match: dropped by inner, null-extended by left
+    schema = Schema([Column("fk", dt.DType(dt.Kind.INT64, True)),
+                     Column("qty", dt.DType(dt.Kind.INT64, False))])
+    fk = np.array([0, 10, 10, 99], dtype=np.int64)
+    valid = np.array([True, True, False, True])
+    fact = HostBlock.from_arrays(
+        schema, {"fk": fk, "qty": np.arange(4, dtype=np.int64)},
+        valids={"fk": valid})
+    dim = HostBlock.from_pandas(pd.DataFrame({
+        "pk": np.array([10, 10], dtype=np.int64),
+        "price": np.array([1.0, 2.0])}))
+    table = mj.build(dim, "pk", ["price"])
+    inner = to_host(mj.probe_expand(to_device(fact), table, "fk", "inner"))
+    assert inner.length == 2 and list(inner.to_pandas().qty) == [1, 1]
+    left = to_host(mj.probe_expand(to_device(fact), table, "fk", "left"))
+    df = left.to_pandas().sort_values(["qty", "price"]).reset_index(drop=True)
+    assert len(df) == 5  # rows 0,2,3 null-extended + two matches for row 1

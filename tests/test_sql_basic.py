@@ -233,3 +233,27 @@ def test_not_in_correlated_null_probe():
     # id=1: 10 in {10,99} → excluded; id=2: kept; id=3: NULL vs non-empty
     # → excluded; id=4: NULL vs empty set → TRUE → kept
     assert list(df.id) == [2, 4]
+
+
+def test_fact_fact_join_duplicate_keys():
+    # both sides non-unique on the join key → expanding (GraceJoin-analog)
+    # probe path; result checked against pandas merge
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("create table fa (id Int64 not null, k Int64 not null, "
+              "va Double not null, primary key (id))")
+    e.execute("create table fb (id Int64 not null, k Int64 not null, "
+              "vb Double not null, primary key (id))")
+    rows_a = ",".join(f"({i}, {i % 5}, {float(i)})" for i in range(40))
+    rows_b = ",".join(f"({i}, {i % 7}, {float(i) * 2})" for i in range(30))
+    e.execute(f"insert into fa (id, k, va) values {rows_a}")
+    e.execute(f"insert into fb (id, k, vb) values {rows_b}")
+    df = e.query("""select fa.k as k, count(*) as n, sum(va + vb) as s
+                    from fa, fb where fa.k = fb.k group by fa.k order by fa.k""")
+    a = pd.DataFrame({"k": np.arange(40) % 5, "va": np.arange(40.0)})
+    b = pd.DataFrame({"k": np.arange(30) % 7, "vb": np.arange(30.0) * 2})
+    m = a.merge(b, on="k")
+    want = m.assign(s=m.va + m.vb).groupby("k").agg(
+        n=("s", "size"), s=("s", "sum")).reset_index()
+    assert list(df.k) == list(want.k)
+    assert list(df.n) == list(want.n)
+    np.testing.assert_allclose(df.s, want.s, rtol=1e-9)

@@ -1,21 +1,25 @@
-"""Broadcast hash-join — TPU-native MapJoin.
+"""Broadcast hash-join — TPU-native MapJoin + duplicate-key expansion.
 
 The reference's broadcast join (`mkql_map_join.cpp` MapJoinCore) builds a
-host hash table and probes row-by-row. The TPU-native design replaces the
-probe with a fully vectorized binary search over a *sorted* build side:
+host hash table and probes row-by-row; GraceJoin (`mkql_grace_join.cpp`)
+handles duplicate keys by bucket partitioning. The TPU-native design
+replaces both probes with fully vectorized binary search over a *sorted*
+build side:
 
   * build (host, once per build table): sort build keys, keep the
     permutation — O(n log n) on small dimension tables;
-  * probe (device, per block): ``jnp.searchsorted`` (vectorized binary
-    search, log2(n) gathers) + one equality check + payload gathers.
-
-Duplicate build keys are rejected for inner/left probes (raises; the
-planner must route such joins to the partitioned GraceJoin path once it
-exists); semi/anti joins tolerate duplicates since they only test
-membership.
+  * unique-key probe (device, per block): ``jnp.searchsorted`` (vectorized
+    binary search, log2(n) gathers) + one equality check + payload gathers;
+  * duplicate-key probe (``probe_expand``): left/right searchsorted give
+    each probe row its matching build range [lo, hi); an exclusive
+    prefix-sum over the counts lays out the expanded output; one
+    host sync picks the output capacity bucket; a second program maps each
+    output slot back to (probe row, build row) with two searchsorted-style
+    gathers. This is the TPU analog of GraceJoin's duplicate handling —
+    expansion instead of per-bucket nested loops.
 
 Join kinds: inner, left, left_semi, left_anti (the kinds KQP plans emit for
-broadcast joins).
+broadcast joins), plus mark (match-flag attach, unique builds only).
 """
 
 from __future__ import annotations
@@ -192,3 +196,89 @@ def probe(dblock: DeviceBlock, table: BuildTable, probe_key: str,
     schema = Schema(cols)
     out = DeviceBlock(schema, arrays, valids, dblock.length, dblock.capacity, dicts)
     return out, out_sel
+
+
+# -- duplicate-key (expanding) probe ---------------------------------------
+
+@partial(jax.jit, static_argnames=("probe_key", "left"))
+def _expand_counts(probe_arrays, probe_valids, length, n_build, keys_sorted,
+                   probe_key, left: bool):
+    cap = probe_arrays[probe_key].shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    active = iota < length
+    enc = _probe_enc(probe_arrays[probe_key])
+    v = probe_valids.get(probe_key)
+    matchable = active if v is None else (active & v)
+
+    lo = jnp.searchsorted(keys_sorted, enc, side="left").astype(jnp.int32)
+    hi = jnp.searchsorted(keys_sorted, enc, side="right").astype(jnp.int32)
+    # sentinel padding (+inf / INT64_MAX) must not count as matches
+    lo = jnp.minimum(lo, n_build)
+    hi = jnp.minimum(hi, n_build)
+    mcounts = jnp.where(matchable, hi - lo, 0)
+    counts = jnp.where(active, jnp.maximum(mcounts, 1), 0) if left \
+        else mcounts
+    offsets = jnp.cumsum(counts) - counts          # exclusive prefix sum
+    total = jnp.sum(counts)
+    return lo, mcounts, counts, offsets, total
+
+
+@partial(jax.jit, static_argnames=("kind", "payload_names", "out_cap"))
+def _expand_gather(probe_arrays, probe_valids, lo, mcounts, offsets, total,
+                   payload, payload_valid, kind: str, payload_names: tuple,
+                   out_cap: int):
+    cap = lo.shape[0]
+    j = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, cap - 1)
+    k = j - offsets[row]
+    padded = next(iter(payload.values())).shape[0] if payload else cap
+    bidx = jnp.clip(lo[row] + k, 0, padded - 1)
+    live = j < total
+    found = (mcounts[row] > 0) & live
+
+    out_arrays = {n: a[row] for n, a in probe_arrays.items()}
+    out_valids = {n: v[row] for n, v in probe_valids.items()}
+    for n in payload_names:
+        out_arrays[n] = payload[n][bidx]
+        pv = payload_valid.get(n)
+        out_valids[n] = found if pv is None else (found & pv[bidx])
+    return out_arrays, out_valids
+
+
+def probe_expand(dblock: DeviceBlock, table: BuildTable, probe_key: str,
+                 kind: str = "inner",
+                 rename: Optional[dict] = None) -> DeviceBlock:
+    """Join a device block against a build table with duplicate keys.
+
+    Returns a NEW compacted DeviceBlock whose capacity is the bucket for
+    the expanded row count (inner: one output row per probe×build match;
+    left: additionally one null-extended row per unmatched probe row).
+    One device→host sync decides the capacity bucket.
+    """
+    assert kind in ("inner", "left"), kind
+    rename = rename or {}
+    lo, mcounts, counts, offsets, total = _expand_counts(
+        dblock.arrays, dblock.valids, dblock.length, jnp.int32(table.n),
+        table.keys_sorted, probe_key, kind == "left")
+    n_out = int(total)                     # sync point (capacity decision)
+    out_cap = bucket_capacity(max(n_out, 1), minimum=128)
+    names = tuple(table.schema.names)
+    payload = {rename.get(n, n): table.payload[n] for n in names}
+    payload_valid = {rename.get(n, n): v for n, v in
+                     table.payload_valid.items()}
+    out_names = tuple(rename.get(n, n) for n in names)
+    out_arrays, out_valids = _expand_gather(
+        dblock.arrays, dblock.valids, lo, mcounts, offsets, total,
+        payload, payload_valid, kind, out_names, out_cap)
+
+    dicts = dict(dblock.dictionaries)
+    cols = [c for c in dblock.schema.columns if c.name not in out_names]
+    for n in names:
+        out_name = rename.get(n, n)
+        dt = table.schema.dtype(n).with_nullable(True)
+        cols.append(Column(out_name, dt))
+        if n in table.dictionaries:
+            dicts[out_name] = table.dictionaries[n]
+    return DeviceBlock(Schema(cols), out_arrays, out_valids,
+                       jnp.int32(n_out), out_cap, dicts)

@@ -166,6 +166,11 @@ class Executor:
             if kind == "join":
                 table = builds[bi]
                 bi += 1
+                if not table.unique and step.kind in ("inner", "left"):
+                    # duplicate build keys → expanding probe (GraceJoin
+                    # analog); output is already compact
+                    d = J.probe_expand(d, table, step.probe_key, step.kind)
+                    continue
                 d, sel = J.probe(d, table, step.probe_key, step.kind,
                                  sel=None, mark_col=step.mark_col or None,
                                  not_in=step.not_in)

@@ -45,6 +45,7 @@ class QueryEngine:
         self._plan_cache: dict = {}
         self._epoch = 0
         self.plan_cache_hits = 0
+        self._tmp_n = 0
 
     # -- versions (standing in for coordinator/mediator time) -------------
 
@@ -61,6 +62,8 @@ class QueryEngine:
         stmt = parse(sql)
         try:
             if isinstance(stmt, ast.Select):
+                if self._needs_materialize(stmt):
+                    return self._execute_materialized(stmt)
                 cached = self._plan_cache.get(sql)
                 if cached is not None and cached[0] == self._epoch:
                     plan = cached[1]
@@ -94,6 +97,132 @@ class QueryEngine:
     def query(self, sql: str):
         """Execute and return a pandas DataFrame (tests / CLI)."""
         return self.execute(sql).to_pandas()
+
+    # -- CTE / derived-table materialization -------------------------------
+    #
+    # WITH bodies and FROM subqueries materialize into transient column
+    # tables before the outer statement plans — the stage-materialization
+    # strategy of DQ precompute stages (`dq_opt_phy_finalizing.cpp`
+    # DqBuildStages: a stage result becomes the next stage's source).
+
+    def _needs_materialize(self, sel: ast.Select) -> bool:
+        if sel.ctes:
+            return True
+
+        def rel_has(r):
+            if isinstance(r, ast.SubqueryRef):
+                return True
+            if isinstance(r, ast.Join):
+                return rel_has(r.left) or rel_has(r.right)
+            return False
+
+        def expr_has(e):
+            if e is None or not hasattr(e, "__dataclass_fields__"):
+                return False
+            if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+                sub = self._needs_materialize(e.query)
+                if isinstance(e, ast.InSubquery):
+                    return sub or expr_has(e.arg)
+                return sub
+
+            def any_in(v):
+                if isinstance(v, tuple):
+                    return any(any_in(x) for x in v)
+                return expr_has(v)
+
+            return any(any_in(getattr(e, f))
+                       for f in e.__dataclass_fields__)
+
+        if sel.relation is not None and rel_has(sel.relation):
+            return True
+        for e in ([i.expr for i in sel.items] + [sel.where, sel.having]
+                  + list(sel.group_by) + [o.expr for o in sel.order_by]):
+            if expr_has(e):
+                return True
+        return False
+
+    def _execute_materialized(self, sel: ast.Select) -> HostBlock:
+        temps: list = []
+        try:
+            sel2 = self._rewrite_sel(sel, {}, temps)
+            plan = self.planner.plan_select(sel2)
+            return self.executor.execute(plan, self.snapshot())
+        finally:
+            for t in temps:
+                if self.catalog.has(t):
+                    self.catalog.drop_table(t)
+
+    def _rewrite_sel(self, sel: ast.Select, cte_map: dict,
+                     temps: list) -> ast.Select:
+        cte_map = dict(cte_map)
+        for (name, body) in sel.ctes:
+            cte_map[name] = self._materialize(
+                self._rewrite_sel(body, cte_map, temps), temps)
+
+        def rewrite_rel(r):
+            if isinstance(r, ast.TableRef):
+                t = cte_map.get(r.name)
+                if t is not None:
+                    return ast.TableRef(t, r.alias or r.name)
+                return r
+            if isinstance(r, ast.Join):
+                return ast.Join(r.kind, rewrite_rel(r.left),
+                                rewrite_rel(r.right),
+                                rewrite_expr(r.on))
+            if isinstance(r, ast.SubqueryRef):
+                t = self._materialize(
+                    self._rewrite_sel(r.query, cte_map, temps), temps)
+                return ast.TableRef(t, r.alias)
+            return r
+
+        def rewrite_expr(e):
+            import dataclasses
+            if e is None or not hasattr(e, "__dataclass_fields__"):
+                return e
+            if isinstance(e, (ast.Exists, ast.InSubquery,
+                              ast.ScalarSubquery)):
+                kw = {"query": self._rewrite_sel(e.query, cte_map, temps)}
+                if isinstance(e, ast.InSubquery):
+                    kw["arg"] = rewrite_expr(e.arg)
+                return dataclasses.replace(e, **kw)
+
+            def rw(v):
+                if isinstance(v, tuple):
+                    return tuple(rw(x) for x in v)
+                return rewrite_expr(v)
+
+            kw = {f: rw(getattr(e, f)) for f in e.__dataclass_fields__}
+            return dataclasses.replace(e, **kw)
+
+        out = ast.Select(**{**sel.__dict__})
+        out.ctes = []
+        if out.relation is not None:
+            out.relation = rewrite_rel(out.relation)
+        out.where = rewrite_expr(out.where)
+        out.having = rewrite_expr(out.having)
+        out.items = [ast.SelectItem(rewrite_expr(i.expr), i.alias)
+                     for i in out.items]
+        out.group_by = [rewrite_expr(g) for g in out.group_by]
+        out.order_by = [ast.OrderItem(rewrite_expr(o.expr), o.ascending,
+                                      o.nulls_first) for o in out.order_by]
+        return out
+
+    def _materialize(self, sel: ast.Select, temps: list) -> str:
+        block = self.executor.execute(self.planner.plan_select(sel),
+                                      self.snapshot())
+        tname = f"__tmp{self._tmp_n}"
+        self._tmp_n += 1
+        t = self.catalog.create_table(tname, block.schema,
+                                      [block.schema.names[0]], shards=1)
+        t.dictionaries = {n: cd.dictionary
+                          for n, cd in block.columns.items()
+                          if cd.dictionary is not None}
+        if block.length:
+            t.commit(t.write(block), self._next_version())
+            for s in t.shards:
+                s.indexate()
+        temps.append(tname)
+        return tname
 
     # -- DDL / DML ---------------------------------------------------------
 

@@ -177,8 +177,6 @@ class Planner:
         self._jk_counter = 0
 
         rels, join_conds, left_joins = self._flatten_relations(sel.relation)
-        if left_joins:
-            raise PlanError("outer joins not supported yet")
         scope = B.Scope()
         for r in rels.values():
             for col in r.table.schema:
@@ -186,9 +184,55 @@ class Planner:
                 scope.add(r.alias, col.name, B.ColumnBinding(
                     internal, col.dtype,
                     r.table.dictionaries.get(col.name)))
+        # left-joined relations: columns visible (nullable — the join may
+        # null-extend), but OUTSIDE the inner-join spanning tree
+        self._left_specs = []
+        self._left_post_preds: list = []
+        for (tref, on) in left_joins:
+            alias = tref.alias or tref.name
+            if alias in rels or any(s["alias"] == alias
+                                    for s in self._left_specs):
+                raise PlanError(f"duplicate alias {alias}")
+            table = self.catalog.table(tref.name)
+            for col in table.schema:
+                scope.add(alias, col.name, B.ColumnBinding(
+                    f"{alias}.{col.name}", col.dtype.with_nullable(True),
+                    table.dictionaries.get(col.name)))
+            self._left_specs.append({"alias": alias, "table": table,
+                                     "tref": tref, "on": on})
         self.scope = scope
         self.pool = pool
         binder = B.ExprBinder(scope, pool)
+        left_aliases = {s["alias"] for s in self._left_specs}
+
+        # classify each left join's ON conjuncts: equi pair vs build-local
+        for spec in self._left_specs:
+            alias = spec["alias"]
+            pairs, local = [], []
+            if spec["on"] is None:
+                raise PlanError("LEFT JOIN requires an ON clause")
+            for c in conjuncts(spec["on"]):
+                aliases = self._pred_aliases(c, rels, scope)
+                if aliases <= {alias}:
+                    local.append(c)
+                    continue
+                ok = (isinstance(c, ast.BinOp) and c.op == "="
+                      and isinstance(c.left, ast.Name)
+                      and isinstance(c.right, ast.Name))
+                if ok:
+                    la = self._name_alias(c.left, rels, scope)
+                    ra = self._name_alias(c.right, rels, scope)
+                    if la == alias and ra not in left_aliases:
+                        pairs.append((c.right, c.left))
+                        continue
+                    if ra == alias and la not in left_aliases:
+                        pairs.append((c.left, c.right))
+                        continue
+                raise PlanError(f"unsupported LEFT JOIN condition {c!r}")
+            if len(pairs) != 1:
+                raise PlanError("LEFT JOIN needs exactly one equi-join "
+                                "condition (composite keys not yet)")
+            spec["pair"], spec["local"] = pairs[0], local
 
         # classify predicates ((a∧x)∨(a∧y) → a∧(x∨y) first: surfaces
         # join conditions buried in OR branches, e.g. TPC-H Q19)
@@ -217,6 +261,11 @@ class Planner:
         residuals: list = []
         for p in preds:
             aliases = self._pred_aliases(p, rels, scope)
+            if aliases & left_aliases:
+                # WHERE over a null-extended side filters AFTER the left
+                # join (standard SQL: ON extends, WHERE restricts)
+                self._left_post_preds.append(p)
+                continue
             if len(aliases) <= 1:
                 alias = next(iter(aliases), None)
                 if alias is None:
@@ -286,6 +335,10 @@ class Planner:
             residuals.append(ast.BinOp("=", lname, rname))
         for p in residuals:
             self._demand(p, needed)
+        for spec in self._left_specs:
+            self._demand(spec["pair"][0], needed)
+        for p in self._left_post_preds:
+            self._demand(p, needed)
 
         pipeline = self._build_pipeline(fact, rels, children, needed,
                                         binder, top=True)
@@ -296,6 +349,9 @@ class Planner:
             for p in residuals:
                 prog.filter(binder.bind(p))
             pipeline.steps.append(("program", prog))
+
+        # null-extending (left outer) joins + their post-join filters
+        self._attach_left_joins(pipeline, binder, needed)
 
         # semi/anti/scalar subquery joins + their filters
         self._attach_sub_specs(pipeline, binder)
@@ -328,13 +384,27 @@ class Planner:
                     if r.on is not None:
                         conds.extend(conjuncts(r.on))
                 elif r.kind == "left":
-                    left_joins.append(r)
                     walk(r.left)
+                    # the nullable side stays OUT of the inner-join tree; it
+                    # becomes a null-extending build fragment attached after
+                    # the inner pipeline (`CommonJoinCore` left semantics)
+                    if not isinstance(r.right, ast.TableRef):
+                        raise PlanError("LEFT JOIN right side must be a "
+                                        "table (materialize subqueries "
+                                        "first)")
+                    left_joins.append((r.right, r.on))
+                elif r.kind == "right":
+                    # A RIGHT JOIN B == B LEFT JOIN A
                     walk(r.right)
+                    if not isinstance(r.left, ast.TableRef):
+                        raise PlanError("RIGHT JOIN left side must be a "
+                                        "table")
+                    left_joins.append((r.left, r.on))
                 else:
                     raise PlanError(f"{r.kind} join not supported yet")
             elif isinstance(r, ast.SubqueryRef):
-                raise PlanError("FROM subqueries not supported yet")
+                raise PlanError("FROM subqueries must be materialized by "
+                                "the engine before planning")
             else:
                 raise PlanError(f"bad relation {r!r}")
 
@@ -513,6 +583,50 @@ class Planner:
                 continue   # dictionary codes are unordered
             scan.prune.append((storage, op, val))
 
+    # -- left outer joins --------------------------------------------------
+
+    def _attach_left_joins(self, pipeline, binder: B.ExprBinder,
+                           needed: set) -> None:
+        """Append a null-extending build fragment per LEFT JOIN: the right
+        side plans as its own (filtered) subquery whose output labels are
+        the internal `alias.col` names, so payload columns land in the
+        outer scope's namespace. Duplicate build keys take the expanding
+        probe automatically."""
+        for spec in self._left_specs:
+            alias = spec["alias"]
+            probe_ast, build_name = spec["pair"]
+            build_col = build_name.parts[-1]
+            right_cols = sorted({n.split(".", 1)[1] for n in needed
+                                 if n.startswith(alias + ".")}
+                                | {build_col})
+            items = [ast.SelectItem(ast.Name((alias, col)), f"{alias}.{col}")
+                     for col in right_cols]
+            sub = ast.Select(items=items,
+                             relation=ast.TableRef(spec["tref"].name, alias),
+                             where=_and_fold(spec["local"]))
+            jplan = self._plan_inner(sub)
+
+            e = binder.bind(probe_ast)
+            if isinstance(e, ir.Col):
+                probe_key = e.name
+            else:
+                probe_key = f"__lj{self._jk_counter}"
+                self._jk_counter += 1
+                pre = ir.Program().assign(probe_key, e)
+                pipeline.steps.append(("program", pre))
+            payload = [f"{alias}.{c}" for c in right_cols]
+            js = JoinStep(jplan, f"{alias}.{build_col}", probe_key, "left",
+                          payload)
+            pipeline.steps.append(("join", js))
+            pipeline.out_names.extend(
+                c for c in payload if c not in pipeline.out_names)
+
+        if self._left_post_preds:
+            prog = ir.Program()
+            for p in self._left_post_preds:
+                prog.filter(binder.bind(p))
+            pipeline.steps.append(("program", prog))
+
     # -- subqueries --------------------------------------------------------
 
     def _inner_scope(self, inner_sel: ast.Select):
@@ -526,13 +640,16 @@ class Planner:
                     r.table.dictionaries.get(col.name)))
         return scope
 
-    def _split_correlations(self, inner_sel: ast.Select):
+    def _split_correlations(self, inner_sel: ast.Select,
+                            with_neq: bool = False):
         """Pull `inner_col = outer_col` conjuncts out of the subquery's
         WHERE (the equality-decorrelation the reference performs in logical
         optimization). Returns (inner select w/o them, [(inner_name_ast,
-        outer_name_ast)])."""
+        outer_name_ast)]) — plus, when `with_neq`, the list of
+        `inner_col <> outer_col` conjuncts as a third element (decorrelated
+        via the min/max trick in `_add_semi_spec`)."""
         inner_scope = self._inner_scope(inner_sel)
-        rest, pairs = [], []
+        rest, pairs, neqs = [], [], []
         for c in conjuncts(inner_sel.where):
             names: set = set()
             walk_names(c, names)
@@ -540,22 +657,25 @@ class Planner:
             if not outer:
                 rest.append(c)
                 continue
-            ok = (isinstance(c, ast.BinOp) and c.op == "="
+            ok = (isinstance(c, ast.BinOp) and c.op in ("=", "<>")
                   and isinstance(c.left, ast.Name)
                   and isinstance(c.right, ast.Name))
-            if not ok:
+            if not ok or (c.op == "<>" and not with_neq):
                 raise PlanError(
                     f"unsupported correlated predicate {c!r} (only "
                     "inner_col = outer_col correlation is decorrelated)")
+            dest = pairs if c.op == "=" else neqs
             if inner_scope.try_resolve(c.left.parts) is not None:
-                pairs.append((c.left, c.right))
+                dest.append((c.left, c.right))
             elif inner_scope.try_resolve(c.right.parts) is not None:
-                pairs.append((c.right, c.left))
+                dest.append((c.right, c.left))
             else:
                 raise PlanError(f"correlated predicate {c!r} references no "
                                 "subquery column")
         new_sel = ast.Select(**{**inner_sel.__dict__})
         new_sel.where = _and_fold(rest)
+        if with_neq:
+            return new_sel, pairs, neqs
         return new_sel, pairs
 
     def _expr_dtype(self, e: ast.Expr, scope: B.Scope):
@@ -693,8 +813,16 @@ class Planner:
 
     def _add_semi_spec(self, outer_exprs, inner_sel: ast.Select,
                        negated: bool, first_item_key: bool):
-        inner, pairs = self._split_correlations(inner_sel)
+        inner, pairs, neqs = self._split_correlations(inner_sel,
+                                                      with_neq=True)
         n = len(self._sub_specs) + len(self._init_subplans)
+        if neqs:
+            if first_item_key or not pairs:
+                raise PlanError("inner <> outer correlation needs an "
+                                "EXISTS with an equality correlation too")
+            if len(neqs) > 1:
+                raise PlanError("at most one <> correlation is supported")
+            return self._add_neq_semi_spec(inner, pairs, neqs[0], negated, n)
         items = []
         keys = []        # [(outer_ast_expr, build_label)]
         i = 0
@@ -749,6 +877,35 @@ class Planner:
                              for i, (_i, oname) in enumerate(pairs)]
         self._sub_specs.append(spec)
 
+    def _add_neq_semi_spec(self, inner: ast.Select, pairs, neq,
+                           negated: bool, n: int):
+        """EXISTS(... WHERE k = outer.k AND col <> outer.col): a row with a
+        different `col` exists in group k iff min(col) != outer.col OR
+        max(col) != outer.col (all-equal collapses min=max=outer). The
+        subquery groups by the equi keys with min/max aggregates; the
+        existence test becomes a mark join + verification filter."""
+        (neq_inner, neq_outer) = neq
+        items, keys = [], []
+        for i, (iname, oname) in enumerate(pairs):
+            lbl = f"__s{n}k{i}"
+            items.append(ast.SelectItem(iname, lbl))
+            keys.append((oname, lbl))
+        mn, mx = f"__s{n}mn", f"__s{n}mx"
+        items.append(ast.SelectItem(
+            ast.FuncCall("min", (neq_inner,)), mn))
+        items.append(ast.SelectItem(
+            ast.FuncCall("max", (neq_inner,)), mx))
+        sub_sel = ast.Select(
+            items=items, relation=inner.relation, where=inner.where,
+            group_by=[iname for (iname, _o) in pairs])
+        self._sub_specs.append({
+            "kind": "anti" if negated else "semi", "n": n,
+            "plan": self._plan_inner(sub_sel),
+            "keys": keys, "payload": [mn, mx],
+            "not_in": False,
+            "neq": (neq_outer, mn, mx),
+        })
+
     def _attach_sub_specs(self, pipeline, binder: B.ExprBinder):
         for spec in self._sub_specs:
             n = spec["n"]
@@ -757,6 +914,9 @@ class Planner:
             for (oexpr, _lbl) in spec["keys"]:
                 e = binder.bind(oexpr)
                 bound.append(e)
+            if spec.get("neq"):
+                self._attach_neq_spec(pipeline, spec, bound, binder, pre)
+                continue
             if len(spec["keys"]) == 1:
                 e = bound[0]
                 if isinstance(e, ir.Col):
@@ -819,6 +979,53 @@ class Planner:
             for p in self._post_preds:
                 prog.filter(binder.bind(p))
             pipeline.steps.append(("program", prog))
+
+    def _attach_neq_spec(self, pipeline, spec, bound, binder, pre):
+        """EXISTS / NOT EXISTS with a `col <> outer.col` correlation: mark
+        join against the per-key min/max aggregate, then verify
+        `min != outer OR max != outer` (coalesced to FALSE so NULL min/max
+        — empty or all-NULL groups — read as 'no differing row')."""
+        n = spec["n"]
+        key_labels = [lbl for (_o, lbl) in spec["keys"]]
+        mark = f"__s{n}m"
+        if len(bound) == 1:
+            e = bound[0]
+            if isinstance(e, ir.Col):
+                probe_key = e.name
+            else:
+                probe_key = f"__s{n}p"
+                pre.assign(probe_key, e)
+            if pre.commands:
+                pipeline.steps.append(("program", pre))
+            js = JoinStep(spec["plan"], key_labels[0], probe_key, "mark",
+                          list(spec["payload"]), mark_col=mark)
+            pipeline.steps.append(("join", js))
+            matched = ir.Col(mark)
+        else:
+            probe_key = f"__s{n}p"
+            hashed = [ir.call("hash64", e) for e in bound]
+            pre.assign(probe_key, hashed[0] if len(hashed) == 1
+                       else ir.call("hash_combine", *hashed))
+            pipeline.steps.append(("program", pre))
+            js = JoinStep(spec["plan"], f"__s{n}bh", probe_key, "mark",
+                          key_labels + list(spec["payload"]),
+                          mark_col=mark, build_hash_keys=key_labels)
+            pipeline.steps.append(("join", js))
+            matched = ir.Col(mark)
+            for e, lbl in zip(bound, key_labels):
+                matched = ir.call("and", matched,
+                                  ir.call("eq", e, ir.Col(lbl)))
+        (neq_outer, mn, mx) = spec["neq"]
+        o = binder.bind(neq_outer)
+        differs = ir.call("or", ir.call("ne", ir.Col(mn), o),
+                          ir.call("ne", ir.Col(mx), o))
+        exists_true = ir.call(
+            "coalesce", ir.call("and", matched, differs),
+            ir.Const(False, dt.DType(dt.Kind.BOOL, False)))
+        verify = ir.Program()
+        verify.filter(exists_true if spec["kind"] == "semi"
+                      else ir.call("not", exists_true))
+        pipeline.steps.append(("program", verify))
 
     def _attach_not_in_verify(self, pipeline, spec, bound, matched, n):
         """Correlated NOT IN (composite-key mark join): `x NOT IN S_k` is
@@ -969,6 +1176,27 @@ class Planner:
             key_specs.append((ge, e, name))
         key_names = [k[2] for k in key_specs]
 
+        # DISTINCT aggregates: dedup by (group keys + arg) in the partial
+        # and first-final GroupBys, then aggregate the arg in a second
+        # final GroupBy over the group keys alone. All distinct aggs must
+        # share one argument (one dedup dimension).
+        distinct_calls = [c for c in agg_calls if c.distinct]
+        dcol = None
+        final2_aggs: list = []
+        if distinct_calls:
+            if any(c.star or not c.args for c in distinct_calls):
+                raise PlanError("COUNT(DISTINCT *) is meaningless")
+            args = {repr(c.args[0]) for c in distinct_calls}
+            if len(args) != 1:
+                raise PlanError("DISTINCT aggregates over different "
+                                "arguments are not supported yet")
+            d_ir = binder.bind(distinct_calls[0].args[0])
+            if isinstance(d_ir, ir.Col):
+                dcol = d_ir.name
+            else:
+                dcol = "__dx"
+                partial.assign(dcol, d_ir)
+
         # aggregate instances (deduped by bound signature)
         agg_map: dict = {}          # signature -> dict describing partial/final
         partial_aggs: list = []
@@ -980,7 +1208,31 @@ class Planner:
         def register(call: ast.FuncCall) -> dict:
             nonlocal n
             if call.distinct:
-                raise PlanError("DISTINCT aggregates not supported yet")
+                sig = (call.name, "distinct", repr(call.args[0]))
+                inst = agg_map.get(sig)
+                if inst is not None:
+                    return inst
+                if sealed[0]:
+                    raise PlanError(
+                        f"aggregate {call.name} appeared only after the "
+                        "partial stage was sealed (planner bug)")
+                inst = {"func": call.name}
+                if call.name == "avg":
+                    s, c = f"agg{n}s", f"agg{n}c"; n += 1
+                    final2_aggs.append(ir.Agg(s, "sum", dcol))
+                    final2_aggs.append(ir.Agg(c, "count", dcol))
+                    inst["sum"], inst["count"] = s, c
+                else:
+                    out = f"agg{n}"; n += 1
+                    f = {"count": "count", "sum": "sum", "min": "min",
+                         "max": "max"}.get(call.name)
+                    if f is None:
+                        raise PlanError(
+                            f"DISTINCT {call.name} not supported")
+                    final2_aggs.append(ir.Agg(out, f, dcol))
+                    inst["col"] = out
+                agg_map[sig] = inst
+                return inst
             # dedup on the AST (bound IR is not stable: LUT params get
             # fresh names per binding)
             if call.star or not call.args:
@@ -1033,12 +1285,27 @@ class Planner:
             register(call)
 
         domains = self._key_domains(key_names)
-        partial.group_by(key_names, partial_aggs, domains)
         sealed[0] = True
-        plan.pipeline.partial = partial
-
-        # -- final stage: merge aggs, having, outputs, sort ---------------
-        final = ir.Program().group_by(key_names, final_aggs, domains)
+        if dcol is None:
+            partial.group_by(key_names, partial_aggs, domains)
+            plan.pipeline.partial = partial
+            # -- final stage: merge aggs, having, outputs, sort -----------
+            final = ir.Program().group_by(key_names, final_aggs, domains)
+        else:
+            ddom = self._key_domains([dcol])
+            partial.group_by(key_names + [dcol], partial_aggs,
+                             domains + ddom)
+            plan.pipeline.partial = partial
+            # first final GroupBy completes the global dedup by
+            # (keys + arg); the second collapses to the group keys, counting
+            # the deduplicated arg and re-merging the regular aggregates
+            # (associative, so the double merge is exact)
+            final = ir.Program().group_by(key_names + [dcol], final_aggs,
+                                          domains + ddom)
+            final.group_by(
+                key_names,
+                [ir.Agg(a.out, a.func, a.out) for a in final_aggs]
+                + final2_aggs, domains)
 
         planner = self
 

@@ -179,6 +179,7 @@ class Select:
     limit: Optional[int] = None
     offset: Optional[int] = None
     distinct: bool = False
+    ctes: list = field(default_factory=list)           # list[(name, Select)]
 
 
 @dataclass

@@ -69,7 +69,7 @@ class Parser:
     # -- statements --------------------------------------------------------
 
     def parse_statement(self) -> ast.Statement:
-        if self.at_kw("select"):
+        if self.at_kw("with") or self.at_kw("select"):
             stmt = self.parse_select()
         elif self.at_kw("create"):
             stmt = self.parse_create_table()
@@ -90,8 +90,19 @@ class Parser:
         return stmt
 
     def parse_select(self) -> ast.Select:
+        ctes = []
+        if self.accept_kw("with"):
+            while True:
+                name = self.ident()
+                self.expect_kw("as")
+                self.expect_op("(")
+                ctes.append((name, self.parse_select()))
+                self.expect_op(")")
+                if not self.accept_op(","):
+                    break
         self.expect_kw("select")
         sel = ast.Select()
+        sel.ctes = ctes
         if self.accept_kw("distinct"):
             sel.distinct = True
         sel.items = [self.select_item()]

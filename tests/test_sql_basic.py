@@ -257,3 +257,58 @@ def test_fact_fact_join_duplicate_keys():
     assert list(df.k) == list(want.k)
     assert list(df.n) == list(want.n)
     np.testing.assert_allclose(df.s, want.s, rtol=1e-9)
+
+
+def test_plan_cache_invalidated_by_bulk_upsert():
+    """Regression (ADVICE r2 high): bulk_upsert grows dictionaries without
+    invalidating cached plans, folding new groups into existing ones."""
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("""create table pc (id Int64 not null, tag Utf8 not null,
+                 v Double not null, primary key (id))""")
+    e.execute("insert into pc (id, tag, v) values (1, 'a', 1.0), (2, 'b', 2.0)")
+    q = "select tag, sum(v) as s from pc group by tag order by tag"
+    df = e.query(q)
+    assert list(df.tag) == ["a", "b"]
+    # ingest a brand-new tag through the bulk path (no engine-level DML)
+    t = e.catalog.table("pc")
+    t.bulk_upsert(pd.DataFrame({"id": [3], "tag": ["c"], "v": [3.0]}),
+                  e._next_version())
+    df2 = e.query(q)
+    assert list(df2.tag) == ["a", "b", "c"]
+    assert list(df2.s) == [1.0, 2.0, 3.0]
+
+
+def test_plan_cache_survives_other_table_writes():
+    """Writes to table B must not invalidate cached plans over table A."""
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("create table a (id Int64 not null, primary key (id))")
+    e.execute("create table b (id Int64 not null, primary key (id))")
+    e.execute("insert into a (id) values (1), (2)")
+    q = "select count(*) as n from a"
+    assert e.query(q).n[0] == 2
+    assert e.query(q).n[0] == 2
+    hits = e.plan_cache_hits
+    e.execute("insert into b (id) values (1)")
+    assert e.query(q).n[0] == 2
+    assert e.plan_cache_hits == hits + 1
+
+
+def test_exists_neq_correlation_demands_outer_column():
+    """Regression (ADVICE r2 medium): `inner <> outer` EXISTS decorrelation
+    must demand the outer neq column into the scan even when it is not
+    otherwise projected."""
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("""create table f (id Int64 not null, k Int64 not null,
+                 v Int64 not null, primary key (id))""")
+    e.execute("""create table d (id Int64 not null, k Int64 not null,
+                 w Int64 not null, primary key (id))""")
+    e.execute("insert into f (id, k, v) values (1, 10, 5), (2, 20, 7), (3, 30, 9)")
+    e.execute("""insert into d (id, k, w) values
+                 (1, 10, 5), (2, 10, 6), (3, 20, 7), (4, 40, 1)""")
+    # k=10: d has w in {5,6}, f.v=5 → a differing row exists → keep
+    # k=20: d has w {7}, f.v=7 → no differing row → drop
+    # k=30: no d rows → drop
+    df = e.query("""select f.id from f where exists
+                    (select 1 from d where d.k = f.k and d.w <> f.v)
+                    order by f.id""")
+    assert list(df.id) == [1]

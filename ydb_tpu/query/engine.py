@@ -40,10 +40,11 @@ class QueryEngine:
         self._plan_step = 1
         self._tx_id = 1
         # plan cache (compile-service LRU analog, `kqp_compile_service.cpp:411`):
-        # keyed by SQL text + catalog epoch — any DDL/DML bumps the epoch
-        # because plans snapshot dictionary domains at plan time
+        # keyed by SQL text, validated against the (uid, data_version) of
+        # every table the statement references — plans snapshot dictionary
+        # domains at plan time, so any commit to a referenced table
+        # invalidates only that statement's entry, not the whole cache
         self._plan_cache: dict = {}
-        self._epoch = 0
         self.plan_cache_hits = 0
         self._tmp_n = 0
 
@@ -64,25 +65,23 @@ class QueryEngine:
             if isinstance(stmt, ast.Select):
                 if self._needs_materialize(stmt):
                     return self._execute_materialized(stmt)
+                fp = self._table_fingerprint(stmt)
                 cached = self._plan_cache.get(sql)
-                if cached is not None and cached[0] == self._epoch:
+                if cached is not None and cached[0] == fp:
                     plan = cached[1]
                     self.plan_cache_hits += 1
                 else:
                     plan = self.planner.plan_select(stmt)
-                    self._plan_cache[sql] = (self._epoch, plan)
+                    self._plan_cache[sql] = (fp, plan)
                 return self.executor.execute(plan, self.snapshot())
             if isinstance(stmt, ast.CreateTable):
-                self._epoch += 1
                 return self._create_table(stmt)
             if isinstance(stmt, ast.DropTable):
                 if stmt.if_exists and not self.catalog.has(stmt.name):
                     return _unit_block()
-                self._epoch += 1
                 self.catalog.drop_table(stmt.name)
                 return _unit_block()
             if isinstance(stmt, ast.Insert):
-                self._epoch += 1
                 return self._insert(stmt)
             raise QueryError(f"unsupported statement {type(stmt).__name__}")
         except (BindError, PlanError) as e:
@@ -97,6 +96,57 @@ class QueryEngine:
     def query(self, sql: str):
         """Execute and return a pandas DataFrame (tests / CLI)."""
         return self.execute(sql).to_pandas()
+
+    def _table_fingerprint(self, sel: ast.Select):
+        """(name, uid, data_version) of every table the statement touches —
+        the plan-cache validity key (reference keys its compile cache on
+        query text + schema version, `kqp_compile_service.cpp:411`)."""
+        names: set = set()
+
+        def walk_sel(s: ast.Select):
+            for (_n, body) in s.ctes:
+                walk_sel(body)
+            if s.relation is not None:
+                walk_rel(s.relation)
+            for e in ([i.expr for i in s.items] + [s.where, s.having]
+                      + list(s.group_by) + [o.expr for o in s.order_by]):
+                walk_expr(e)
+
+        def walk_rel(r):
+            if isinstance(r, ast.TableRef):
+                names.add(r.name)
+            elif isinstance(r, ast.Join):
+                walk_rel(r.left)
+                walk_rel(r.right)
+                walk_expr(r.on)
+            elif isinstance(r, ast.SubqueryRef):
+                walk_sel(r.query)
+
+        def walk_expr(e):
+            if e is None or not hasattr(e, "__dataclass_fields__"):
+                return
+            if isinstance(e, (ast.Exists, ast.InSubquery, ast.ScalarSubquery)):
+                walk_sel(e.query)
+                if isinstance(e, ast.InSubquery):
+                    walk_expr(e.arg)
+                return
+            def walk_val(v):
+                if isinstance(v, tuple):
+                    for x in v:
+                        walk_val(x)
+                else:
+                    walk_expr(v)
+
+            for f in e.__dataclass_fields__:
+                walk_val(getattr(e, f))
+
+        walk_sel(sel)
+        out = []
+        for n in sorted(names):
+            if self.catalog.has(n):
+                t = self.catalog.table(n)
+                out.append((n, t.uid, t.data_version))
+        return tuple(out)
 
     # -- CTE / derived-table materialization -------------------------------
     #

@@ -309,6 +309,8 @@ class Planner:
         for spec in self._sub_specs:
             for (oexpr, _lbl) in spec["keys"]:
                 self._demand(oexpr, needed)
+            if spec.get("neq"):
+                self._demand(spec["neq"][0], needed)
         for p in self._post_preds:
             self._demand(p, needed)
 

@@ -21,6 +21,8 @@ from ydb_tpu.storage.mvcc import MAX_SNAPSHOT, Snapshot, WriteVersion
 from ydb_tpu.storage.shard import ColumnShard
 from ydb_tpu.utils.hashing import splitmix64
 
+_table_uids = iter(range(1, 2 ** 62))
+
 
 class ColumnTable:
     def __init__(self, name: str, schema: Schema, key_columns: list[str],
@@ -38,6 +40,12 @@ class ColumnTable:
         self.shards = [ColumnShard(schema, i, portion_rows) for i in range(shards)]
         self.dictionaries: dict[str, Dictionary] = {
             c.name: Dictionary() for c in schema if c.dtype.is_string}
+        # data_version: bumped on every commit — cached plans snapshot
+        # dictionary domains, so the plan cache keys on (uid, data_version)
+        # per referenced table (the compile-cache schema-version key of
+        # `kqp_compile_service.cpp:411`). uid distinguishes drop/recreate.
+        self.uid = next(_table_uids)
+        self.data_version = 0
 
     @property
     def num_shards(self) -> int:
@@ -73,6 +81,7 @@ class ColumnTable:
             by_shard.setdefault(sid, []).append(wid)
         for sid, wids in by_shard.items():
             self.shards[sid].commit(wids, version)
+        self.data_version += 1
 
     def bulk_upsert(self, df, version: WriteVersion) -> int:
         """Ingest a pandas DataFrame (BulkUpsert analog): write+commit+indexate."""

@@ -312,3 +312,17 @@ def test_exists_neq_correlation_demands_outer_column():
                     (select 1 from d where d.k = f.k and d.w <> f.v)
                     order by f.id""")
     assert list(df.id) == [1]
+
+
+def test_float_probe_key_join_not_truncated():
+    """Regression (r3 review): the fused LUT probe must not truncate float
+    probe keys to int (10.5 must NOT match build key 10)."""
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("""create table ff (id Int64 not null, x Double not null,
+                 primary key (id))""")
+    e.execute("create table dd (k Int64 not null, w Int64 not null, primary key (k))")
+    e.execute("insert into ff (id, x) values (1, 10.5), (2, 20.0)")
+    e.execute("insert into dd (k, w) values (10, 100), (20, 200)")
+    df = e.query("select ff.id, dd.w from ff join dd on ff.x = dd.k order by ff.id")
+    assert list(df.id) == [2]
+    assert list(df.w) == [200]

@@ -1,0 +1,164 @@
+"""Whole-query fused execution — ONE XLA dispatch per query.
+
+The reference streams blocks through a chain of separately-scheduled
+operators (scan actor → block comp nodes → channels,
+`dq_compute_actor_impl.h:295`). On this TPU platform every dispatch after
+the first device→host readout pays a large fixed tunnel latency (PERF.md),
+so the fused path compiles the ENTIRE single-node query — scan over all
+portions, pushdown filters, broadcast-join probes, aggregation, HAVING,
+output expressions, ORDER BY, LIMIT — into one `jax.jit` program:
+
+  * scan sources arrive as stacked (K, CAP) "superblocks" per column
+    (`DeviceColumnCache.superblock`), flattened to one K·CAP row vector
+    with a per-row activity mask (no data-dependent shapes);
+  * filters thread a selection mask between programs (`TColumnFilter`
+    semantics) — nothing compresses until after aggregation;
+  * joins probe via direct-address LUTs (`ops/join.py:probe_lut_traced`) —
+    one fused gather per probe, no binary-search loops;
+  * GroupBy uses the scatter-free paths of `ops/xla_exec.py`.
+
+A query therefore costs one dispatch + one result readout in the steady
+state, versus O(portions × operators) dispatches for the unfused path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.ops import ir
+from ydb_tpu.ops.device import bucket_capacity
+from ydb_tpu.ops.join import probe_lut_traced
+from ydb_tpu.ops.sort import sort_env
+from ydb_tpu.ops.xla_exec import _eval, _trace_program, compress
+
+
+def build_fused_fn(pipe, final_program: Optional[ir.Program],
+                   scan_cols: list, K: int, CAP: int,
+                   sb_valid_names: frozenset, join_metas: list,
+                   rank_assigns: list, sort_spec: tuple,
+                   limit: Optional[int], offset: Optional[int],
+                   keep: tuple):
+    """Compile the full single-node query pipeline into one jitted fn.
+
+    scan_cols: [Column] of the flattened scan env (internal names).
+    join_metas: per join step, the static meta dict for
+    `probe_lut_traced` plus "payload_cols" ([Column] appended to the
+    schema by the probe).
+
+    Returns (fn, out_schema); fn(sb, sbv, lengths, builds, params) →
+    (out_d, out_v, length)."""
+    lim2 = None if limit is None else limit + (offset or 0)
+
+    @jax.jit
+    def fn(sb, sbv, lengths, builds, params):
+        cap = K * CAP
+        env = {}
+        for c in scan_cols:
+            d = sb[c.name].reshape(cap)
+            v = sbv[c.name].reshape(cap) if c.name in sb_valid_names else None
+            env[c.name] = (d, v)
+        sel = (jnp.arange(CAP, dtype=jnp.int32)[None, :]
+               < lengths[:, None]).reshape(cap)
+        length = jnp.int32(cap)
+        schema = Schema(list(scan_cols))
+
+        def run(prog, env, length, sel, schema, cap):
+            env, length, sel, schema = _trace_program(
+                prog, schema.columns, cap, env, length, params, sel=sel)
+            if env:
+                cap = next(iter(env.values()))[0].shape[0]
+            return env, length, sel, schema, cap
+
+        if pipe.pre_program is not None:
+            env, length, sel, schema, cap = run(pipe.pre_program, env,
+                                                length, sel, schema, cap)
+        bi = 0
+        for kind, step in pipe.steps:
+            if kind == "join":
+                meta = join_metas[bi]
+                env, sel = probe_lut_traced(env, sel, builds[bi], meta)
+                bi += 1
+                cols = [c for c in schema.columns
+                        if c.name not in {p.name for p in meta["payload_cols"]}]
+                schema = Schema(cols + list(meta["payload_cols"]))
+            else:
+                env, length, sel, schema, cap = run(step, env, length, sel,
+                                                    schema, cap)
+        if pipe.partial is not None:
+            env, length, sel, schema, cap = run(pipe.partial, env, length,
+                                                sel, schema, cap)
+        if final_program is not None:
+            env, length, sel, schema, cap = run(final_program, env, length,
+                                                sel, schema, cap)
+        if sel is not None:
+            env, length = compress(env, length, sel, cap)
+
+        for a in rank_assigns:
+            env[a.name] = _eval(a.expr, env, params, cap)
+        if sort_spec:
+            arrays = {n: d for n, (d, _v) in env.items()}
+            valids = {n: v for n, (d, v) in env.items() if v is not None}
+            arrays2, valids2, length = sort_env(
+                arrays, valids, length, None, sort_spec,
+                tuple(arrays.keys()))
+            env = {n: (arrays2[n], valids2.get(n)) for n in arrays2}
+        if lim2 is not None:
+            length = jnp.minimum(length, jnp.int32(lim2))
+            out_cap = min(bucket_capacity(lim2, minimum=128), cap)
+            env = {n: (d[:out_cap], v[:out_cap] if v is not None else None)
+                   for n, (d, v) in env.items()}
+        out_names = [n for n in keep if n in env] or list(env.keys())
+        out_d = {n: env[n][0] for n in out_names}
+        out_v = {n: env[n][1] for n in out_names if env[n][1] is not None}
+        return out_d, out_v, length
+
+    return fn
+
+
+def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,
+                    sort_spec, rank_assigns, param_names):
+    pipe = plan.pipeline
+    progs = []
+    if pipe.pre_program is not None:
+        progs.append(pipe.pre_program.fingerprint())
+    for kind, step in pipe.steps:
+        if kind == "join":
+            progs.append(("join", step.probe_key, step.kind,
+                          tuple(step.payload), step.mark_col, step.not_in))
+        else:
+            progs.append(step.fingerprint())
+    if pipe.partial is not None:
+        progs.append(pipe.partial.fingerprint())
+    if plan.final_program is not None:
+        progs.append(plan.final_program.fingerprint())
+    return (tuple(progs),
+            tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
+                  for c in scan_cols),
+            K, CAP, tuple(sorted(sb_valid_names)), builds_sig,
+            sort_spec,
+            ir.Program(rank_assigns).fingerprint() if rank_assigns else "",
+            plan.limit, plan.offset,
+            tuple(n for (n, _lbl) in plan.output), tuple(param_names))
+
+
+def build_inputs_sig(bt) -> tuple:
+    """Shape signature of a BuildTable's traced inputs."""
+    return (bt.lut.shape[0],
+            next(iter(bt.payload.values())).shape[0] if bt.payload else 0,
+            tuple(sorted(bt.payload)), tuple(sorted(bt.payload_valid)))
+
+
+def build_traced_inputs(bt) -> dict:
+    """The traced-input pytree for one BuildTable."""
+    return {
+        "lut": bt.lut,
+        "lut_base": jnp.int64(bt.lut_base),
+        "n": jnp.int32(bt.n),
+        "payload": dict(bt.payload),
+        "pvalid": dict(bt.payload_valid),
+    }

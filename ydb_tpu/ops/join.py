@@ -50,9 +50,19 @@ def _host_key(block: HostBlock, name: str) -> tuple[np.ndarray, Optional[np.ndar
     return d.astype(np.int64), cd.valid
 
 
+_LUT_SPAN_BUDGET = 1 << 26         # max direct-address entries (256MB int32)
+
+
 @dataclass
 class BuildTable:
-    """Sorted build side, resident on device."""
+    """Sorted build side, resident on device.
+
+    When the key is integral with a bounded span, a direct-address lookup
+    table maps (key - lut_base) → sorted build row (-1 = absent), so a probe
+    is ONE fused gather instead of a binary search (`jnp.searchsorted`
+    lowers to a serializing scan loop on this platform — see PERF.md).
+    With duplicate keys the LUT holds the FIRST sorted row of the key
+    run (existence checks — semi/anti/mark — stay LUT-probeable)."""
     keys_sorted: object            # jnp int64 (padded with INT64_MAX)
     n: int                         # real build rows
     payload: dict                  # name -> jnp array (sorted by key)
@@ -60,6 +70,8 @@ class BuildTable:
     schema: Schema                 # payload schema
     dictionaries: dict
     unique: bool
+    lut: object = None             # jnp int32 (span,) or None
+    lut_base: int = 0              # key value of lut[0]
 
 
 def build(block: HostBlock, key: str, payload_names: list[str]) -> BuildTable:
@@ -76,6 +88,23 @@ def build(block: HostBlock, key: str, payload_names: list[str]) -> BuildTable:
     sentinel = np.inf if enc.dtype == np.float64 else np.iinfo(np.int64).max
     keys_pad = np.full(cap, sentinel, dtype=enc.dtype)
     keys_pad[:len(enc)] = enc
+
+    lut = None
+    lut_base = 0
+    if enc.dtype != np.float64 and len(enc):
+        lo, hi = int(enc[0]), int(enc[-1])
+        span = hi - lo + 1
+        if 0 < span <= max(1 << 12, min(_LUT_SPAN_BUDGET, 8 * len(enc))):
+            span_cap = bucket_capacity(span, minimum=1024)
+            lut_np = np.full(span_cap, -1, np.int32)
+            offs = (enc - lo).astype(np.int64)
+            # first sorted row of each key run wins (reversed assignment:
+            # numpy keeps the last write, which is the run's first row)
+            lut_np[offs[::-1]] = np.arange(len(enc) - 1, -1, -1,
+                                           dtype=np.int32)
+            lut = jnp.asarray(lut_np)
+            lut_base = lo
+
     payload, payload_valid, dicts = {}, {}, {}
     for name in payload_names:
         cd = block.columns[name]
@@ -88,7 +117,8 @@ def build(block: HostBlock, key: str, payload_names: list[str]) -> BuildTable:
         if cd.dictionary is not None:
             dicts[name] = cd.dictionary
     return BuildTable(jnp.asarray(keys_pad), len(enc), payload, payload_valid,
-                      block.schema.select(payload_names), dicts, unique)
+                      block.schema.select(payload_names), dicts, unique,
+                      lut, lut_base)
 
 
 def place(table: BuildTable, device) -> BuildTable:
@@ -99,7 +129,56 @@ def place(table: BuildTable, device) -> BuildTable:
         put(table.keys_sorted), table.n,
         {k: put(v) for k, v in table.payload.items()},
         {k: put(v) for k, v in table.payload_valid.items()},
-        table.schema, table.dictionaries, table.unique)
+        table.schema, table.dictionaries, table.unique,
+        None if table.lut is None else put(table.lut), table.lut_base)
+
+
+def probe_lut_traced(env: dict, sel, bt_arrays: dict, meta: dict):
+    """LUT probe, callable inside a fused query trace (`ops/fused.py`).
+
+    env: {name: (data, valid|None)}; sel: bool selection mask — REQUIRED,
+    and must already include the row-activity mask (`iota < length`; the
+    fused pipeline threads it instead of compressing, so there is no
+    separate length here); bt_arrays: traced build inputs {lut, lut_base,
+    n, payload.<name>, pvalid.<name>}; meta (static): probe_key, kind,
+    payload_names (post-rename), src_names, mark_col, not_in.
+
+    Returns (env', sel'). Selection semantics match `_probe`: matched rows
+    selected for inner/semi, unmatched for anti, all for left/mark."""
+    if sel is None:
+        raise ValueError("probe_lut_traced needs the row-activity mask")
+    d, v = env[meta["probe_key"]]
+    if np.issubdtype(np.dtype(d.dtype), np.floating):
+        # LUTs address integer keys; truncating a float probe would
+        # mis-match (10.5 → 10). The executor declines fusion for float
+        # probe keys — this is the backstop.
+        raise TypeError("LUT probe requires an integral probe key")
+    enc = d.astype(jnp.int64)
+    active = sel
+    matchable = active if v is None else (active & v)
+
+    lut = bt_arrays["lut"]
+    span = lut.shape[0]
+    off = enc - bt_arrays["lut_base"]
+    inb = (off >= 0) & (off < span)
+    idx = lut[jnp.clip(off, 0, span - 1).astype(jnp.int32)]
+    found = inb & (idx >= 0) & matchable
+    kind = meta["kind"]
+
+    pcap = next(iter(bt_arrays["payload"].values())).shape[0] \
+        if bt_arrays["payload"] else d.shape[0]
+    safe = jnp.clip(idx, 0, pcap - 1)
+    out_sel, gathered, gathered_valid = _select_and_gather(
+        found, safe, active, v, bt_arrays["n"], kind, meta["not_in"],
+        bt_arrays["payload"], bt_arrays["pvalid"], meta["src_names"])
+
+    env2 = dict(env)
+    for src, out in zip(meta["src_names"], meta["payload_names"]):
+        if src in gathered:
+            env2[out] = (gathered[src], gathered_valid[src])
+    if kind == "mark":
+        env2[meta["mark_col"] or "__mark"] = (found, None)
+    return env2, out_sel
 
 
 def _probe_enc(d):
@@ -130,7 +209,19 @@ def _probe(probe_arrays, probe_valids, length, sel, n_build,
     # `safe < n_build` guards against probe keys equal to the padding
     # sentinel (INT64_MAX / +inf) matching padding slots
     found = (keys_sorted[safe] == enc) & matchable & (safe < n_build)
+    out_sel, gathered, gathered_valid = _select_and_gather(
+        found, safe, active, v, n_build, kind, not_in, payload,
+        payload_valid, payload_names)
+    return out_sel, gathered, gathered_valid, found
 
+
+def _select_and_gather(found, safe, active, v, n_build, kind: str,
+                       not_in: bool, payload, payload_valid,
+                       payload_names: tuple):
+    """Shared post-match join logic (selection semantics + payload
+    gathers) for the searchsorted (`_probe`) and LUT
+    (`probe_lut_traced`) probes — the NOT IN three-valued rule and
+    null-extension behavior live only here."""
     out_sel = found if kind in ("inner", "left_semi") else (
         (~found) & active if kind == "left_anti" else active)
     if kind == "left_anti" and not_in and v is not None:
@@ -141,12 +232,10 @@ def _probe(probe_arrays, probe_valids, length, sel, n_build,
     gathered, gathered_valid = {}, {}
     if kind in ("inner", "left", "mark"):
         for name in payload_names:
-            pd_ = payload[name][safe]
-            gathered[name] = pd_
+            gathered[name] = payload[name][safe]
             pv = payload_valid.get(name)
-            gv = found if pv is None else (found & pv[safe])
-            gathered_valid[name] = gv
-    return out_sel, gathered, gathered_valid, found
+            gathered_valid[name] = found if pv is None else (found & pv[safe])
+    return out_sel, gathered, gathered_valid
 
 
 def probe(dblock: DeviceBlock, table: BuildTable, probe_key: str,

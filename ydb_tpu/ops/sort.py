@@ -30,7 +30,11 @@ def _sort_block(arrays, valids, length, sel, keys: tuple, names: tuple):
 
 
 def _sort_impl(arrays, valids, length, sel, keys: tuple, names: tuple):
-    """keys: tuple of (col_name, ascending, nulls_first)."""
+    """keys: tuple of (col_name, ascending, nulls_first).
+
+    Sorts key encodings + a row-id only (carrying whole rows through a wide
+    multi-operand ``lax.sort`` explodes XLA compile time on TPU); row values
+    follow by permutation gathers, which XLA fuses."""
     first = arrays[names[0]]
     cap = first.shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
@@ -54,19 +58,15 @@ def _sort_impl(arrays, valids, length, sel, keys: tuple, names: tuple):
             enc = jnp.where(v, enc, _zero_like_operand(enc))
         sort_ops.append(enc)
 
-    nk = len(sort_ops)
-    carried = []
-    for name in names:
-        carried.append(arrays[name])
-        v = valids.get(name)
-        carried.append(v if v is not None else jnp.ones((cap,), jnp.bool_))
-    out = jax.lax.sort(sort_ops + carried, num_keys=nk)
-    res = out[nk:]
+    # iota as the final key → deterministic (stable) order; the sorted iota
+    # IS the permutation
+    out = jax.lax.sort(sort_ops + [iota], num_keys=len(sort_ops) + 1)
+    perm = out[-1]
     new_arrays, new_valids = {}, {}
-    for i, name in enumerate(names):
-        new_arrays[name] = res[2 * i]
+    for name in names:
+        new_arrays[name] = arrays[name][perm]
         if name in valids:
-            new_valids[name] = res[2 * i + 1]
+            new_valids[name] = valids[name][perm]
     new_len = jnp.sum(active.astype(jnp.int32))
     return new_arrays, new_valids, new_len
 

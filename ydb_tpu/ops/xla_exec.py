@@ -13,11 +13,22 @@ Design constraints honored for the TPU:
     reduction masks by ``iota < length``;
   * no data-dependent control flow — filters keep selection masks
     (`TColumnFilter` semantics) instead of gathering;
-  * GroupBy is a sort-based segmented aggregation: ``lax.sort`` over
-    bit-monotone key encodings, segment ids from key-change boundaries,
-    ``segment_sum/min/max`` — all MXU/VPU-friendly with static tiles;
+  * GroupBy avoids scatter ops: global aggregates are plain masked
+    reductions; bounded key domains use a chunked one-hot 2-D reduction
+    (an MXU/VPU-friendly "aggregation as reduction over buckets");
+    unbounded domains sort (keys + row-id only — wide multi-operand
+    sorts explode XLA compile time) and aggregate with cumulative-sum
+    differences at segment boundaries;
   * f64 accumulation for SQL sum semantics (TPU emulates f64; precision
     verified against the numpy oracle in tests).
+
+Measured platform note (tunneled single-chip TPU, see PERF.md): after the
+first device→host readout in a process, every dispatch pays a large fixed
+latency and each *scatter* op (`segment_sum`, `.at[].set/add`) pays ~70-100ms
+extra, while gathers / sorts / cumsums / reductions stay at base cost. The
+operator designs here (and the whole-query fusion in
+`ydb_tpu/ops/fused.py`) exist to keep a query at one dispatch with zero
+scatters in the steady state.
 """
 
 from __future__ import annotations
@@ -94,63 +105,55 @@ def _sentinel(dtype, for_min: bool):
     return np.array(info.max if for_min else info.min, dtype=dtype)
 
 
-_SCATTER_MAX_BUCKETS = 1 << 16
+_SMALL_DOMAIN_BUCKETS = 1 << 9     # one-hot 2-D reduction path bound
+_CHUNK_W = 64                      # buckets per one-hot chunk
+_SCATTER_MAX_BUCKETS = 1 << 16    # medium-domain single-scatter path bound
 
 
-def _agg_over_segments(cmd: ir.GroupBy, env, active, seg_safe, nseg, iota):
-    """Shared aggregate emission: env values segmented by `seg_safe` into
-    `nseg` buckets; rows where ~active must carry seg_safe == nseg-1 (a
-    garbage bucket the caller drops or overwrites)."""
+def _acc_dtype(d):
+    if np.issubdtype(np.dtype(d.dtype), np.floating):
+        return jnp.float64
+    if d.dtype == jnp.uint64:
+        return jnp.uint64
+    return jnp.int64
+
+
+def _groupby_global(cmd: ir.GroupBy, env, active, iota):
+    """Keyless GROUP BY: plain masked reductions — one output row, no sort,
+    no scatter (the BlockCombineAll analog, `mkql_block_agg.cpp`)."""
     new_env = {}
     for a in cmd.aggs:
         if a.func == "count_all":
-            data = jax.ops.segment_sum(active.astype(jnp.uint64), seg_safe, nseg)
-            new_env[a.out] = (data, None)
+            data = jnp.sum(active.astype(jnp.uint64))
+            new_env[a.out] = (data[None], None)
             continue
         d, v = env[a.arg]
         m = active if v is None else (active & v)
         if a.func == "count":
-            data = jax.ops.segment_sum(m.astype(jnp.uint64), seg_safe, nseg)
-            new_env[a.out] = (data, None)
+            new_env[a.out] = (jnp.sum(m.astype(jnp.uint64))[None], None)
             continue
-        any_valid = jax.ops.segment_max(m.astype(jnp.int32), seg_safe, nseg) > 0
+        any_valid = jnp.any(m)[None]
         if a.func == "sum":
-            if np.issubdtype(np.dtype(d.dtype), np.floating):
-                acc = jnp.where(m, d, 0).astype(jnp.float64)
-            elif d.dtype == jnp.uint64:
-                acc = jnp.where(m, d, 0).astype(jnp.uint64)
-            else:
-                acc = jnp.where(m, d, 0).astype(jnp.int64)
-            data = jax.ops.segment_sum(acc, seg_safe, nseg)
+            data = jnp.sum(jnp.where(m, d, 0).astype(_acc_dtype(d)))[None]
             new_env[a.out] = (data, any_valid)
         elif a.func in ("min", "max"):
             sent = _sentinel(np.dtype(d.dtype), a.func == "min")
-            masked = jnp.where(m, d, sent)
-            fn = jax.ops.segment_min if a.func == "min" else jax.ops.segment_max
-            data = fn(masked, seg_safe, nseg)
+            red = jnp.min if a.func == "min" else jnp.max
+            data = red(jnp.where(m, d, sent))[None]
             data = jnp.where(any_valid, data, jnp.zeros((), d.dtype))
             new_env[a.out] = (data, any_valid)
         elif a.func == "some":
-            pos = jnp.where(m, iota, len(iota))
-            firstpos = jax.ops.segment_min(pos, seg_safe, nseg)
-            safe = jnp.clip(firstpos, 0, len(iota) - 1)
-            data = d[safe]
+            firstpos = jnp.min(jnp.where(m, iota, len(iota)))
+            data = d[jnp.clip(firstpos, 0, len(iota) - 1)][None]
             new_env[a.out] = (data, any_valid)
         else:
             raise ValueError(a.func)
-    return new_env
+    return new_env, jnp.int32(1)
 
 
-def _trace_group_by_scatter(cmd: ir.GroupBy, env, schema: Schema, sel,
-                            length, cap):
-    """Direct-indexed aggregation for statically bounded key domains — the
-    BlockCombineHashed analog (`mkql_block_agg.cpp`): bucket id is the mixed
-    radix of the key codes (+1 slot for NULL), no sort. Buckets live in the
-    leading K slots of the cap-sized block; non-empty buckets are compacted
-    to the front."""
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    active = (iota < length) if sel is None else ((iota < length) & sel)
-
+def _bucket_ids(cmd: ir.GroupBy, env, cap):
+    """Mixed-radix bucket id per row for bounded key domains (+1 slot per
+    key for NULL)."""
     kid = jnp.zeros((cap,), jnp.int32)
     stride = 1
     strides = []
@@ -163,16 +166,70 @@ def _trace_group_by_scatter(cmd: ir.GroupBy, env, schema: Schema, sel,
         kid = kid + code * stride
         strides.append(stride)
         stride *= dom + 1
-    nbuckets = stride
-    nseg = nbuckets + 1                         # +1 garbage bucket
-    seg_safe = jnp.where(active, kid, nbuckets)
+    return kid, stride, strides
 
-    new_env = _agg_over_segments(cmd, env, active, seg_safe, nseg, iota)
-    present = jax.ops.segment_sum(active.astype(jnp.int32), seg_safe, nseg) > 0
-    present = present.at[nbuckets].set(False)
 
-    # rebuild key columns from bucket ids
-    bucket_ids = jnp.arange(nseg, dtype=jnp.int32)
+def _groupby_small_domain(cmd: ir.GroupBy, env, schema: Schema, sel,
+                          length, cap):
+    """Bounded-domain aggregation as a chunked one-hot 2-D reduction — the
+    BlockCombineHashed analog (`mkql_block_agg.cpp`) built entirely from
+    elementwise ops + axis-0 reductions (XLA fuses the one-hot expansion
+    into the reduction; nothing materializes, nothing scatters)."""
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    active = (iota < length) if sel is None else ((iota < length) & sel)
+    kid, nbuckets, strides = _bucket_ids(cmd, env, cap)
+
+    chunks: dict[str, list] = {a.out: [] for a in cmd.aggs}
+    valid_chunks: dict[str, list] = {}
+    present_chunks = []
+    for c0 in range(0, nbuckets, _CHUNK_W):
+        w = min(_CHUNK_W, nbuckets - c0)
+        ids = c0 + jnp.arange(w, dtype=jnp.int32)
+        oh = (kid[:, None] == ids[None, :]) & active[:, None]
+        present_chunks.append(jnp.any(oh, axis=0))
+        for a in cmd.aggs:
+            if a.func == "count_all":
+                chunks[a.out].append(jnp.sum(oh.astype(jnp.uint64), axis=0))
+                continue
+            d, v = env[a.arg]
+            m = oh if v is None else (oh & v[:, None])
+            if a.func == "count":
+                chunks[a.out].append(jnp.sum(m.astype(jnp.uint64), axis=0))
+                continue
+            any_valid = jnp.any(m, axis=0)
+            valid_chunks.setdefault(a.out, []).append(any_valid)
+            if a.func == "sum":
+                acc = jnp.where(m, d[:, None], 0).astype(_acc_dtype(d))
+                chunks[a.out].append(jnp.sum(acc, axis=0))
+            elif a.func in ("min", "max"):
+                sent = _sentinel(np.dtype(d.dtype), a.func == "min")
+                red = jnp.min if a.func == "min" else jnp.max
+                data = red(jnp.where(m, d[:, None], sent), axis=0)
+                chunks[a.out].append(
+                    jnp.where(any_valid, data, jnp.zeros((), d.dtype)))
+            elif a.func == "some":
+                firstpos = jnp.min(jnp.where(m, iota[:, None], cap), axis=0)
+                chunks[a.out].append(d[jnp.clip(firstpos, 0, cap - 1)])
+            else:
+                raise ValueError(a.func)
+
+    new_env = {}
+    for a in cmd.aggs:
+        data = jnp.concatenate(chunks[a.out])
+        v = valid_chunks.get(a.out)
+        new_env[a.out] = (data, jnp.concatenate(v) if v is not None else None)
+    present = jnp.concatenate(present_chunks)
+    return _emit_bucket_groups(cmd, env, schema, new_env, present, nbuckets,
+                               strides)
+
+
+def _emit_bucket_groups(cmd: ir.GroupBy, env, schema: Schema, new_env,
+                        present, nbuckets, strides):
+    """Shared bounded-domain epilogue: rebuild key columns from bucket ids,
+    then compact non-empty buckets to the front of a SMALL capacity bucket
+    (compress sorts; doing it over the scan capacity would cost a full
+    cap-sized argsort for a handful of groups)."""
+    bucket_ids = jnp.arange(nbuckets, dtype=jnp.int32)
     for kname, dom, st in zip(cmd.keys, cmd.key_domains, strides):
         code = (bucket_ids // st) % (dom + 1) - 1
         d, _v = env[kname]
@@ -181,11 +238,8 @@ def _trace_group_by_scatter(cmd: ir.GroupBy, env, schema: Schema, sel,
         dt = schema.dtype(kname)
         new_env[kname] = (kd, kv if dt.nullable else None)
 
-    # compact non-empty buckets to the front of a SMALL capacity bucket
-    # (compress sorts; doing it over the original cap would cost a full
-    # cap-sized argsort for a handful of groups)
-    out_cap = bucket_capacity(nseg, minimum=128)
-    pad = out_cap - nseg
+    out_cap = bucket_capacity(nbuckets, minimum=128)
+    pad = out_cap - nbuckets
     padded = {}
     for name, (d, v) in new_env.items():
         dp = jnp.pad(d, (0, pad)) if pad > 0 else d[:out_cap]
@@ -194,23 +248,79 @@ def _trace_group_by_scatter(cmd: ir.GroupBy, env, schema: Schema, sel,
             vp = jnp.pad(v, (0, pad)) if pad > 0 else v[:out_cap]
         padded[name] = (dp, vp)
     present_p = jnp.pad(present, (0, pad)) if pad > 0 else present[:out_cap]
-    out_env, ngroups = compress(padded, jnp.int32(nseg), present_p, out_cap)
-    return out_env, ngroups
+    return compress(padded, jnp.int32(nbuckets), present_p, out_cap)
 
 
-def _trace_group_by(cmd: ir.GroupBy, env, schema: Schema, sel, length, cap):
-    """Sort-based segmented aggregation. Returns (new_env, new_length)."""
-    if cmd.keys and cmd.key_domains and all(d > 0 for d in cmd.key_domains):
-        nb = 1
-        for d in cmd.key_domains:
-            nb *= d + 1
-        if nb + 1 <= min(cap, _SCATTER_MAX_BUCKETS):
-            return _trace_group_by_scatter(cmd, env, schema, sel, length, cap)
+def _groupby_medium_domain(cmd: ir.GroupBy, env, schema: Schema, sel,
+                           length, cap):
+    """Bounded domains too wide for the one-hot path: one scatter-reduce
+    per aggregate into a bucket array (each scatter pays the platform's
+    post-readout scatter tax exactly once per aggregate)."""
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    active = (iota < length) if sel is None else ((iota < length) & sel)
+    kid, nbuckets, strides = _bucket_ids(cmd, env, cap)
+    seg_safe = jnp.where(active, kid, nbuckets)
+    nseg = nbuckets + 1                         # +1 garbage bucket
+
+    new_env = {}
+    for a in cmd.aggs:
+        if a.func == "count_all":
+            data = jax.ops.segment_sum(active.astype(jnp.uint64), seg_safe,
+                                       nseg)
+            new_env[a.out] = (data[:nbuckets], None)
+            continue
+        d, v = env[a.arg]
+        m = active if v is None else (active & v)
+        if a.func == "count":
+            data = jax.ops.segment_sum(m.astype(jnp.uint64), seg_safe, nseg)
+            new_env[a.out] = (data[:nbuckets], None)
+            continue
+        cnt = jax.ops.segment_sum(m.astype(jnp.int32), seg_safe, nseg)
+        any_valid = (cnt > 0)[:nbuckets]
+        if a.func == "sum":
+            acc = jnp.where(m, d, 0).astype(_acc_dtype(d))
+            data = jax.ops.segment_sum(acc, seg_safe, nseg)[:nbuckets]
+            new_env[a.out] = (data, any_valid)
+        elif a.func in ("min", "max"):
+            sent = _sentinel(np.dtype(d.dtype), a.func == "min")
+            masked = jnp.where(m, d, sent)
+            fn = jax.ops.segment_min if a.func == "min" else jax.ops.segment_max
+            data = fn(masked, seg_safe, nseg)[:nbuckets]
+            data = jnp.where(any_valid, data, jnp.zeros((), d.dtype))
+            new_env[a.out] = (data, any_valid)
+        elif a.func == "some":
+            pos = jnp.where(m, iota, cap)
+            firstpos = jax.ops.segment_min(pos, seg_safe, nseg)[:nbuckets]
+            data = d[jnp.clip(firstpos, 0, cap - 1)]
+            new_env[a.out] = (data, any_valid)
+        else:
+            raise ValueError(a.func)
+
+    present = jax.ops.segment_sum(active.astype(jnp.int32), seg_safe,
+                                  nseg)[:nbuckets] > 0
+    return _emit_bucket_groups(cmd, env, schema, new_env, present, nbuckets,
+                               strides)
+
+
+def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
+                           length, cap):
+    """Unbounded-domain aggregation: sort (keys + row-id only), segment
+    boundaries from key changes, sums/counts via cumulative-sum differences
+    at segment endpoints, min/max via one scatter-reduce per aggregate.
+
+    The sort carries only key encodings and the row permutation — carrying
+    value columns through a wide multi-operand `lax.sort` explodes XLA
+    compile time on TPU (minutes at 1M+ rows); values are gathered by the
+    permutation instead.
+
+    Precision note: a segment sum is csum[end] − csum[start] + v[start];
+    for a tiny group inside a huge total the cancellation costs ~(total /
+    group_sum)·1e-16 relative error — acceptable for SQL doubles and the
+    test oracles' 1e-6 tolerances."""
     iota = jnp.arange(cap, dtype=jnp.int32)
     row_mask = iota < length
     active = row_mask if sel is None else (row_mask & sel)
 
-    # sort operands: [inactive][per-key: validbit, enc] + carried values
     inactive = (~active).astype(jnp.int32)
     sort_keys = [inactive]
     for kname in cmd.keys:
@@ -222,74 +332,130 @@ def _trace_group_by(cmd: ir.GroupBy, env, schema: Schema, sel, length, cap):
         else:
             sort_keys.append(jnp.ones((cap,), jnp.int32))
         sort_keys.append(enc)
-
-    carried_names: list[str] = []
-    carried: list = []
-
-    def carry(name):
-        if name in carried_names:
-            return
-        d, v = env[name]
-        carried_names.append(name)
-        carried.append(d)
-        carried.append(v if v is not None else jnp.ones((cap,), jnp.bool_))
-
-    for kname in cmd.keys:
-        carry(kname)
-    for a in cmd.aggs:
-        if a.arg is not None:
-            carry(a.arg)
-
-    nk = len(sort_keys)
-    out = jax.lax.sort(sort_keys + carried, num_keys=nk)
+    # iota as the last key → deterministic total order, and the sort output
+    # IS the permutation (no carried operands)
+    out = jax.lax.sort(sort_keys + [iota], num_keys=len(sort_keys) + 1)
     inactive_s = out[0]
-    keyparts_s = out[1:nk]
-    carried_s = out[nk:]
+    keyparts_s = out[1:-1]
+    perm = out[-1]
+
     env_s = {}
-    for i, name in enumerate(carried_names):
-        env_s[name] = (carried_s[2 * i], carried_s[2 * i + 1])
+
+    def sorted_col(name):
+        got = env_s.get(name)
+        if got is None:
+            d, v = env[name]
+            got = (d[perm], v[perm] if v is not None else None)
+            env_s[name] = got
+        return got
 
     active_s = inactive_s == 0
-    if cmd.keys:
-        changed = jnp.zeros((cap,), jnp.bool_)
-        for kp in keyparts_s:
-            prev = jnp.concatenate([kp[:1], kp[:-1]])
-            neq = kp != prev
-            if np.issubdtype(np.dtype(kp.dtype), np.floating):
-                # NaN != NaN would split every NaN row into its own group;
-                # lax.sort places NaNs adjacently, so treat them as equal
-                neq = neq & ~(jnp.isnan(kp) & jnp.isnan(prev))
-            changed = changed | neq
-        first_row = iota == 0
-        boundary = active_s & (first_row | changed)
-        seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
-        ngroups = jnp.sum(boundary.astype(jnp.int32))
-    else:
-        boundary = active_s & (jnp.cumsum(active_s.astype(jnp.int32)) == 1)
-        seg = jnp.zeros((cap,), jnp.int32)
-        ngroups = jnp.int32(1)  # global agg always yields one row
+    changed = jnp.zeros((cap,), jnp.bool_)
+    for kp in keyparts_s:
+        prev = jnp.concatenate([kp[:1], kp[:-1]])
+        neq = kp != prev
+        if np.issubdtype(np.dtype(kp.dtype), np.floating):
+            # NaN != NaN would split every NaN row into its own group;
+            # lax.sort places NaNs adjacently, so treat them as equal
+            neq = neq & ~(jnp.isnan(kp) & jnp.isnan(prev))
+        changed = changed | neq
+    boundary = active_s & ((iota == 0) | changed)
+    ngroups = jnp.sum(boundary.astype(jnp.int32))
+    nactive = jnp.sum(active_s.astype(jnp.int32))
 
-    seg_safe = jnp.where(active_s, seg, cap - 1)
+    # compact segment-start row indices to the front: starts[i] = sorted-row
+    # index where group i begins
+    starts = jnp.argsort(jnp.where(boundary, iota, jnp.int32(cap))
+                         ).astype(jnp.int32)
+    gi = jnp.arange(cap, dtype=jnp.int32)
+    next_start = jnp.concatenate([starts[1:], jnp.full((1,), cap, jnp.int32)])
+    ends = jnp.where(gi + 1 < ngroups, next_start - 1, nactive - 1)
+    ends = jnp.clip(ends, 0, cap - 1)
+    live = gi < ngroups
 
     new_env = {}
-    # emit group keys: scatter first-row-of-segment values
-    scatter_idx = jnp.where(boundary, seg, cap)  # cap = dropped
     for kname in cmd.keys:
-        d, v = env_s[kname]
-        kd = jnp.zeros((cap,), d.dtype).at[scatter_idx].set(d, mode="drop")
-        kv = jnp.zeros((cap,), jnp.bool_).at[scatter_idx].set(v, mode="drop")
+        d, v = sorted_col(kname)
+        kd = d[starts]
         dt = schema.dtype(kname)
-        new_env[kname] = (kd, kv if dt.nullable else None)
+        if dt.nullable:
+            kv = (v[starts] if v is not None else jnp.ones((cap,), jnp.bool_))
+            new_env[kname] = (kd, kv & live)
+        else:
+            new_env[kname] = (kd, None)
 
-    new_env.update(_agg_over_segments(cmd, env_s, active_s, seg_safe, cap,
-                                      iota))
+    seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    seg_safe = jnp.where(active_s, seg, cap)
+
+    def csum_diff(per_row):
+        """Per-group sum of a sorted per-row array via cumsum endpoints."""
+        c = jnp.cumsum(per_row)
+        first = per_row[starts]
+        return c[ends] - c[starts] + first
+
+    for a in cmd.aggs:
+        if a.func == "count_all":
+            data = csum_diff(active_s.astype(jnp.uint64))
+            new_env[a.out] = (jnp.where(live, data, 0), None)
+            continue
+        d, v = sorted_col(a.arg)
+        m = active_s if v is None else (active_s & v)
+        if a.func == "count":
+            data = csum_diff(m.astype(jnp.uint64))
+            new_env[a.out] = (jnp.where(live, data, 0), None)
+            continue
+        cnt = csum_diff(m.astype(jnp.int64))
+        any_valid = (cnt > 0) & live
+        if a.func == "sum":
+            acc = jnp.where(m, d, 0).astype(_acc_dtype(d))
+            new_env[a.out] = (csum_diff(acc), any_valid)
+        elif a.func in ("min", "max"):
+            sent = _sentinel(np.dtype(d.dtype), a.func == "min")
+            masked = jnp.where(m, d, sent)
+            init = jnp.full((cap + 1,), sent, d.dtype)
+            upd = (init.at[seg_safe].min(masked, mode="drop")
+                   if a.func == "min"
+                   else init.at[seg_safe].max(masked, mode="drop"))
+            data = jnp.where(any_valid, upd[:cap], jnp.zeros((), d.dtype))
+            new_env[a.out] = (data, any_valid)
+        elif a.func == "some":
+            # first valid value in the segment: rows are key-then-row-id
+            # sorted, so scan for the first m-true position per segment
+            pos = jnp.where(m, iota, cap)
+            init = jnp.full((cap + 1,), cap, jnp.int32)
+            firstpos = init.at[seg_safe].min(pos, mode="drop")[:cap]
+            data = d[jnp.clip(firstpos, 0, cap - 1)]
+            new_env[a.out] = (data, any_valid)
+        else:
+            raise ValueError(a.func)
     return new_env, ngroups.astype(jnp.int32)
 
 
-def _trace_program(program: ir.Program, in_schema_cols, cap, env, length, params):
-    """env: name -> (data, valid|None); returns (env, length, sel)."""
+def _trace_group_by(cmd: ir.GroupBy, env, schema: Schema, sel, length, cap):
+    """GroupBy dispatch: keyless → plain reductions; small bounded domains →
+    one-hot 2-D reduction; medium bounded → scatter-reduce; unbounded →
+    sort-based. Returns (new_env, new_length)."""
+    if not cmd.keys:
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        active = (iota < length) if sel is None else ((iota < length) & sel)
+        return _groupby_global(cmd, env, active, iota)
+    if cmd.key_domains and all(d > 0 for d in cmd.key_domains):
+        nb = 1
+        for d in cmd.key_domains:
+            nb *= d + 1
+        if nb <= _SMALL_DOMAIN_BUCKETS:
+            return _groupby_small_domain(cmd, env, schema, sel, length, cap)
+        if nb + 1 <= _SCATTER_MAX_BUCKETS:
+            return _groupby_medium_domain(cmd, env, schema, sel, length, cap)
+    return _trace_group_by_sorted(cmd, env, schema, sel, length, cap)
+
+
+def _trace_program(program: ir.Program, in_schema_cols, cap, env, length,
+                   params, sel=None):
+    """env: name -> (data, valid|None); returns (env, length, sel, schema).
+    `sel` seeds the selection mask (fused pipelines thread it between
+    programs instead of compressing)."""
     schema = Schema(list(in_schema_cols))
-    sel = None
     for cmd in program.commands:
         if isinstance(cmd, ir.Assign):
             data, valid = _eval(cmd.expr, env, params, cap)

@@ -43,6 +43,7 @@ class Executor:
         self.block_rows = block_rows
         self.device_cache = device_cache or DeviceColumnCache()
         self._finalize_cache: dict = {}
+        self._fused_cache: dict = {}
         # device mesh for distributed execution (None / size-1 mesh →
         # single-device). The analog of the KQP task graph + DQ hash-shuffle
         # channels (`dq_tasks_graph.h:43`): scans are row-partitioned across
@@ -73,9 +74,190 @@ class Executor:
             merged = self._execute_distributed(plan, params, snapshot)
             return self._project_output(merged, plan.output)
 
-        partials = self._run_pipeline(plan.pipeline, params, snapshot)
+        fused = self._try_execute_fused(plan, params, snapshot)
+        if isinstance(fused, HostBlock):
+            return self._project_output(fused, plan.output)
+
+        # fused path declined: it may have prepared the join builds already
+        partials = self._run_pipeline(plan.pipeline, params, snapshot,
+                                      builds=fused)
         merged = self._finalize(plan, partials, params)
         return self._project_output(merged, plan.output)
+
+    # -- fused whole-query path --------------------------------------------
+
+    def _try_execute_fused(self, plan: QueryPlan, params: dict,
+                           snapshot: Snapshot):
+        """Run the query as ONE fused device program (`ops/fused.py`) when
+        its shape allows: single device, all joins LUT-probeable (and
+        unique-keyed where payloads attach — expanding duplicate-key
+        probes need a data-dependent output capacity, so they stay on the
+        portioned path).
+
+        Returns the merged HostBlock on success; on fallback, the list of
+        prepared join BuildTables (for `_run_pipeline` to reuse) or None
+        if none were prepared."""
+        from ydb_tpu.ops import fused as F
+
+        pipe = plan.pipeline
+        table = self.catalog.table(pipe.scan.table)
+        storage_names = [s for (s, _i) in pipe.scan.columns]
+        rename = {s: i for (s, i) in pipe.scan.columns}
+        sb = self.device_cache.superblock(table, storage_names, rename,
+                                          snapshot, pipe.scan.prune or None)
+        if sb is None:
+            return None                    # empty scan → portioned path
+        arrays, valids, lengths, K, CAP, sb_dicts = sb
+
+        join_steps = [step for kind, step in pipe.steps if kind == "join"]
+        builds = [self._prepare_join(step, params, snapshot)
+                  for step in join_steps]
+        for step, bt in zip(join_steps, builds):
+            if bt.lut is None or (
+                    not bt.unique and step.kind in ("inner", "left", "mark")):
+                return builds              # un-LUT-able / expanding join
+
+        scan_cols = [Column(i, table.schema.dtype(s))
+                     for (s, i) in pipe.scan.columns]
+        sb_valid_names = frozenset(valids.keys())
+
+        # dictionaries visible to sort setup: scan + build payloads
+        dicts = dict(sb_dicts)
+        join_metas = []
+        bi = 0
+        probe_schema = Schema(list(scan_cols))
+        if pipe.pre_program is not None:
+            probe_schema = ir.infer_schema(pipe.pre_program, probe_schema)
+        for kind, step in pipe.steps:
+            if kind != "join":
+                probe_schema = ir.infer_schema(step, probe_schema)
+                continue
+            bt = builds[bi]
+            bi += 1
+            # LUTs address integer keys — a float probe key would truncate
+            # (10.5 → 10 would "match"); those joins stay on the
+            # searchsorted path
+            from ydb_tpu.core.dtypes import Kind as _K
+            if probe_schema.dtype(step.probe_key).kind in (_K.FLOAT64,
+                                                           _K.FLOAT32):
+                return builds
+            payload_cols = []
+            for name in bt.schema.names:
+                dt = bt.schema.dtype(name).with_nullable(True)
+                payload_cols.append(Column(name, dt))
+                if name in bt.dictionaries:
+                    dicts[name] = bt.dictionaries[name]
+            if step.kind == "mark":
+                from ydb_tpu.core.dtypes import DType, Kind
+                payload_cols.append(Column(step.mark_col or "__mark",
+                                           DType(Kind.BOOL, False)))
+            join_metas.append({
+                "probe_key": step.probe_key,
+                "kind": step.kind,
+                "src_names": tuple(bt.schema.names),
+                "payload_names": tuple(bt.schema.names),
+                "mark_col": step.mark_col,
+                "not_in": step.not_in,
+                "payload_cols": payload_cols,
+            })
+            cols = [c for c in probe_schema.columns
+                    if c.name not in {p.name for p in payload_cols}]
+            probe_schema = Schema(cols + payload_cols)
+
+        sort_params, sort_spec, rank_assigns = self._sort_setup_fused(
+            plan, scan_cols, join_metas, dicts)
+        all_params = {**params, **sort_params}
+
+        builds_sig = tuple(F.build_inputs_sig(bt) for bt in builds)
+        key = F.fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names,
+                                builds_sig, sort_spec, rank_assigns,
+                                tuple(sorted(all_params)))
+        entry = self._fused_cache.get(key)
+        if entry is None:
+            fn = F.build_fused_fn(
+                pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
+                join_metas, rank_assigns, sort_spec, plan.limit, plan.offset,
+                tuple(dict.fromkeys(n for (n, _lbl) in plan.output)))
+            out_schema = self._fused_out_schema(plan, scan_cols, join_metas)
+            entry = (fn, out_schema)
+            self._fused_cache[key] = entry
+        fn, out_schema = entry
+
+        dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
+                      for k, v in all_params.items()}
+        build_inputs = [F.build_traced_inputs(bt) for bt in builds]
+        out_d, out_v, length = fn(arrays, valids, lengths, build_inputs,
+                                  dev_params)
+
+        out_dicts = {n: d for n, d in dicts.items() if out_schema.has(n)}
+        out_dicts.update({n: d for n, d in plan.result_dicts.items()
+                          if out_schema.has(n)})
+        out_cap = (next(iter(out_d.values())).shape[0] if out_d else 0)
+        dblock = DeviceBlock(out_schema, out_d, out_v, length, out_cap,
+                             out_dicts)
+        block = to_host(dblock)
+        lo = plan.offset or 0
+        if lo:
+            hi = lo + plan.limit if plan.limit is not None else block.length
+            block = block.slice(lo, min(hi, block.length))
+        return block
+
+    def _fused_out_schema(self, plan: QueryPlan, scan_cols: list,
+                          join_metas: list) -> Schema:
+        schema = self._fused_final_schema(plan, scan_cols, join_metas)
+        keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
+        out_cols = [c for c in schema.columns if c.name in keep] \
+            or list(schema.columns)
+        return Schema(out_cols)
+
+    def _sort_setup_fused(self, plan: QueryPlan, scan_cols: list,
+                          join_metas: list, dicts: dict):
+        """Rank-LUT sort params against the fused pipeline's final schema
+        (mirrors `_sort_setup`, which works from partial-output blocks)."""
+        from ydb_tpu.core import dtypes as dt
+        schema = self._fused_final_schema(plan, scan_cols, join_metas)
+        sort_params, rank_assigns, spec = {}, [], []
+        dicts = {**dicts, **plan.result_dicts}
+        for j, sk in enumerate(plan.sort):
+            dtype = schema.dtype(sk.name)
+            dic = dicts.get(sk.name)
+            if dtype.is_string and dic is not None:
+                vals = dic.values_array()
+                ranks = np.argsort(np.argsort(vals)).astype(np.int32) \
+                    if len(vals) else np.zeros(1, np.int32)
+                pname = f"__rank{j}"
+                sort_params[pname] = ranks
+                rank_col = f"__sortrank{j}"
+                rank_assigns.append(ir.Assign(rank_col, ir.call(
+                    "take_lut", ir.Col(sk.name),
+                    ir.Param(pname, dt.DType(dt.Kind.INT32, False),
+                             is_array=True))))
+                spec.append((rank_col, sk.ascending, sk.nulls_first))
+            else:
+                spec.append((sk.name, sk.ascending, sk.nulls_first))
+        return sort_params, tuple(spec), rank_assigns
+
+    def _fused_final_schema(self, plan: QueryPlan, scan_cols: list,
+                            join_metas: list) -> Schema:
+        schema = Schema(list(scan_cols))
+        pipe = plan.pipeline
+        bi = 0
+        if pipe.pre_program is not None:
+            schema = ir.infer_schema(pipe.pre_program, schema)
+        for kind, step in pipe.steps:
+            if kind == "join":
+                meta = join_metas[bi]
+                bi += 1
+                cols = [c for c in schema.columns
+                        if c.name not in {p.name for p in meta["payload_cols"]}]
+                schema = Schema(cols + list(meta["payload_cols"]))
+            else:
+                schema = ir.infer_schema(step, schema)
+        if pipe.partial is not None:
+            schema = ir.infer_schema(pipe.partial, schema)
+        if plan.final_program is not None:
+            schema = ir.infer_schema(plan.final_program, schema)
+        return schema
 
     # -- distributed (mesh) path -------------------------------------------
 
@@ -145,11 +327,13 @@ class Executor:
     # -- pipelines ---------------------------------------------------------
 
     def _run_pipeline(self, pipe: Pipeline, params: dict,
-                      snapshot: Snapshot) -> list:
+                      snapshot: Snapshot, builds=None) -> list:
         """Partial-result DeviceBlocks (≥1: an empty scan still runs the
-        programs once so global aggregates emit their row)."""
-        builds = [self._prepare_join(step, params, snapshot)
-                  for kind, step in pipe.steps if kind == "join"]
+        programs once so global aggregates emit their row). `builds`:
+        BuildTables already prepared by a declined fused attempt."""
+        if builds is None:
+            builds = [self._prepare_join(step, params, snapshot)
+                      for kind, step in pipe.steps if kind == "join"]
         out = [self._run_block(pipe, d, builds, params)
                for d in self._scan_device_blocks(pipe, snapshot)]
         if not out:

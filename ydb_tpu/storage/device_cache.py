@@ -65,6 +65,80 @@ class DeviceColumnCache:
         self._evict()
         return data, valid
 
+    def superblock(self, table, storage_names: list, rename: dict,
+                   snapshot, prune):
+        """Stacked (K, CAP) device arrays covering every visible scan source
+        of `table` — the input of the whole-query fused program
+        (`ydb_tpu/ops/fused.py`), one upload per column per data version.
+
+        Returns (arrays {internal: (K,CAP)}, valids {internal: (K,CAP)},
+        lengths jnp (K,), K, CAP, dicts) or None when the table has no
+        visible sources."""
+        sources = []          # HostBlocks
+        src_ids = []
+        for shard in table.shards:
+            portions, insert_blocks = shard.scan_sources(snapshot, prune)
+            for p in portions:
+                sources.append(p.block)
+                src_ids.append(("p", p.id))
+            for i, b in enumerate(insert_blocks):
+                sources.append(b)
+                src_ids.append(("i", shard.shard_id, i))
+        if not sources:
+            return None
+        K = len(sources)
+        CAP = max(bucket_capacity(max(b.length, 1)) for b in sources)
+        src_key = (table.uid, table.data_version,
+                   (snapshot.plan_step, snapshot.tx_id), tuple(src_ids), CAP)
+
+        lengths_np = np.array([b.length for b in sources], np.int32)
+        arrays, valids, dicts = {}, {}, {}
+        for s in storage_names:
+            out = rename.get(s, s)
+            key = ("sbc", src_key, s)
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                arrays[out] = hit[0]
+                if hit[1] is not None:
+                    valids[out] = hit[1]
+            else:
+                self.misses += 1
+                dtype = sources[0].columns[s].data.dtype
+                stack = np.zeros((K, CAP), dtype=dtype)
+                has_valid = any(b.columns[s].valid is not None
+                                for b in sources)
+                vstack = np.zeros((K, CAP), np.bool_) if has_valid else None
+                for k, b in enumerate(sources):
+                    cd = b.columns[s]
+                    stack[k, :b.length] = cd.data
+                    if vstack is not None:
+                        vstack[k, :b.length] = (cd.valid if cd.valid is not None
+                                                else True)
+                d = jnp.asarray(stack)
+                v = jnp.asarray(vstack) if vstack is not None else None
+                nbytes = d.nbytes + (v.nbytes if v is not None else 0)
+                self._entries[key] = (d, v, nbytes)
+                self.bytes += nbytes
+                self._evict()
+                arrays[out] = d
+                if v is not None:
+                    valids[out] = v
+            cd0 = sources[0].columns[s]
+            if cd0.dictionary is not None:
+                dicts[out] = cd0.dictionary
+
+        lkey = ("sbl", src_key)
+        lhit = self._entries.get(lkey)
+        if lhit is None:
+            lengths = jnp.asarray(lengths_np)
+            self._entries[lkey] = (lengths, None, lengths.nbytes)
+            self.bytes += lengths.nbytes
+        else:
+            lengths = lhit[0]
+        return arrays, valids, lengths, K, CAP, dicts
+
     def device_block(self, portion: Portion, columns: list,
                      rename: Optional[dict] = None,
                      device=None) -> DeviceBlock:

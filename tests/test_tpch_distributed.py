@@ -18,10 +18,15 @@ from tests.tpch_util import QUERIES, assert_frames_match, oracle
 
 SF = 0.002
 
-# the distributed path routes two-phase aggregations; queries chosen to
-# cover: plain agg (q1, q6), joins + agg (q3, q5, q10), semi/anti
-# subqueries (q4), global agg with having (q11 shape via q5's tail)
-DIST_QUERIES = ["q1", "q3", "q4", "q5", "q6", "q10", "q12", "q14"]
+# queries run with a mesh configured; two-phase aggregation shapes route
+# through the ICI hash shuffle, the rest fall back to single-device
+# execution under the same engine — either way results must match the
+# oracle (test_distributed_path_taken pins that the mesh is exercised).
+# A subset of the 22: one process accumulates hundreds of XLA CPU
+# executables across 8 virtual devices and the full set segfaults the
+# test runner; the single-device suite covers all 22.
+DIST_QUERIES = ["q1", "q3", "q4", "q5", "q6", "q10", "q12", "q14",
+                "q13", "q15", "q16", "q21"]
 
 
 @pytest.fixture(scope="module")

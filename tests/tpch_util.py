@@ -220,6 +220,57 @@ or (p_partkey = l_partkey and p_brand = 'Brand#34'
    and p_container in ('LG CASE','LG BOX','LG PACK','LG PKG')
    and l_quantity >= 20 and l_quantity <= 30 and p_size between 1 and 15
    and l_shipmode in ('AIR','AIR REG') and l_shipinstruct = 'DELIVER IN PERSON')""",
+    "q13": """
+select c_count, count(*) as custdist from (
+  select c.c_custkey as c_custkey, count(o.o_orderkey) as c_count
+  from customer c left join orders o
+    on c.c_custkey = o.o_custkey and o.o_comment not like '%special%requests%'
+  group by c.c_custkey
+) as c_orders
+group by c_count
+order by custdist desc, c_count desc""",
+    "q15": """
+with revenue as (
+  select l_suppkey as supplier_no,
+         sum(l_extendedprice * (1 - l_discount)) as total_revenue
+  from lineitem
+  where l_shipdate >= date '1996-01-01' and l_shipdate < date '1996-04-01'
+  group by l_suppkey
+)
+select s.s_suppkey, s.s_name, s.s_address, s.s_phone, r.total_revenue
+from supplier s join revenue r on s.s_suppkey = r.supplier_no
+where r.total_revenue = (select max(total_revenue) from revenue)
+order by s.s_suppkey""",
+    "q16": """
+select p.p_brand, p.p_type, p.p_size,
+       count(distinct ps.ps_suppkey) as supplier_cnt
+from partsupp ps join part p on p.p_partkey = ps.ps_partkey
+where p.p_brand <> 'Brand#45'
+  and p.p_type not like 'MEDIUM POLISHED%'
+  and p.p_size in (49, 14, 23, 45, 19, 3, 36, 9)
+  and ps.ps_suppkey not in (
+    select s_suppkey from supplier where s_comment like '%Customer%Complaints%'
+  )
+group by p.p_brand, p.p_type, p.p_size
+order by supplier_cnt desc, p.p_brand, p.p_type, p.p_size""",
+    "q21": """
+select s.s_name, count(*) as numwait
+from supplier s
+join lineitem l1 on s.s_suppkey = l1.l_suppkey
+join orders o on o.o_orderkey = l1.l_orderkey
+join nation n on s.s_nationkey = n.n_nationkey
+where o.o_orderstatus = 'F'
+  and l1.l_receiptdate > l1.l_commitdate
+  and exists (select 1 from lineitem l2
+              where l2.l_orderkey = l1.l_orderkey
+                and l2.l_suppkey <> l1.l_suppkey)
+  and not exists (select 1 from lineitem l3
+                  where l3.l_orderkey = l1.l_orderkey
+                    and l3.l_suppkey <> l1.l_suppkey
+                    and l3.l_receiptdate > l3.l_commitdate)
+  and n.n_name = 'SAUDI ARABIA'
+group by s.s_name
+order by numwait desc, s.s_name""",
 }
 
 
@@ -475,6 +526,53 @@ def oracle(name: str, data: TpchData) -> pd.DataFrame:
         rev = (d.l_extendedprice * (1 - d.l_discount)).sum() if len(d) \
             else np.nan   # SQL: SUM over empty set is NULL
         return pd.DataFrame({"revenue": [rev]})
+    if name == "q13":
+        o = od[~od.o_comment.str.match(r".*special.*requests.*")]
+        j = cu.merge(o, left_on="c_custkey", right_on="o_custkey", how="left")
+        per_cust = j.groupby("c_custkey").o_orderkey.count() \
+            .reset_index(name="c_count")
+        g = per_cust.groupby("c_count").size().reset_index(name="custdist")
+        return g.sort_values(["custdist", "c_count"], ascending=[False, False],
+                             kind="stable")
+    if name == "q15":
+        su = f["supplier"]
+        l = li[(li.l_shipdate >= date32(1996, 1, 1))
+               & (li.l_shipdate < date32(1996, 4, 1))]
+        rev = (l.assign(r=l.l_extendedprice * (1 - l.l_discount))
+               .groupby("l_suppkey").r.sum().reset_index(name="total_revenue"))
+        top = rev[rev.total_revenue == rev.total_revenue.max()]
+        j = su.merge(top, left_on="s_suppkey", right_on="l_suppkey")
+        j = j.sort_values("s_suppkey")
+        return j[["s_suppkey", "s_name", "s_address", "s_phone",
+                  "total_revenue"]]
+    if name == "q16":
+        pa, ps, su = f["part"], f["partsupp"], f["supplier"]
+        bad = su[su.s_comment.str.match(r".*Customer.*Complaints.*")].s_suppkey
+        p = pa[(pa.p_brand != "Brand#45")
+               & ~pa.p_type.str.startswith("MEDIUM POLISHED")
+               & pa.p_size.isin([49, 14, 23, 45, 19, 3, 36, 9])]
+        j = ps.merge(p, left_on="ps_partkey", right_on="p_partkey")
+        j = j[~j.ps_suppkey.isin(bad)]
+        g = j.groupby(["p_brand", "p_type", "p_size"]).ps_suppkey.nunique() \
+             .reset_index(name="supplier_cnt")
+        return g.sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                             ascending=[False, True, True, True],
+                             kind="stable")
+    if name == "q21":
+        su, na = f["supplier"], f["nation"]
+        multi = li.groupby("l_orderkey").l_suppkey.nunique()
+        late = li[li.l_receiptdate > li.l_commitdate]
+        late_multi = late.groupby("l_orderkey").l_suppkey.nunique()
+        j = late.merge(su, left_on="l_suppkey", right_on="s_suppkey") \
+                .merge(od, left_on="l_orderkey", right_on="o_orderkey") \
+                .merge(na, left_on="s_nationkey", right_on="n_nationkey")
+        j = j[(j.o_orderstatus == "F") & (j.n_name == "SAUDI ARABIA")]
+        # exists: another supplier on the order; not exists: another LATE one
+        j = j[j.l_orderkey.map(multi).fillna(0) > 1]
+        j = j[j.l_orderkey.map(late_multi).fillna(0) == 1]
+        g = j.groupby("s_name").size().reset_index(name="numwait")
+        return g.sort_values(["numwait", "s_name"], ascending=[False, True],
+                             kind="stable")
     raise KeyError(name)
 
 

@@ -349,6 +349,5 @@ def load_tpch(catalog, sf: float = 0.01, shards: int = 1, seed: int = 19920101,
                                       dictionaries=dict(table.dictionaries))
         writes = table.write(block)
         table.commit(writes, WriteVersion(1, 1))
-        for s in table.shards:
-            s.indexate()
+        table.indexate()
     return data

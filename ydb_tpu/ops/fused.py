@@ -37,6 +37,15 @@ from ydb_tpu.ops.sort import sort_env
 from ydb_tpu.ops.xla_exec import _eval, _trace_program, compress
 
 
+def apply_join_schema(schema: Schema, payload_cols: list) -> Schema:
+    """Schema effect of a join probe: payload columns replace any existing
+    columns with the same names and append at the end (the single source
+    of truth for fused schema threading)."""
+    taken = {p.name for p in payload_cols}
+    return Schema([c for c in schema.columns if c.name not in taken]
+                  + list(payload_cols))
+
+
 def build_fused_fn(pipe, final_program: Optional[ir.Program],
                    scan_cols: list, K: int, CAP: int,
                    sb_valid_names: frozenset, join_metas: list,
@@ -83,9 +92,7 @@ def build_fused_fn(pipe, final_program: Optional[ir.Program],
                 meta = join_metas[bi]
                 env, sel = probe_lut_traced(env, sel, builds[bi], meta)
                 bi += 1
-                cols = [c for c in schema.columns
-                        if c.name not in {p.name for p in meta["payload_cols"]}]
-                schema = Schema(cols + list(meta["payload_cols"]))
+                schema = apply_join_schema(schema, meta["payload_cols"])
             else:
                 env, length, sel, schema, cap = run(step, env, length, sel,
                                                     schema, cap)

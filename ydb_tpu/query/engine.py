@@ -30,14 +30,31 @@ class QueryError(Exception):
 
 class QueryEngine:
     def __init__(self, catalog: Optional[Catalog] = None,
-                 block_rows: int = 1 << 20, mesh=None):
+                 block_rows: int = 1 << 20, mesh=None,
+                 data_dir: Optional[str] = None):
         """`mesh`: a jax.sharding.Mesh for distributed execution — scans are
         row-partitioned across its devices and aggregation boundaries become
-        ICI hash shuffles (`ydb_tpu.parallel.make_mesh(n)` builds one)."""
+        ICI hash shuffles (`ydb_tpu.parallel.make_mesh(n)` builds one).
+
+        `data_dir`: durable root. An existing catalog there is recovered
+        (portions + WAL replay, `storage/persist.py`); otherwise a fresh
+        durable catalog is created. MVCC plan steps resume past the last
+        committed step so recovered versions stay ordered."""
+        restored_step = 0
+        if data_dir is not None and catalog is None:
+            import os
+
+            from ydb_tpu.storage.persist import Store
+            store = Store(data_dir)
+            if os.path.exists(os.path.join(data_dir, "catalog.json")):
+                catalog, restored_step = store.load()
+            else:
+                catalog = Catalog(store=store)
+                store.save_catalog(catalog)
         self.catalog = catalog or Catalog()
         self.planner = Planner(self.catalog)
         self.executor = Executor(self.catalog, block_rows, mesh=mesh)
-        self._plan_step = 1
+        self._plan_step = max(1, restored_step)
         self._tx_id = 1
         # plan cache (compile-service LRU analog, `kqp_compile_service.cpp:411`):
         # keyed by SQL text, validated against the (uid, data_version) of
@@ -263,14 +280,14 @@ class QueryEngine:
         tname = f"__tmp{self._tmp_n}"
         self._tmp_n += 1
         t = self.catalog.create_table(tname, block.schema,
-                                      [block.schema.names[0]], shards=1)
+                                      [block.schema.names[0]], shards=1,
+                                      transient=True)
         t.dictionaries = {n: cd.dictionary
                           for n, cd in block.columns.items()
                           if cd.dictionary is not None}
         if block.length:
             t.commit(t.write(block), self._next_version())
-            for s in t.shards:
-                s.indexate()
+            t.indexate()
         temps.append(tname)
         return tname
 
@@ -333,8 +350,7 @@ class QueryEngine:
                                       dict(table.dictionaries))
         writes = table.write(block)
         table.commit(writes, self._next_version())
-        for s in table.shards:
-            s.indexate()
+        table.indexate()
         return _unit_block()
 
 

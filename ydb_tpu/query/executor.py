@@ -97,18 +97,15 @@ class Executor:
         Returns the merged HostBlock on success; on fallback, the list of
         prepared join BuildTables (for `_run_pipeline` to reuse) or None
         if none were prepared."""
+        from ydb_tpu.core.dtypes import DType, Kind as _K
         from ydb_tpu.ops import fused as F
 
         pipe = plan.pipeline
         table = self.catalog.table(pipe.scan.table)
-        storage_names = [s for (s, _i) in pipe.scan.columns]
-        rename = {s: i for (s, i) in pipe.scan.columns}
-        sb = self.device_cache.superblock(table, storage_names, rename,
-                                          snapshot, pipe.scan.prune or None)
-        if sb is None:
-            return None                    # empty scan → portioned path
-        arrays, valids, lengths, K, CAP, sb_dicts = sb
 
+        # builds + fusability checks FIRST — the superblock stack/upload is
+        # the expensive part and must not run for plans that always take
+        # the portioned path
         join_steps = [step for kind, step in pipe.steps if kind == "join"]
         builds = [self._prepare_join(step, params, snapshot)
                   for step in join_steps]
@@ -119,38 +116,35 @@ class Executor:
 
         scan_cols = [Column(i, table.schema.dtype(s))
                      for (s, i) in pipe.scan.columns]
-        sb_valid_names = frozenset(valids.keys())
 
-        # dictionaries visible to sort setup: scan + build payloads
-        dicts = dict(sb_dicts)
+        # one schema walk over the pipeline: collects join metas, rejects
+        # float probe keys (a truncating LUT probe would mis-match 10.5
+        # against 10), and lands on the final schema used for sort setup
+        # and output selection
+        dicts = {}
         join_metas = []
         bi = 0
-        probe_schema = Schema(list(scan_cols))
+        schema = Schema(list(scan_cols))
         if pipe.pre_program is not None:
-            probe_schema = ir.infer_schema(pipe.pre_program, probe_schema)
+            schema = ir.infer_schema(pipe.pre_program, schema)
         for kind, step in pipe.steps:
             if kind != "join":
-                probe_schema = ir.infer_schema(step, probe_schema)
+                schema = ir.infer_schema(step, schema)
                 continue
             bt = builds[bi]
             bi += 1
-            # LUTs address integer keys — a float probe key would truncate
-            # (10.5 → 10 would "match"); those joins stay on the
-            # searchsorted path
-            from ydb_tpu.core.dtypes import Kind as _K
-            if probe_schema.dtype(step.probe_key).kind in (_K.FLOAT64,
-                                                           _K.FLOAT32):
+            if schema.dtype(step.probe_key).kind in (_K.FLOAT64,
+                                                     _K.FLOAT32):
                 return builds
             payload_cols = []
             for name in bt.schema.names:
-                dt = bt.schema.dtype(name).with_nullable(True)
-                payload_cols.append(Column(name, dt))
+                payload_cols.append(
+                    Column(name, bt.schema.dtype(name).with_nullable(True)))
                 if name in bt.dictionaries:
                     dicts[name] = bt.dictionaries[name]
             if step.kind == "mark":
-                from ydb_tpu.core.dtypes import DType, Kind
                 payload_cols.append(Column(step.mark_col or "__mark",
-                                           DType(Kind.BOOL, False)))
+                                           DType(_K.BOOL, False)))
             join_metas.append({
                 "probe_key": step.probe_key,
                 "kind": step.kind,
@@ -160,12 +154,24 @@ class Executor:
                 "not_in": step.not_in,
                 "payload_cols": payload_cols,
             })
-            cols = [c for c in probe_schema.columns
-                    if c.name not in {p.name for p in payload_cols}]
-            probe_schema = Schema(cols + payload_cols)
+            schema = F.apply_join_schema(schema, payload_cols)
+        if pipe.partial is not None:
+            schema = ir.infer_schema(pipe.partial, schema)
+        if plan.final_program is not None:
+            schema = ir.infer_schema(plan.final_program, schema)
+
+        storage_names = [s for (s, _i) in pipe.scan.columns]
+        rename = {s: i for (s, i) in pipe.scan.columns}
+        sb = self.device_cache.superblock(table, storage_names, rename,
+                                          snapshot, pipe.scan.prune or None)
+        if sb is None:
+            return builds or None          # empty scan → portioned path
+        arrays, valids, lengths, K, CAP, sb_dicts = sb
+        sb_valid_names = frozenset(valids.keys())
+        dicts.update(sb_dicts)
 
         sort_params, sort_spec, rank_assigns = self._sort_setup_fused(
-            plan, scan_cols, join_metas, dicts)
+            plan, schema, dicts)
         all_params = {**params, **sort_params}
 
         builds_sig = tuple(F.build_inputs_sig(bt) for bt in builds)
@@ -178,8 +184,10 @@ class Executor:
                 pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
                 join_metas, rank_assigns, sort_spec, plan.limit, plan.offset,
                 tuple(dict.fromkeys(n for (n, _lbl) in plan.output)))
-            out_schema = self._fused_out_schema(plan, scan_cols, join_metas)
-            entry = (fn, out_schema)
+            keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
+            out_cols = [c for c in schema.columns if c.name in keep] \
+                or list(schema.columns)
+            entry = (fn, Schema(out_cols))
             self._fused_cache[key] = entry
         fn, out_schema = entry
 
@@ -202,20 +210,11 @@ class Executor:
             block = block.slice(lo, min(hi, block.length))
         return block
 
-    def _fused_out_schema(self, plan: QueryPlan, scan_cols: list,
-                          join_metas: list) -> Schema:
-        schema = self._fused_final_schema(plan, scan_cols, join_metas)
-        keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
-        out_cols = [c for c in schema.columns if c.name in keep] \
-            or list(schema.columns)
-        return Schema(out_cols)
-
-    def _sort_setup_fused(self, plan: QueryPlan, scan_cols: list,
-                          join_metas: list, dicts: dict):
+    def _sort_setup_fused(self, plan: QueryPlan, schema: Schema,
+                          dicts: dict):
         """Rank-LUT sort params against the fused pipeline's final schema
         (mirrors `_sort_setup`, which works from partial-output blocks)."""
         from ydb_tpu.core import dtypes as dt
-        schema = self._fused_final_schema(plan, scan_cols, join_metas)
         sort_params, rank_assigns, spec = {}, [], []
         dicts = {**dicts, **plan.result_dicts}
         for j, sk in enumerate(plan.sort):
@@ -236,28 +235,6 @@ class Executor:
             else:
                 spec.append((sk.name, sk.ascending, sk.nulls_first))
         return sort_params, tuple(spec), rank_assigns
-
-    def _fused_final_schema(self, plan: QueryPlan, scan_cols: list,
-                            join_metas: list) -> Schema:
-        schema = Schema(list(scan_cols))
-        pipe = plan.pipeline
-        bi = 0
-        if pipe.pre_program is not None:
-            schema = ir.infer_schema(pipe.pre_program, schema)
-        for kind, step in pipe.steps:
-            if kind == "join":
-                meta = join_metas[bi]
-                bi += 1
-                cols = [c for c in schema.columns
-                        if c.name not in {p.name for p in meta["payload_cols"]}]
-                schema = Schema(cols + list(meta["payload_cols"]))
-            else:
-                schema = ir.infer_schema(step, schema)
-        if pipe.partial is not None:
-            schema = ir.infer_schema(pipe.partial, schema)
-        if plan.final_program is not None:
-            schema = ir.infer_schema(plan.final_program, schema)
-        return schema
 
     # -- distributed (mesh) path -------------------------------------------
 

@@ -16,22 +16,35 @@ from ydb_tpu.storage.table import ColumnTable
 
 
 class Catalog:
-    def __init__(self):
+    def __init__(self, store=None):
+        """`store`: a `ydb_tpu.storage.persist.Store` for durability; None
+        keeps the catalog purely in-memory (tests, transient engines)."""
         self.tables: dict[str, ColumnTable] = {}
+        self.store = store
         self._next_version = 1
 
     def create_table(self, name: str, schema: Schema, key_columns: list[str],
                      shards: int = 1, portion_rows: int = 1 << 20,
-                     partition_by: Optional[list[str]] = None) -> ColumnTable:
+                     partition_by: Optional[list[str]] = None,
+                     transient: bool = False) -> ColumnTable:
+        """`transient`: never persisted (materialized CTE/derived-table
+        temps)."""
         if name in self.tables:
             raise ValueError(f"table {name!r} already exists")
         t = ColumnTable(name, schema, key_columns, shards, portion_rows,
                         partition_by)
         self.tables[name] = t
+        if self.store is not None and not transient:
+            t.store = self.store
+            self.store.create_table(t)
+            self.store.save_catalog(self)
         return t
 
     def drop_table(self, name: str) -> None:
-        del self.tables[name]
+        t = self.tables.pop(name)
+        if self.store is not None and t.store is not None:
+            self.store.drop_table(name)
+            self.store.save_catalog(self)
 
     def table(self, name: str) -> ColumnTable:
         t = self.tables.get(name)

@@ -88,8 +88,11 @@ class DeviceColumnCache:
             return None
         K = len(sources)
         CAP = max(bucket_capacity(max(b.length, 1)) for b in sources)
-        src_key = (table.uid, table.data_version,
-                   (snapshot.plan_step, snapshot.tx_id), tuple(src_ids), CAP)
+        # no snapshot component: src_ids already reflect exactly which
+        # sources the snapshot sees (portions are immutable), and
+        # data_version covers commits — a snapshot in the key would make
+        # every write to ANY table re-stack and re-upload this one
+        src_key = (table.uid, table.data_version, tuple(src_ids), CAP)
 
         lengths_np = np.array([b.length for b in sources], np.int32)
         arrays, valids, dicts = {}, {}, {}

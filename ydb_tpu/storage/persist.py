@@ -1,0 +1,300 @@
+"""Durable storage: on-disk portions + write-ahead insert log + recovery.
+
+The reference persists every byte through the LocalDB redo log + snapshot
+boot over BlobStorage (`ydb/core/tablet_flat/flat_executor.h:320`,
+`flat_boot_*.h`); ColumnShard additionally owns an insert-table → portions
+lifecycle (`ydb/core/tx/columnshard/engines/insert_table/`). The TPU build
+keeps that shape but stores straight to the local filesystem (BlobStorage's
+erasure/replication layer is a separate concern):
+
+    <root>/
+      catalog.json                   table metas (schema, pk, sharding)
+      state.json                     last committed plan step
+      <table>/
+        dicts.json                   per-column string dictionaries
+        shard_<i>/
+          wal.jsonl                  insert log: write / commit records
+          wal_<wid>.npz              staged insert block (columnar)
+          portion_<id>.npz           immutable indexed portion
+          manifest.json              live portions + wal high-water mark
+
+Crash consistency: json files go through write-tmp + atomic rename; the
+WAL is append-only with per-record flush. Indexation order is (1) portion
+files, (2) manifest rename (with ``wal_consumed_through`` = the highest
+write id baked into portions), (3) WAL truncate — a crash between (2) and
+(3) is healed at boot by skipping replay of consumed write ids.
+
+Recovery (`Store.load`, the `flat_boot_misc.cpp` analog): read catalog +
+dictionaries, load portion files, then replay the WAL — uncommitted writes
+re-stage, committed-but-unindexed writes become visible inserts again.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from ydb_tpu.core.block import ColumnData, HostBlock
+from ydb_tpu.core.dictionary import Dictionary
+from ydb_tpu.core.dtypes import DType, Kind
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.storage.mvcc import WriteVersion
+
+
+def _atomic_json(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _read_json(path: str, default=None):
+    if not os.path.exists(path):
+        return default
+    with open(path) as f:
+        return json.load(f)
+
+
+def _save_block_npz(path: str, block: HostBlock) -> None:
+    arrays = {}
+    for name, cd in block.columns.items():
+        arrays[f"d_{name}"] = cd.data
+        if cd.valid is not None:
+            arrays[f"v_{name}"] = cd.valid
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+
+
+def _load_block_npz(path: str, schema: Schema, dicts: dict) -> HostBlock:
+    with np.load(path) as z:
+        cols = {}
+        length = 0
+        for c in schema:
+            d = z[f"d_{c.name}"]
+            v = z[f"v_{c.name}"] if f"v_{c.name}" in z.files else None
+            cols[c.name] = ColumnData(d, v, dicts.get(c.name))
+            length = len(d)
+    return HostBlock(schema, cols, length)
+
+
+class Store:
+    """Filesystem persistence for a catalog of column tables."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    # -- paths -------------------------------------------------------------
+
+    def _tdir(self, table: str) -> str:
+        return os.path.join(self.root, table)
+
+    def _sdir(self, table: str, shard: int) -> str:
+        return os.path.join(self.root, table, f"shard_{shard}")
+
+    # -- catalog -----------------------------------------------------------
+
+    def save_catalog(self, catalog) -> None:
+        metas = {}
+        for name, t in catalog.tables.items():
+            metas[name] = {
+                "schema": [[c.name, c.dtype.kind.value, c.dtype.nullable]
+                           for c in t.schema],
+                "key_columns": t.key_columns,
+                "partition_by": t.partition_by,
+                "shards": len(t.shards),
+                "portion_rows": t.shards[0].portion_rows,
+            }
+        _atomic_json(os.path.join(self.root, "catalog.json"),
+                     {"tables": metas})
+
+    def save_state(self, last_plan_step: int) -> None:
+        _atomic_json(os.path.join(self.root, "state.json"),
+                     {"last_plan_step": last_plan_step})
+
+    def load_state(self) -> int:
+        return _read_json(os.path.join(self.root, "state.json"),
+                          {"last_plan_step": 0})["last_plan_step"]
+
+    def create_table(self, table) -> None:
+        for s in table.shards:
+            os.makedirs(self._sdir(table.name, s.shard_id), exist_ok=True)
+        self.save_dictionaries(table)
+
+    def drop_table(self, name: str) -> None:
+        import shutil
+        if os.path.isdir(self._tdir(name)):
+            shutil.rmtree(self._tdir(name))
+
+    def save_dictionaries(self, table) -> None:
+        vals = {col: list(d.values_array())
+                for col, d in table.dictionaries.items()}
+        _atomic_json(os.path.join(self._tdir(table.name), "dicts.json"), vals)
+
+    # -- WAL ---------------------------------------------------------------
+
+    def wal_write(self, table: str, shard: int, wid: int,
+                  block: HostBlock) -> None:
+        sdir = self._sdir(table, shard)
+        _save_block_npz(os.path.join(sdir, f"wal_{wid}.npz"), block)
+        self._wal_append(sdir, {"op": "write", "wid": wid})
+
+    def wal_commit(self, table: str, shard: int, wids: list,
+                   version: WriteVersion) -> None:
+        self._wal_append(self._sdir(table, shard),
+                         {"op": "commit", "wids": wids,
+                          "plan_step": version.plan_step,
+                          "tx_id": version.tx_id})
+
+    def _wal_append(self, sdir: str, rec: dict) -> None:
+        with open(os.path.join(sdir, "wal.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    # -- portions ----------------------------------------------------------
+
+    def save_indexation(self, table, shard) -> None:
+        """Persist a shard's portion set after indexate()/compact() and
+        truncate the consumed WAL prefix."""
+        sdir = self._sdir(table.name, shard.shard_id)
+        live = []
+        for p in shard.portions:
+            path = os.path.join(sdir, f"portion_{p.id}.npz")
+            if not os.path.exists(path):
+                _save_block_npz(path, p.block)
+            live.append({"id": p.id, "rows": p.num_rows,
+                         "plan_step": p.version.plan_step,
+                         "tx_id": p.version.tx_id})
+        # a write id is replayable iff still pending here, or newer than
+        # anything this manifest knew about (a single high-water mark would
+        # be wrong when an old uncommitted write outlives newer consumed
+        # ones)
+        _atomic_json(os.path.join(sdir, "manifest.json"),
+                     {"portions": live,
+                      "pending_wids": [e.write_id for e in shard.inserts],
+                      "max_wid": shard._next_write_id - 1})
+        # drop orphaned portion files (compaction) and consumed wal blocks
+        keep = {f"portion_{e['id']}.npz" for e in live}
+        still = {f"wal_{e.write_id}.npz" for e in shard.inserts}
+        for fn in os.listdir(sdir):
+            if fn.startswith("portion_") and fn.endswith(".npz") \
+                    and fn not in keep:
+                os.unlink(os.path.join(sdir, fn))
+            if fn.startswith("wal_") and fn.endswith(".npz") \
+                    and fn not in still:
+                os.unlink(os.path.join(sdir, fn))
+        # rewrite the WAL with only still-pending entries
+        wal = os.path.join(sdir, "wal.jsonl")
+        recs = []
+        for e in shard.inserts:
+            recs.append({"op": "write", "wid": e.write_id})
+            if e.committed_version is not None:
+                recs.append({"op": "commit", "wids": [e.write_id],
+                             "plan_step": e.committed_version.plan_step,
+                             "tx_id": e.committed_version.tx_id})
+        tmp = wal + ".tmp"
+        with open(tmp, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, wal)
+
+    # -- recovery ----------------------------------------------------------
+
+    def load(self):
+        """Rebuild a Catalog from disk (the flat_boot analog). Returns
+        (catalog, last_plan_step)."""
+        from ydb_tpu.scheme.catalog import Catalog
+        from ydb_tpu.storage.portion import Portion, _portion_ids
+        from ydb_tpu.storage.shard import InsertEntry
+
+        catalog = Catalog(store=None)      # attach after load (no re-writes)
+        meta = _read_json(os.path.join(self.root, "catalog.json"),
+                          {"tables": {}})
+        for name, tm in meta["tables"].items():
+            schema = Schema([Column(n, DType(Kind(k), nullable))
+                             for (n, k, nullable) in tm["schema"]])
+            t = catalog.create_table(
+                name, schema, tm["key_columns"], shards=tm["shards"],
+                portion_rows=tm["portion_rows"],
+                partition_by=tm["partition_by"])
+            dvals = _read_json(os.path.join(self._tdir(name), "dicts.json"),
+                               {})
+            for col, vals in dvals.items():
+                d = Dictionary()
+                d.encode(list(vals))
+                t.dictionaries[col] = d
+            for c in schema:
+                if c.dtype.is_string and c.name not in t.dictionaries:
+                    t.dictionaries[c.name] = Dictionary()
+
+            for shard in t.shards:
+                sdir = self._sdir(name, shard.shard_id)
+                man = _read_json(os.path.join(sdir, "manifest.json"),
+                                 {"portions": [], "pending_wids": None,
+                                  "max_wid": 0})
+                for e in man["portions"]:
+                    block = _load_block_npz(
+                        os.path.join(sdir, f"portion_{e['id']}.npz"),
+                        schema, t.dictionaries)
+                    # restore the persisted id: a fresh one would alias a
+                    # different portion_<id>.npz on the next indexation
+                    p = Portion.from_block(
+                        block, WriteVersion(e["plan_step"], e["tx_id"]),
+                        id=e["id"])
+                    shard.portions.append(p)
+                    _portion_ids.ensure_above(e["id"])
+                # crash leftovers (portion written, manifest not) must not
+                # be aliased by future ids either
+                for fn in os.listdir(sdir):
+                    if fn.startswith("portion_") and fn.endswith(".npz"):
+                        _portion_ids.ensure_above(
+                            int(fn[len("portion_"):-len(".npz")]))
+                pending = man["pending_wids"]
+                max_wid = man["max_wid"]
+
+                def replayable(wid: int) -> bool:
+                    if pending is None:      # no manifest yet: replay all
+                        return True
+                    return wid in pending or wid > max_wid
+
+                staged: dict[int, InsertEntry] = {}
+                wal = os.path.join(sdir, "wal.jsonl")
+                if os.path.exists(wal):
+                    with open(wal) as f:
+                        for line in f:
+                            line = line.strip()
+                            if not line:
+                                continue
+                            rec = json.loads(line)
+                            if rec["op"] == "write":
+                                wid = rec["wid"]
+                                if not replayable(wid):
+                                    continue   # baked into portions already
+                                block = _load_block_npz(
+                                    os.path.join(sdir, f"wal_{wid}.npz"),
+                                    schema, t.dictionaries)
+                                staged[wid] = InsertEntry(block, wid)
+                            elif rec["op"] == "commit":
+                                ver = WriteVersion(rec["plan_step"],
+                                                   rec["tx_id"])
+                                for wid in rec["wids"]:
+                                    if wid in staged:
+                                        staged[wid].committed_version = ver
+                for wid in sorted(staged):
+                    shard.inserts.append(staged[wid])
+                    if staged[wid].committed_version:
+                        shard.rows_written += staged[wid].block.length
+                shard._next_write_id = max([max_wid] + list(staged)) + 1
+            # re-arm durability: post-recovery writes must persist too
+            t.store = self
+        catalog.store = self
+        return catalog, self.load_state()

@@ -8,7 +8,6 @@ write version that committed it.
 
 from __future__ import annotations
 
-import itertools
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -17,7 +16,23 @@ import numpy as np
 from ydb_tpu.core.block import HostBlock
 from ydb_tpu.storage.mvcc import WriteVersion
 
-_portion_ids = itertools.count(1)
+
+class _IdGen:
+    """Monotonic portion ids; recovery advances past ids found on disk so
+    new portions never collide with persisted files."""
+
+    def __init__(self):
+        self.n = 0
+
+    def __next__(self) -> int:
+        self.n += 1
+        return self.n
+
+    def ensure_above(self, m: int) -> None:
+        self.n = max(self.n, m)
+
+
+_portion_ids = _IdGen()
 
 
 @dataclass
@@ -39,7 +54,10 @@ class Portion:
         return self.block.length
 
     @staticmethod
-    def from_block(block: HostBlock, version: WriteVersion) -> "Portion":
+    def from_block(block: HostBlock, version: WriteVersion,
+                   id: Optional[int] = None) -> "Portion":
+        """`id`: recovery restores the persisted portion id (a fresh one
+        would alias a different on-disk file)."""
         stats = {}
         for c in block.schema:
             cd = block.columns[c.name]
@@ -53,6 +71,8 @@ class Portion:
                 st.min = vals.min()
                 st.max = vals.max()
             stats[c.name] = st
+        if id is not None:
+            return Portion(block, version, stats, id)
         return Portion(block, version, stats)
 
 

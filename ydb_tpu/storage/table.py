@@ -46,6 +46,8 @@ class ColumnTable:
         # `kqp_compile_service.cpp:411`). uid distinguishes drop/recreate.
         self.uid = next(_table_uids)
         self.data_version = 0
+        # durability hook (ydb_tpu/storage/persist.Store); None = volatile
+        self.store = None
 
     @property
     def num_shards(self) -> int:
@@ -64,16 +66,22 @@ class ColumnTable:
         return (h % np.uint64(len(self.shards))).astype(np.int64)
 
     def write(self, block: HostBlock) -> list[tuple[int, int]]:
-        """Stage rows into shards; returns [(shard_id, write_id)]."""
+        """Stage rows into shards (WAL-logged when durable); returns
+        [(shard_id, write_id)]."""
+        staged: list[tuple[int, int, HostBlock]] = []
         if len(self.shards) == 1:
-            return [(0, self.shards[0].write(block))]
-        dest = self._route(block)
-        out = []
-        for sid in range(len(self.shards)):
-            idx = np.nonzero(dest == sid)[0]
-            if len(idx):
-                out.append((sid, self.shards[sid].write(block.take(idx))))
-        return out
+            staged.append((0, self.shards[0].write(block), block))
+        else:
+            dest = self._route(block)
+            for sid in range(len(self.shards)):
+                idx = np.nonzero(dest == sid)[0]
+                if len(idx):
+                    blk = block.take(idx)
+                    staged.append((sid, self.shards[sid].write(blk), blk))
+        if self.store is not None:
+            for sid, wid, blk in staged:
+                self.store.wal_write(self.name, sid, wid, blk)
+        return [(sid, wid) for (sid, wid, _b) in staged]
 
     def commit(self, writes: list[tuple[int, int]], version: WriteVersion) -> None:
         by_shard: dict[int, list[int]] = {}
@@ -82,6 +90,31 @@ class ColumnTable:
         for sid, wids in by_shard.items():
             self.shards[sid].commit(wids, version)
         self.data_version += 1
+        if self.store is not None:
+            for sid, wids in by_shard.items():
+                self.store.wal_commit(self.name, sid, wids, version)
+            self.store.save_dictionaries(self)
+            self.store.save_state(version.plan_step)
+
+    def indexate(self) -> int:
+        """Background indexation across shards (persists portion sets)."""
+        made = 0
+        for s in self.shards:
+            n = s.indexate()
+            made += n
+            if self.store is not None and n:
+                self.store.save_indexation(self, s)
+        return made
+
+    def compact(self) -> int:
+        """Compaction across shards (persists the rewritten portion sets)."""
+        merged = 0
+        for s in self.shards:
+            n = s.compact()
+            merged += n
+            if self.store is not None and n:
+                self.store.save_indexation(self, s)
+        return merged
 
     def bulk_upsert(self, df, version: WriteVersion) -> int:
         """Ingest a pandas DataFrame (BulkUpsert analog): write+commit+indexate."""
@@ -89,8 +122,7 @@ class ColumnTable:
                                       dictionaries=self.dictionaries)
         writes = self.write(block)
         self.commit(writes, version)
-        for s in self.shards:
-            s.indexate()
+        self.indexate()
         return block.length
 
     # -- read path --------------------------------------------------------

@@ -326,3 +326,14 @@ def test_float_probe_key_join_not_truncated():
     df = e.query("select ff.id, dd.w from ff join dd on ff.x = dd.k order by ff.id")
     assert list(df.id) == [2]
     assert list(df.w) == [200]
+
+
+def test_order_by_unprojected_column():
+    """Regression (r3): ORDER BY a column absent from the SELECT list must
+    survive the output projection until the sort runs."""
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("create table t (id Int64 not null, bal Int64 not null, "
+              "primary key (id))")
+    e.execute("insert into t (id, bal) values (2, 20), (1, 10), (3, 30)")
+    df = e.query("select bal from t order by id desc")
+    assert list(df.bal) == [30, 20, 10]

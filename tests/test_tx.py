@@ -1,0 +1,282 @@
+"""Interactive transactions: isolation, atomicity, optimistic locks,
+randomized serializability.
+
+The analog of the reference's KQP tx suites + the in-house serializability
+checker (`ydb/core/kqp/ut/tx`, `ydb/tests/tools/ydb_serializable/`):
+concurrent sessions interleave BEGIN/SELECT/UPSERT/COMMIT on shared
+tables; committed history must equal some serial order.
+"""
+
+import numpy as np
+import pytest
+
+from ydb_tpu.query import QueryEngine, QueryError
+from ydb_tpu.tx import TxAborted
+
+
+@pytest.fixture
+def eng():
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("""create table acct (id Int64 not null, bal Int64 not null,
+                 primary key (id)) with (store = row)""")
+    e.execute("insert into acct (id, bal) values (1, 100), (2, 100), (3, 100)")
+    return e
+
+
+def test_tx_atomic_commit(eng):
+    s = eng.session()
+    s.execute("begin")
+    s.execute("update acct set bal = bal - 30 where id = 1")
+    s.execute("update acct set bal = bal + 30 where id = 2")
+    # other sessions see nothing until commit
+    other = eng.query("select sum(bal) as t, min(bal) as lo from acct")
+    assert other.t[0] == 300 and other.lo[0] == 100
+    s.execute("commit")
+    df = eng.query("select id, bal from acct order by id")
+    assert list(df.bal) == [70, 130, 100]
+
+
+def test_tx_rollback_discards(eng):
+    s = eng.session()
+    s.execute("begin")
+    s.execute("update acct set bal = 0 where id = 1")
+    s.execute("delete from acct where id = 2")
+    assert list(s.query("select bal from acct order by id").bal) == [0, 100]
+    s.execute("rollback")
+    df = eng.query("select id, bal from acct order by id")
+    assert list(df.id) == [1, 2, 3] and list(df.bal) == [100] * 3
+
+
+def test_tx_reads_own_writes_and_snapshot(eng):
+    s = eng.session()
+    s.execute("begin")
+    s.execute("upsert into acct (id, bal) values (4, 50)")
+    assert s.query("select count(*) as n from acct").n[0] == 4
+    # a commit by another session AFTER our BEGIN is invisible to us
+    eng.execute("upsert into acct (id, bal) values (5, 77)")
+    assert s.query("select count(*) as n from acct").n[0] == 4
+    assert eng.query("select count(*) as n from acct").n[0] == 4  # 3 + id5
+    s.execute("rollback")
+    assert eng.query("select count(*) as n from acct").n[0] == 4
+
+
+def test_tx_optimistic_lock_conflict(eng):
+    s1, s2 = eng.session(), eng.session()
+    s1.execute("begin")
+    # s1 reads acct → lock
+    assert s1.query("select bal from acct where id = 1").bal[0] == 100
+    # s2 commits a write to acct behind s1's back
+    s2.execute("update acct set bal = 999 where id = 3")
+    s1.execute("update acct set bal = bal - 10 where id = 1")
+    with pytest.raises(QueryError, match="optimistic lock"):
+        s1.execute("commit")
+    # aborted tx left nothing behind
+    df = eng.query("select id, bal from acct order by id")
+    assert list(df.bal) == [100, 100, 999]
+
+
+def test_tx_no_conflict_on_unrelated_table(eng):
+    eng.execute("""create table other (id Int64 not null, primary key (id))
+                 with (store = row)""")
+    s1 = eng.session()
+    s1.execute("begin")
+    s1.execute("update acct set bal = 1 where id = 1")
+    eng.execute("insert into other (id) values (1)")   # unrelated commit
+    s1.execute("commit")                               # must succeed
+    assert eng.query("select bal from acct where id = 1").bal[0] == 1
+
+
+def test_tx_column_table_insert(eng):
+    eng.execute("create table log (id Int64 not null, primary key (id))")
+    s = eng.session()
+    s.execute("begin")
+    s.execute("insert into log (id) values (1), (2)")
+    assert s.query("select count(*) as n from log").n[0] == 2
+    assert eng.query("select count(*) as n from log").n[0] == 0
+    s.execute("commit")
+    assert eng.query("select count(*) as n from log").n[0] == 2
+
+
+def test_tx_column_table_rollback(eng):
+    eng.execute("create table log (id Int64 not null, primary key (id))")
+    s = eng.session()
+    s.execute("begin")
+    s.execute("insert into log (id) values (1)")
+    s.execute("rollback")
+    assert eng.query("select count(*) as n from log").n[0] == 0
+
+
+def test_tx_ddl_rejected(eng):
+    s = eng.session()
+    s.execute("begin")
+    with pytest.raises(QueryError, match="DDL"):
+        s.execute("create table x (id Int64 not null, primary key (id))")
+    s.execute("rollback")
+
+
+def test_tx_durability(tmp_path):
+    ddir = str(tmp_path / "d")
+    e = QueryEngine(block_rows=1 << 13, data_dir=ddir)
+    e.execute("""create table acct (id Int64 not null, bal Int64 not null,
+                 primary key (id)) with (store = row)""")
+    e.execute("insert into acct (id, bal) values (1, 100), (2, 100)")
+    s = e.session()
+    s.execute("begin")
+    s.execute("update acct set bal = bal - 40 where id = 1")
+    s.execute("update acct set bal = bal + 40 where id = 2")
+    s.execute("commit")
+    s2 = e.session()
+    s2.execute("begin")
+    s2.execute("update acct set bal = 0 where id = 1")
+    s2.execute("rollback")
+    e2 = QueryEngine(block_rows=1 << 13, data_dir=ddir)
+    df = e2.query("select id, bal from acct order by id")
+    assert list(df.bal) == [60, 140]
+
+
+def test_randomized_serializability(eng):
+    """Jepsen-style check (ydb_serializable analog): random interleaved
+    transfer transactions; committed ones must form a serializable
+    history. With table-granular optimistic locks every pair of committed
+    txs conflicts, so the commit order IS the serial order — replaying
+    committed transfers serially must reproduce the final state, and the
+    total must be invariant throughout."""
+    rng = np.random.default_rng(7)
+    committed = []
+    sessions = []
+    for _ in range(60):
+        if rng.random() < 0.4:
+            # a fully sequential tx (no interleaving → always commits)
+            s = eng.session()
+            src, dst = rng.choice([1, 2, 3], 2, replace=False)
+            amt = int(rng.integers(1, 20))
+            s.execute("begin")
+            s.execute(f"update acct set bal = bal - {amt} where id = {src}")
+            s.execute(f"update acct set bal = bal + {amt} where id = {dst}")
+            s.execute("commit")
+            committed.append([(int(src), int(dst), amt)])
+        elif sessions and rng.random() < 0.6:
+            s, plan = sessions.pop(rng.integers(len(sessions)))
+            try:
+                for (src, dst, amt) in plan:
+                    s.execute(f"update acct set bal = bal - {amt} "
+                              f"where id = {src}")
+                    s.execute(f"update acct set bal = bal + {amt} "
+                              f"where id = {dst}")
+                if rng.random() < 0.8:
+                    s.execute("commit")
+                    committed.append(plan)
+                else:
+                    s.execute("rollback")
+            except QueryError:
+                pass                        # optimistic abort
+        else:
+            s = eng.session()
+            s.execute("begin")
+            src, dst = rng.choice([1, 2, 3], 2, replace=False)
+            amt = int(rng.integers(1, 20))
+            sessions.append((s, [(int(src), int(dst), amt)]))
+        # invariant: committed total never changes
+        assert eng.query("select sum(bal) as t from acct").t[0] == 300
+    for s, _plan in sessions:
+        try:
+            s.execute("rollback")
+        except QueryError:
+            pass
+    # serial replay of the committed transfers reproduces the final state
+    bal = {1: 100, 2: 100, 3: 100}
+    for plan in committed:
+        for (src, dst, amt) in plan:
+            bal[src] -= amt
+            bal[dst] += amt
+    df = eng.query("select id, bal from acct order by id")
+    assert list(df.bal) == [bal[1], bal[2], bal[3]]
+    assert len(committed) > 5, "too few commits to be meaningful"
+
+
+def test_atomic_insert_batch_failure(eng):
+    """Regression (r3 review): a failing multi-row INSERT must leave
+    nothing behind — in autocommit AND inside a transaction."""
+    with pytest.raises(QueryError, match="duplicate"):
+        eng.execute("insert into acct (id, bal) values (9, 1), (1, 2)")
+    assert eng.query("select count(*) as n from acct").n[0] == 3
+    s = eng.session()
+    s.execute("begin")
+    with pytest.raises(QueryError, match="duplicate"):
+        s.execute("insert into acct (id, bal) values (8, 1), (8, 2)")
+    s.execute("commit")
+    assert eng.query("select count(*) as n from acct").n[0] == 3
+
+
+def test_tx_staged_column_write_invalidates_plan_cache(eng):
+    """Regression (r3 review): a tx-staged column INSERT grows shared
+    dictionaries — the tx's own reads must not reuse a stale cached plan."""
+    eng.execute("""create table c (id Int64 not null, s Utf8 not null,
+                 primary key (id))""")
+    eng.execute("insert into c (id, s) values (1, 'alpha'), (2, 'beta')")
+    q = "select s, count(*) as n from c group by s order by s"
+    assert list(eng.query(q).s) == ["alpha", "beta"]   # plan now cached
+    s = eng.session()
+    s.execute("begin")
+    s.execute("insert into c (id, s) values (3, 'zeta')")
+    df = s.query(q)
+    assert list(df.s) == ["alpha", "beta", "zeta"]
+    assert list(df.n) == [1, 1, 1]
+    s.execute("rollback")
+    assert list(eng.query(q).s) == ["alpha", "beta"]
+
+
+def test_crashed_open_tx_writes_discarded_at_boot(tmp_path):
+    """Regression (r3 review): column writes staged by a tx that never
+    committed must be dropped at recovery, not resurrected as zombies."""
+    ddir = str(tmp_path / "d")
+    e = QueryEngine(block_rows=1 << 13, data_dir=ddir)
+    e.execute("create table c (id Int64 not null, primary key (id))")
+    e.execute("insert into c (id) values (1)")
+    s = e.session()
+    s.execute("begin")
+    s.execute("insert into c (id) values (2)")
+    # process "dies" here with the tx open (no commit/rollback)
+    e2 = QueryEngine(block_rows=1 << 13, data_dir=ddir)
+    assert e2.query("select count(*) as n from c").n[0] == 1
+    t = e2.catalog.table("c")
+    assert all(en.committed_version is not None
+               for sh in t.shards for en in sh.inserts)
+    # but a COMMITTED tx's writes must survive the same crash
+    s2 = e2.session()
+    s2.execute("begin")
+    s2.execute("insert into c (id) values (3)")
+    s2.execute("commit")
+    e3 = QueryEngine(block_rows=1 << 13, data_dir=ddir)
+    assert sorted(e3.query("select id from c").id) == [1, 3]
+
+
+def test_plan_step_covers_wal_when_state_json_lags(tmp_path):
+    """Regression (r3 review): recovery derives the plan-step watermark
+    from replayed versions, not just state.json (which can lag a crash
+    between wal_commit and save_state)."""
+    import json, os
+    ddir = str(tmp_path / "d")
+    e = QueryEngine(block_rows=1 << 13, data_dir=ddir)
+    e.execute("create table c (id Int64 not null, primary key (id))")
+    e.execute("insert into c (id) values (1)")
+    step = e._plan_step
+    # simulate the crash window: state.json rolled back behind the WAL
+    with open(os.path.join(ddir, "state.json"), "w") as f:
+        json.dump({"last_plan_step": 1}, f)
+    e2 = QueryEngine(block_rows=1 << 13, data_dir=ddir)
+    assert e2._plan_step >= step
+    assert e2.query("select count(*) as n from c").n[0] == 1
+
+
+def test_insert_select_column_subset(eng):
+    """Regression (r3 review): INSERT..SELECT with a column subset
+    null-fills nullable columns instead of raising KeyError."""
+    eng.execute("""create table src (k Int64 not null, primary key (k))""")
+    eng.execute("insert into src (k) values (1), (2)")
+    eng.execute("""create table dst (k Int64 not null, v Double,
+                 primary key (k))""")
+    eng.execute("insert into dst (k) select k from src")
+    df = eng.query("select k, v from dst order by k")
+    assert list(df.k) == [1, 2]
+    assert df.v.isna().all()

@@ -1,1 +1,1 @@
-from ydb_tpu.query.engine import QueryEngine  # noqa: F401
+from ydb_tpu.query.engine import QueryEngine, QueryError  # noqa: F401

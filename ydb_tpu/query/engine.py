@@ -3,8 +3,9 @@
 Plays the role of the KQP session actor + compile service
 (`kqp_session_actor.cpp:455` CompileQuery → `ExecutePhyTx`): parses, plans
 (with a fingerprint-keyed plan cache), executes, and applies DDL/DML against
-the catalog. Single-session, single-node for now; the distributed planner
-and the transactional write path slot in behind the same interface.
+the catalog. Interactive transactions (BEGIN/COMMIT/ROLLBACK with
+optimistic locks) live in `ydb_tpu/tx`; `engine.session()` opens
+concurrent sessions over the shared engine.
 """
 
 from __future__ import annotations
@@ -54,8 +55,12 @@ class QueryEngine:
         self.catalog = catalog or Catalog()
         self.planner = Planner(self.catalog)
         self.executor = Executor(self.catalog, block_rows, mesh=mesh)
-        self._plan_step = max(1, restored_step)
-        self._tx_id = 1
+        from ydb_tpu.tx import Coordinator, Session
+        self.coordinator = Coordinator(start_step=max(1, restored_step))
+        # the engine's own statements run through a default session
+        # (autocommit unless BEGIN is issued on it); `session()` opens
+        # additional concurrent sessions
+        self._default_session = Session(self)
         # plan cache (compile-service LRU analog, `kqp_compile_service.cpp:411`):
         # keyed by SQL text, validated against the (uid, data_version) of
         # every table the statement references — plans snapshot dictionary
@@ -65,23 +70,51 @@ class QueryEngine:
         self.plan_cache_hits = 0
         self._tmp_n = 0
 
-    # -- versions (standing in for coordinator/mediator time) -------------
+    # -- versions (coordinator time, ydb_tpu/tx/coordinator.py) ------------
+
+    @property
+    def _plan_step(self) -> int:
+        return self.coordinator.last_plan_step
 
     def _next_version(self) -> WriteVersion:
-        self._plan_step += 1
-        return WriteVersion(self._plan_step, self._tx_id)
+        return self.coordinator.propose(0)
 
     def snapshot(self) -> Snapshot:
-        return Snapshot(self._plan_step, 2 ** 62)
+        return self.coordinator.read_snapshot()
+
+    def session(self):
+        """Open an interactive session (BEGIN/COMMIT/ROLLBACK scope)."""
+        from ydb_tpu.tx import Session
+        return Session(self)
 
     # -- entry -------------------------------------------------------------
 
-    def execute(self, sql: str) -> HostBlock:
+    def execute(self, sql: str, session=None) -> HostBlock:
+        session = session or self._default_session
         stmt = parse(sql)
+        tx = session.tx
+        snap = tx.snapshot if tx is not None else self.snapshot()
         try:
+            if isinstance(stmt, ast.Begin):
+                session.begin()
+                return _unit_block()
+            if isinstance(stmt, ast.Commit):
+                from ydb_tpu.tx import TxAborted
+                try:
+                    session.commit()
+                except TxAborted as e:
+                    raise QueryError(str(e)) from e
+                return _unit_block()
+            if isinstance(stmt, ast.Rollback):
+                session.rollback()
+                return _unit_block()
             if isinstance(stmt, ast.Select):
+                if tx is not None:
+                    for name in self._referenced_tables(stmt):
+                        if self.catalog.has(name):
+                            tx.lock(self.catalog.table(name))
                 if self._needs_materialize(stmt):
-                    return self._execute_materialized(stmt)
+                    return self._execute_materialized(stmt, snap)
                 fp = self._table_fingerprint(stmt)
                 cached = self._plan_cache.get(sql)
                 if cached is not None and cached[0] == fp:
@@ -90,19 +123,39 @@ class QueryEngine:
                 else:
                     plan = self.planner.plan_select(stmt)
                     self._plan_cache[sql] = (fp, plan)
-                return self.executor.execute(plan, self.snapshot())
+                return self.executor.execute(plan, snap)
             if isinstance(stmt, ast.CreateTable):
+                if tx is not None:
+                    raise QueryError("DDL inside a transaction is not "
+                                     "supported")
                 return self._create_table(stmt)
             if isinstance(stmt, ast.DropTable):
+                if tx is not None:
+                    raise QueryError("DDL inside a transaction is not "
+                                     "supported")
                 if stmt.if_exists and not self.catalog.has(stmt.name):
                     return _unit_block()
                 self.catalog.drop_table(stmt.name)
                 return _unit_block()
             if isinstance(stmt, ast.Insert):
-                return self._insert(stmt)
+                return self._insert(stmt, snap, tx)
+            if isinstance(stmt, ast.Update):
+                return self._update(stmt, snap, tx)
+            if isinstance(stmt, ast.Delete):
+                return self._delete(stmt, snap, tx)
             raise QueryError(f"unsupported statement {type(stmt).__name__}")
         except (BindError, PlanError) as e:
             raise QueryError(str(e)) from e
+
+    def _run_select(self, sel: ast.Select,
+                    snap: Optional[Snapshot] = None) -> HostBlock:
+        """Execute an in-memory Select AST (DML subflows: INSERT…SELECT,
+        UPDATE/DELETE row evaluation) — no text-keyed plan cache."""
+        snap = snap or self.snapshot()
+        if self._needs_materialize(sel):
+            return self._execute_materialized(sel, snap)
+        plan = self.planner.plan_select(sel)
+        return self.executor.execute(plan, snap)
 
     def explain(self, sql: str) -> str:
         stmt = parse(sql)
@@ -118,6 +171,16 @@ class QueryEngine:
         """(name, uid, data_version) of every table the statement touches —
         the plan-cache validity key (reference keys its compile cache on
         query text + schema version, `kqp_compile_service.cpp:411`)."""
+        out = []
+        for n in sorted(self._referenced_tables(sel)):
+            if self.catalog.has(n):
+                t = self.catalog.table(n)
+                out.append((n, t.uid, t.data_version))
+        return tuple(out)
+
+    def _referenced_tables(self, sel: ast.Select) -> set:
+        """Every table name the statement touches (plan-cache keys and
+        transaction read-lock acquisition)."""
         names: set = set()
 
         def walk_sel(s: ast.Select):
@@ -158,12 +221,7 @@ class QueryEngine:
                 walk_val(getattr(e, f))
 
         walk_sel(sel)
-        out = []
-        for n in sorted(names):
-            if self.catalog.has(n):
-                t = self.catalog.table(n)
-                out.append((n, t.uid, t.data_version))
-        return tuple(out)
+        return names
 
     # -- CTE / derived-table materialization -------------------------------
     #
@@ -208,23 +266,26 @@ class QueryEngine:
                 return True
         return False
 
-    def _execute_materialized(self, sel: ast.Select) -> HostBlock:
+    def _execute_materialized(self, sel: ast.Select,
+                              snap: Optional[Snapshot] = None) -> HostBlock:
+        snap = snap or self.snapshot()
         temps: list = []
         try:
-            sel2 = self._rewrite_sel(sel, {}, temps)
+            sel2 = self._rewrite_sel(sel, {}, temps, snap)
             plan = self.planner.plan_select(sel2)
-            return self.executor.execute(plan, self.snapshot())
+            return self.executor.execute(plan, snap)
         finally:
             for t in temps:
                 if self.catalog.has(t):
                     self.catalog.drop_table(t)
 
     def _rewrite_sel(self, sel: ast.Select, cte_map: dict,
-                     temps: list) -> ast.Select:
+                     temps: list, snap: Optional[Snapshot] = None
+                     ) -> ast.Select:
         cte_map = dict(cte_map)
         for (name, body) in sel.ctes:
             cte_map[name] = self._materialize(
-                self._rewrite_sel(body, cte_map, temps), temps)
+                self._rewrite_sel(body, cte_map, temps, snap), temps, snap)
 
         def rewrite_rel(r):
             if isinstance(r, ast.TableRef):
@@ -238,7 +299,8 @@ class QueryEngine:
                                 rewrite_expr(r.on))
             if isinstance(r, ast.SubqueryRef):
                 t = self._materialize(
-                    self._rewrite_sel(r.query, cte_map, temps), temps)
+                    self._rewrite_sel(r.query, cte_map, temps, snap), temps,
+                    snap)
                 return ast.TableRef(t, r.alias)
             return r
 
@@ -248,7 +310,8 @@ class QueryEngine:
                 return e
             if isinstance(e, (ast.Exists, ast.InSubquery,
                               ast.ScalarSubquery)):
-                kw = {"query": self._rewrite_sel(e.query, cte_map, temps)}
+                kw = {"query": self._rewrite_sel(e.query, cte_map, temps,
+                                                 snap)}
                 if isinstance(e, ast.InSubquery):
                     kw["arg"] = rewrite_expr(e.arg)
                 return dataclasses.replace(e, **kw)
@@ -274,9 +337,10 @@ class QueryEngine:
                                       o.nulls_first) for o in out.order_by]
         return out
 
-    def _materialize(self, sel: ast.Select, temps: list) -> str:
-        block = self.executor.execute(self.planner.plan_select(sel),
-                                      self.snapshot())
+    def _materialize(self, sel: ast.Select, temps: list,
+                     snap: Optional[Snapshot] = None) -> str:
+        snap = snap or self.snapshot()
+        block = self.executor.execute(self.planner.plan_select(sel), snap)
         tname = f"__tmp{self._tmp_n}"
         self._tmp_n += 1
         t = self.catalog.create_table(tname, block.schema,
@@ -286,7 +350,11 @@ class QueryEngine:
                           for n, cd in block.columns.items()
                           if cd.dictionary is not None}
         if block.length:
-            t.commit(t.write(block), self._next_version())
+            # committed INSIDE the driving snapshot (tx snapshots are
+            # pinned — a fresh coordinator step would be invisible); the
+            # temp is private and dropped right after, so the early
+            # version leaks nowhere
+            t.commit(t.write(block), WriteVersion(snap.plan_step, 0))
             t.indexate()
         temps.append(tname)
         return tname
@@ -302,13 +370,16 @@ class QueryEngine:
                 for (name, ty, not_null) in stmt.columns]
         pk = stmt.primary_key or [cols[0].name]
         self.catalog.create_table(stmt.name, Schema(cols), pk,
-                                  shards=max(1, stmt.partition_count))
+                                  shards=max(1, stmt.partition_count),
+                                  store_kind=stmt.store)
         return _unit_block()
 
-    def _insert(self, stmt: ast.Insert) -> HostBlock:
+    def _insert(self, stmt: ast.Insert, snap=None, tx=None) -> HostBlock:
         table = self.catalog.table(stmt.table)
+        if tx is not None:
+            tx.lock(table)
         if stmt.query is not None:
-            raise QueryError("INSERT ... SELECT not supported yet")
+            return self._insert_select(stmt, table, snap, tx)
         names = stmt.columns or table.schema.names
         data: dict[str, list] = {n: [] for n in names}
         from ydb_tpu.query.binder import _try_fold
@@ -323,6 +394,18 @@ class QueryEngine:
                 if folded is None:
                     raise QueryError("VALUES must be constant expressions")
                 data[n].append(folded.value)
+
+        if getattr(table, "store_kind", "column") == "row":
+            kind = {"insert": "insert", "upsert": "upsert",
+                    "replace": "replace"}[stmt.mode]
+            ops = []
+            for i in range(len(stmt.rows)):
+                ops.append((kind, {n: data[n][i] for n in names}))
+            try:
+                self._apply_row_ops(table, ops, tx)
+            except ValueError as e:
+                raise QueryError(str(e)) from e
+            return _unit_block()
 
         arrays, valids = {}, {}
         n_rows = len(stmt.rows)
@@ -348,10 +431,217 @@ class QueryEngine:
                 valids[c.name] = np.zeros(n_rows, dtype=bool)
         block = HostBlock.from_arrays(table.schema, arrays, valids,
                                       dict(table.dictionaries))
+        if tx is not None:
+            writes = table.write(block, tx=tx.tx_id)
+            tx.col_writes.append((table, writes))
+            tx.note_self_bump(table)   # staged write bumps data_version
+            return _unit_block()
         writes = table.write(block)
         table.commit(writes, self._next_version())
         table.indexate()
         return _unit_block()
+
+    def _apply_row_ops(self, table, ops, tx) -> None:
+        """Row-table mutation: immediate at a fresh version (autocommit)
+        or staged under the open transaction."""
+        if not ops:
+            return
+        if tx is not None:
+            table.apply(ops, None, durable=False, tx=tx.tx_id)
+            tx.row_writes.append((table, ops))
+            tx.note_self_bump(table)
+        else:
+            table.apply(ops, self._next_version())
+
+
+    # -- UPDATE / DELETE ---------------------------------------------------
+    #
+    # Row tables (DataShard analog): evaluate the WHERE through the normal
+    # query path, then apply point mutations on the version chains — MVCC
+    # snapshots keep seeing the old rows.
+    #
+    # Column tables: evaluated the same way, then applied by rewriting the
+    # affected portions (copy-on-write minus deleted rows). This matches
+    # the reference's bulk semantics in spirit but, unlike the row path,
+    # does NOT preserve time travel — historical snapshots see the
+    # post-delete state (the distributed-tx layer can tighten this later).
+
+    def _update(self, stmt: ast.Update, snap=None, tx=None) -> HostBlock:
+        table = self.catalog.table(stmt.table)
+        if tx is not None:
+            tx.lock(table)
+            if getattr(table, "store_kind", "column") != "row":
+                raise QueryError("UPDATE of column tables inside a "
+                                 "transaction is not supported (portion "
+                                 "rewrite is non-transactional)")
+        set_cols = [c for (c, _e) in stmt.assignments]
+        for c in set_cols:
+            if c in table.key_columns:
+                raise QueryError("UPDATE of primary key columns is not "
+                                 "supported (DELETE + INSERT)")
+        # constant assignments (incl. string literals, which the binder
+        # cannot type outside comparisons) apply directly; computed
+        # expressions evaluate through the query path
+        from ydb_tpu.query.binder import _try_fold
+        const_vals: dict = {}
+        computed: list = []
+        for (c, e) in stmt.assignments:
+            if isinstance(e, ast.Literal) and e.value is None:
+                const_vals[c] = None
+                continue
+            folded = _try_fold(e)
+            if folded is not None:
+                const_vals[c] = folded.value
+            else:
+                computed.append((c, e))
+
+        if getattr(table, "store_kind", "column") == "row":
+            items = [ast.SelectItem(ast.Name((k,)), k)
+                     for k in table.key_columns]
+            items += [ast.SelectItem(e, f"__set_{c}")
+                      for (c, e) in computed]
+            df = self._run_select(ast.Select(
+                items=items, relation=ast.TableRef(stmt.table),
+                where=stmt.where), snap).to_pandas()
+            ops = []
+            for row in df.to_dict("records"):
+                vals = {k: _native(row[k]) for k in table.key_columns}
+                vals.update(const_vals)
+                vals.update({c: _native(row[f"__set_{c}"])
+                             for (c, _e) in computed})
+                ops.append(("upsert", vals))
+            self._apply_row_ops(table, ops, tx)
+            return _unit_block()
+        # column table: select full updated rows, drop originals, re-insert
+        items = [ast.SelectItem(ast.Name((c,)), c)
+                 for c in table.schema.names]
+        items += [ast.SelectItem(e, f"__set_{c}") for (c, e) in computed]
+        df = self._run_select(ast.Select(
+            items=items, relation=ast.TableRef(stmt.table),
+            where=stmt.where), snap).to_pandas()
+        for (c, _e) in computed:
+            df[c] = df.pop(f"__set_{c}")
+        for c, v in const_vals.items():
+            df[c] = v
+        self._column_delete(table, stmt.where)
+        if len(df):
+            table.bulk_upsert(df[list(table.schema.names)],
+                              self._next_version())
+        return _unit_block()
+
+    def _delete(self, stmt: ast.Delete, snap=None, tx=None) -> HostBlock:
+        table = self.catalog.table(stmt.table)
+        if tx is not None:
+            tx.lock(table)
+            if getattr(table, "store_kind", "column") != "row":
+                raise QueryError("DELETE from column tables inside a "
+                                 "transaction is not supported (portion "
+                                 "rewrite is non-transactional)")
+        if getattr(table, "store_kind", "column") == "row":
+            items = [ast.SelectItem(ast.Name((k,)), k)
+                     for k in table.key_columns]
+            df = self._run_select(ast.Select(
+                items=items, relation=ast.TableRef(stmt.table),
+                where=stmt.where), snap).to_pandas()
+            ops = [("delete", {k: _native(row[k])
+                               for k in table.key_columns})
+                   for row in df.to_dict("records")]
+            self._apply_row_ops(table, ops, tx)
+            return _unit_block()
+        self._column_delete(table, stmt.where)
+        return _unit_block()
+
+    def _column_delete(self, table, where) -> int:
+        """Delete by predicate on a column table via portion rewrite."""
+        import pandas as pd
+
+        keys = table.key_columns
+        pks = self._run_select(ast.Select(
+            items=[ast.SelectItem(ast.Name((k,)), k) for k in keys],
+            relation=ast.TableRef(table.name),
+            where=where)).to_pandas().drop_duplicates()
+        if pks.empty:
+            return 0
+        from ydb_tpu.storage.portion import Portion
+        table.indexate()          # inserts → portions first: the WAL must
+        #                           never resurrect rewritten rows
+        removed = 0
+        for shard in table.shards:
+            new_portions = []
+            changed = False
+            for p in shard.portions:
+                kdf = p.block.select(keys).to_pandas()
+                kdf["__pos"] = np.arange(len(kdf))
+                hit = kdf.merge(pks, on=keys, how="inner")["__pos"]
+                if not len(hit):
+                    new_portions.append(p)
+                    continue
+                changed = True
+                removed += len(hit)
+                keep = np.setdiff1d(np.arange(p.num_rows),
+                                    hit.to_numpy())
+                if len(keep):
+                    new_portions.append(
+                        Portion.from_block(p.block.take(keep), p.version))
+            if changed:
+                shard.portions = new_portions
+                if table.store is not None:
+                    table.store.save_indexation(table, shard)
+        table.data_version += 1   # invalidate plan/superblock caches
+        return removed
+
+    def _insert_select(self, stmt: ast.Insert, table, snap=None,
+                       tx=None) -> HostBlock:
+        block = self._run_select(stmt.query, snap)
+        df = block.to_pandas()
+        names = stmt.columns or table.schema.names
+        if len(df.columns) != len(names):
+            raise QueryError("INSERT ... SELECT arity mismatch")
+        df.columns = names
+        if getattr(table, "store_kind", "column") == "row":
+            # ops carry only the named columns — "upsert" must keep the
+            # unmentioned ones, so no null-filling here (apply() enforces
+            # NOT NULL for genuinely absent values)
+            kind = {"insert": "insert", "upsert": "upsert",
+                    "replace": "replace"}[stmt.mode]
+            ops = [(kind, {c: _native(v) for c, v in row.items()})
+                   for row in df.to_dict("records")]
+            try:
+                self._apply_row_ops(table, ops, tx)
+            except ValueError as e:
+                raise QueryError(str(e)) from e
+            return _unit_block()
+        # null-fill unspecified columns (the VALUES path's semantics)
+        for c in table.schema:
+            if c.name not in df.columns:
+                if not c.dtype.nullable:
+                    raise QueryError(f"missing NOT NULL column {c.name}")
+                df[c.name] = None
+        df = df[list(table.schema.names)]
+        if tx is not None and len(df):
+            from ydb_tpu.core.block import HostBlock as _HB
+            blk = _HB.from_pandas(df, schema=table.schema,
+                                  dictionaries=table.dictionaries)
+            writes = table.write(blk, tx=tx.tx_id)
+            tx.col_writes.append((table, writes))
+            tx.note_self_bump(table)   # staged write bumps data_version
+            return _unit_block()
+        if len(df):
+            table.bulk_upsert(df, self._next_version())
+        return _unit_block()
+
+
+def _native(v):
+    """pandas cell → python native (None for NA; unwrap numpy scalars)."""
+    import pandas as pd
+    if v is None or (isinstance(v, float) and v != v):
+        return None
+    try:
+        if pd.isna(v):
+            return None
+    except (TypeError, ValueError):
+        pass
+    return v.item() if hasattr(v, "item") else v
 
 
 def _unit_block() -> HostBlock:

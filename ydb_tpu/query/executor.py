@@ -376,7 +376,7 @@ class Executor:
         rename = {s: i for (s, i) in pipe.scan.columns}
         i = 0
         for shard in table.shards:
-            portions, insert_blocks = shard.scan_sources(
+            portions, insert_entries = shard.scan_sources(
                 snapshot, pipe.scan.prune or None)
             for p in portions:
                 if devices is None:
@@ -387,8 +387,8 @@ class Executor:
                     i += 1
                     yield di, self.device_cache.device_block(
                         p, storage_names, rename, device=devices[di])
-            for blk in insert_blocks:
-                hb = _rename_block(blk.select(storage_names), rename)
+            for e in insert_entries:
+                hb = _rename_block(e.block.select(storage_names), rename)
                 if devices is None:
                     yield to_device(hb)
                 else:

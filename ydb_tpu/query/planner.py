@@ -1399,6 +1399,7 @@ class Planner:
             e = bind_fn(alias_deref(o.expr, positional=True))
             if isinstance(e, ir.Col):
                 name = e.name
+                extra.append(name)     # keep through the output projection
             else:
                 name = f"sort{j}"
                 prog.assign(name, e)

@@ -26,13 +26,20 @@ class Catalog:
     def create_table(self, name: str, schema: Schema, key_columns: list[str],
                      shards: int = 1, portion_rows: int = 1 << 20,
                      partition_by: Optional[list[str]] = None,
-                     transient: bool = False) -> ColumnTable:
+                     transient: bool = False,
+                     store_kind: str = "column"):
         """`transient`: never persisted (materialized CTE/derived-table
-        temps)."""
+        temps). `store_kind`: "column" (ColumnShard analog) or "row"
+        (DataShard analog, `storage/rowtable.py`)."""
         if name in self.tables:
             raise ValueError(f"table {name!r} already exists")
-        t = ColumnTable(name, schema, key_columns, shards, portion_rows,
-                        partition_by)
+        if store_kind == "row":
+            from ydb_tpu.storage.rowtable import RowTable
+            t = RowTable(name, schema, key_columns, shards, portion_rows,
+                         partition_by)
+        else:
+            t = ColumnTable(name, schema, key_columns, shards, portion_rows,
+                            partition_by)
         self.tables[name] = t
         if self.store is not None and not transient:
             t.store = self.store

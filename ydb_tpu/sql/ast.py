@@ -220,4 +220,20 @@ class Update:
     where: Optional[Expr] = None
 
 
-Statement = Union[Select, CreateTable, DropTable, Insert, Delete, Update]
+@dataclass(frozen=True)
+class Begin:
+    pass
+
+
+@dataclass(frozen=True)
+class Commit:
+    pass
+
+
+@dataclass(frozen=True)
+class Rollback:
+    pass
+
+
+Statement = Union[Select, CreateTable, DropTable, Insert, Delete, Update,
+                  Begin, Commit, Rollback]

@@ -26,7 +26,7 @@ KEYWORDS = {
     "create", "table", "primary", "key", "drop", "insert", "upsert",
     "replace", "into", "values", "delete", "update", "set", "if", "with",
     "union", "all", "escape", "substring", "for", "partition", "store",
-    "extract",
+    "extract", "begin", "commit", "rollback", "transaction",
 }
 
 _OPS = ["<>", "!=", ">=", "<=", "||", "(", ")", ",", "+", "-", "*", "/", "%",

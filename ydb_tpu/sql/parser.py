@@ -81,6 +81,13 @@ class Parser:
             stmt = self.parse_delete()
         elif self.at_kw("update"):
             stmt = self.parse_update()
+        elif self.accept_kw("begin"):
+            self.accept_kw("transaction")
+            stmt = ast.Begin()
+        elif self.accept_kw("commit"):
+            stmt = ast.Commit()
+        elif self.accept_kw("rollback"):
+            stmt = ast.Rollback()
         else:
             raise SqlError(f"unexpected {self.peek().value!r} at "
                            f"{self.peek().pos}")

@@ -77,13 +77,15 @@ class DeviceColumnCache:
         sources = []          # HostBlocks
         src_ids = []
         for shard in table.shards:
-            portions, insert_blocks = shard.scan_sources(snapshot, prune)
+            portions, insert_entries = shard.scan_sources(snapshot, prune)
             for p in portions:
                 sources.append(p.block)
                 src_ids.append(("p", p.id))
-            for i, b in enumerate(insert_blocks):
-                sources.append(b)
-                src_ids.append(("i", shard.shard_id, i))
+            for e in insert_entries:
+                # write id, not list position: two snapshots seeing
+                # different insert subsets must not collide in the cache
+                sources.append(e.block)
+                src_ids.append(("i", shard.shard_id, e.write_id))
         if not sources:
             return None
         K = len(sources)

@@ -110,6 +110,7 @@ class Store:
                 "partition_by": t.partition_by,
                 "shards": len(t.shards),
                 "portion_rows": t.shards[0].portion_rows,
+                "store_kind": getattr(t, "store_kind", "column"),
             }
         _atomic_json(os.path.join(self.root, "catalog.json"),
                      {"tables": metas})
@@ -123,8 +124,12 @@ class Store:
                           {"last_plan_step": 0})["last_plan_step"]
 
     def create_table(self, table) -> None:
-        for s in table.shards:
-            os.makedirs(self._sdir(table.name, s.shard_id), exist_ok=True)
+        if getattr(table, "store_kind", "column") == "row":
+            os.makedirs(self._tdir(table.name), exist_ok=True)
+        else:
+            for s in table.shards:
+                os.makedirs(self._sdir(table.name, s.shard_id),
+                            exist_ok=True)
         self.save_dictionaries(table)
 
     def drop_table(self, name: str) -> None:
@@ -139,11 +144,30 @@ class Store:
 
     # -- WAL ---------------------------------------------------------------
 
+    def row_wal_append(self, table: str, ops: list,
+                       version: WriteVersion) -> None:
+        """Mutation log for row tables (the DataShard redo-log analog)."""
+        def native(v):
+            if hasattr(v, "item"):
+                return v.item()
+            return v
+
+        rec = {"plan_step": version.plan_step, "tx_id": version.tx_id,
+               "ops": [[kind, {c: native(v) for c, v in vals.items()}]
+                       for (kind, vals) in ops]}
+        with open(os.path.join(self._tdir(table), "rowwal.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
     def wal_write(self, table: str, shard: int, wid: int,
-                  block: HostBlock) -> None:
+                  block: HostBlock, tx=None) -> None:
         sdir = self._sdir(table, shard)
         _save_block_npz(os.path.join(sdir, f"wal_{wid}.npz"), block)
-        self._wal_append(sdir, {"op": "write", "wid": wid})
+        rec = {"op": "write", "wid": wid}
+        if tx is not None:
+            rec["tx"] = tx     # boot discards writes of txs that died open
+        self._wal_append(sdir, rec)
 
     def wal_commit(self, table: str, shard: int, wids: list,
                    version: WriteVersion) -> None:
@@ -151,6 +175,10 @@ class Store:
                          {"op": "commit", "wids": wids,
                           "plan_step": version.plan_step,
                           "tx_id": version.tx_id})
+
+    def wal_abort(self, table: str, shard: int, wids: list) -> None:
+        self._wal_append(self._sdir(table, shard),
+                         {"op": "abort", "wids": wids})
 
     def _wal_append(self, sdir: str, rec: dict) -> None:
         with open(os.path.join(sdir, "wal.jsonl"), "a") as f:
@@ -217,6 +245,11 @@ class Store:
         from ydb_tpu.storage.shard import InsertEntry
 
         catalog = Catalog(store=None)      # attach after load (no re-writes)
+        # last_plan_step must cover every version replayed from disk:
+        # state.json can lag a crash that landed between the fsynced
+        # wal_commit and save_state (committed data would be invisible and
+        # plan steps would be re-granted)
+        seen_step = 0
         meta = _read_json(os.path.join(self.root, "catalog.json"),
                           {"tables": {}})
         for name, tm in meta["tables"].items():
@@ -225,7 +258,8 @@ class Store:
             t = catalog.create_table(
                 name, schema, tm["key_columns"], shards=tm["shards"],
                 portion_rows=tm["portion_rows"],
-                partition_by=tm["partition_by"])
+                partition_by=tm["partition_by"],
+                store_kind=tm.get("store_kind", "column"))
             dvals = _read_json(os.path.join(self._tdir(name), "dicts.json"),
                                {})
             for col, vals in dvals.items():
@@ -235,6 +269,24 @@ class Store:
             for c in schema:
                 if c.dtype.is_string and c.name not in t.dictionaries:
                     t.dictionaries[c.name] = Dictionary()
+
+            if tm.get("store_kind", "column") == "row":
+                wal = os.path.join(self._tdir(name), "rowwal.jsonl")
+                if os.path.exists(wal):
+                    with open(wal) as f:
+                        for line in f:
+                            line = line.strip()
+                            if not line:
+                                continue
+                            rec = json.loads(line)
+                            ver = WriteVersion(rec["plan_step"],
+                                               rec["tx_id"])
+                            ops = [(kind, vals)
+                                   for (kind, vals) in rec["ops"]]
+                            t.apply(ops, ver, durable=False)
+                            seen_step = max(seen_step, ver.plan_step)
+                t.store = self
+                continue
 
             for shard in t.shards:
                 sdir = self._sdir(name, shard.shard_id)
@@ -252,6 +304,7 @@ class Store:
                         id=e["id"])
                     shard.portions.append(p)
                     _portion_ids.ensure_above(e["id"])
+                    seen_step = max(seen_step, e["plan_step"])
                 # crash leftovers (portion written, manifest not) must not
                 # be aliased by future ids either
                 for fn in os.listdir(sdir):
@@ -268,27 +321,36 @@ class Store:
 
                 staged: dict[int, InsertEntry] = {}
                 wal = os.path.join(sdir, "wal.jsonl")
+                recs = []
                 if os.path.exists(wal):
                     with open(wal) as f:
-                        for line in f:
-                            line = line.strip()
-                            if not line:
-                                continue
-                            rec = json.loads(line)
-                            if rec["op"] == "write":
-                                wid = rec["wid"]
-                                if not replayable(wid):
-                                    continue   # baked into portions already
-                                block = _load_block_npz(
-                                    os.path.join(sdir, f"wal_{wid}.npz"),
-                                    schema, t.dictionaries)
-                                staged[wid] = InsertEntry(block, wid)
-                            elif rec["op"] == "commit":
-                                ver = WriteVersion(rec["plan_step"],
-                                                   rec["tx_id"])
-                                for wid in rec["wids"]:
-                                    if wid in staged:
-                                        staged[wid].committed_version = ver
+                        recs = [json.loads(line) for line in f
+                                if line.strip()]
+                committed_wids = {wid for r in recs if r["op"] == "commit"
+                                  for wid in r["wids"]}
+                for rec in recs:
+                    if rec["op"] == "write":
+                        wid = rec["wid"]
+                        if not replayable(wid):
+                            continue       # baked into portions already
+                        if rec.get("tx") is not None \
+                                and wid not in committed_wids:
+                            # staged by a tx that died open: its commit
+                            # can never arrive — implicit rollback at boot
+                            continue
+                        block = _load_block_npz(
+                            os.path.join(sdir, f"wal_{wid}.npz"),
+                            schema, t.dictionaries)
+                        staged[wid] = InsertEntry(block, wid)
+                    elif rec["op"] == "commit":
+                        ver = WriteVersion(rec["plan_step"], rec["tx_id"])
+                        seen_step = max(seen_step, ver.plan_step)
+                        for wid in rec["wids"]:
+                            if wid in staged:
+                                staged[wid].committed_version = ver
+                    elif rec["op"] == "abort":
+                        for wid in rec["wids"]:
+                            staged.pop(wid, None)
                 for wid in sorted(staged):
                     shard.inserts.append(staged[wid])
                     if staged[wid].committed_version:
@@ -297,4 +359,4 @@ class Store:
             # re-arm durability: post-recovery writes must persist too
             t.store = self
         catalog.store = self
-        return catalog, self.load_state()
+        return catalog, max(self.load_state(), seen_step)

@@ -35,6 +35,7 @@ class InsertEntry:
     block: HostBlock
     write_id: int
     committed_version: Optional[WriteVersion] = None
+    tx: Optional[int] = None       # open interactive tx that staged this
 
 
 class ColumnShard:
@@ -50,18 +51,24 @@ class ColumnShard:
 
     # -- write path -------------------------------------------------------
 
-    def write(self, block: HostBlock) -> int:
+    def write(self, block: HostBlock, tx: Optional[int] = None) -> int:
         """Stage an uncommitted insert; returns write id (InsertTable model)."""
         wid = self._next_write_id
         self._next_write_id += 1
-        self.inserts.append(InsertEntry(block, wid))
+        self.inserts.append(InsertEntry(block, wid, tx=tx))
         return wid
 
     def commit(self, write_ids: list[int], version: WriteVersion) -> None:
         for e in self.inserts:
             if e.write_id in write_ids:
                 e.committed_version = version
+                e.tx = None
                 self.rows_written += e.block.length
+
+    def rollback(self, write_ids: list[int]) -> None:
+        self.inserts = [e for e in self.inserts
+                        if e.write_id not in write_ids
+                        or e.committed_version is not None]
 
     def indexate(self) -> int:
         """Background indexation: committed inserts → portions. Returns #portions."""
@@ -113,17 +120,20 @@ class ColumnShard:
     def scan_sources(self, snapshot: Snapshot = MAX_SNAPSHOT,
                      prune_predicates: Optional[list[tuple]] = None
                      ) -> tuple[list, list]:
-        """(visible portions, visible committed-but-unindexed insert blocks)
-        under the snapshot, after min/max pruning."""
+        """(visible portions, visible committed-but-unindexed InsertEntry
+        list) under the snapshot, after min/max pruning. Entries (not bare
+        blocks) so callers can key device caches on stable write ids."""
         prune_predicates = prune_predicates or []
         portions = [
             p for p in self.portions
             if snapshot.includes(p.version)
             and not any(prune_by_range(p, c, op, v)
                         for (c, op, v) in prune_predicates)]
-        inserts = [e.block for e in self.inserts
-                   if e.committed_version
-                   and snapshot.includes(e.committed_version)]
+        inserts = [e for e in self.inserts
+                   if (e.committed_version
+                       and snapshot.includes(e.committed_version))
+                   or (e.committed_version is None and e.tx is not None
+                       and e.tx == snapshot.tx_view)]
         return portions, inserts
 
     def scan(self, columns: list[str],
@@ -146,8 +156,10 @@ class ColumnShard:
                 return out
             return None
 
-        portions, insert_blocks = self.scan_sources(snapshot, prune_predicates)
-        sources = [p.block for p in portions] + insert_blocks
+        portions, insert_entries = self.scan_sources(snapshot,
+                                                     prune_predicates)
+        sources = [p.block for p in portions] + [e.block
+                                                 for e in insert_entries]
 
         for src in sources:
             blk = src.select(columns)

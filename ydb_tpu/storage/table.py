@@ -65,23 +65,41 @@ class ColumnTable:
         h = splitmix64(np, cd.data)
         return (h % np.uint64(len(self.shards))).astype(np.int64)
 
-    def write(self, block: HostBlock) -> list[tuple[int, int]]:
+    def write(self, block: HostBlock,
+              tx: Optional[int] = None) -> list[tuple[int, int]]:
         """Stage rows into shards (WAL-logged when durable); returns
-        [(shard_id, write_id)]."""
+        [(shard_id, write_id)]. `tx`: owning open transaction (entries
+        visible only through its tx_view until commit)."""
         staged: list[tuple[int, int, HostBlock]] = []
         if len(self.shards) == 1:
-            staged.append((0, self.shards[0].write(block), block))
+            staged.append((0, self.shards[0].write(block, tx), block))
         else:
             dest = self._route(block)
             for sid in range(len(self.shards)):
                 idx = np.nonzero(dest == sid)[0]
                 if len(idx):
                     blk = block.take(idx)
-                    staged.append((sid, self.shards[sid].write(blk), blk))
+                    staged.append((sid, self.shards[sid].write(blk, tx),
+                                   blk))
+        if tx is not None:
+            # staged writes grow shared dictionaries and change what the
+            # owning tx's snapshot sees — cached plans must re-fingerprint
+            self.data_version += 1
         if self.store is not None:
             for sid, wid, blk in staged:
-                self.store.wal_write(self.name, sid, wid, blk)
+                self.store.wal_write(self.name, sid, wid, blk, tx=tx)
         return [(sid, wid) for (sid, wid, _b) in staged]
+
+    def rollback(self, writes: list[tuple[int, int]]) -> None:
+        """Drop staged-but-uncommitted writes (interactive tx abort)."""
+        by_shard: dict[int, list[int]] = {}
+        for sid, wid in writes:
+            by_shard.setdefault(sid, []).append(wid)
+        for sid, wids in by_shard.items():
+            self.shards[sid].rollback(wids)
+            if self.store is not None:
+                self.store.wal_abort(self.name, sid, wids)
+        self.data_version += 1
 
     def commit(self, writes: list[tuple[int, int]], version: WriteVersion) -> None:
         by_shard: dict[int, list[int]] = {}

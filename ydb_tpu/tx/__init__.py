@@ -1,0 +1,2 @@
+from ydb_tpu.tx.coordinator import Coordinator  # noqa: F401
+from ydb_tpu.tx.session import Session, Transaction, TxAborted  # noqa: F401

@@ -1,0 +1,45 @@
+"""Transaction coordinator — plan-step allocation and commit ordering.
+
+The reference's coordinator tablet (`ydb/core/tx/coordinator/
+coordinator_impl.h:209`, `coordinator__plan_step.cpp`) assigns global plan
+steps that order distributed transactions across shards; the mediator
+(`ydb/core/tx/mediator/`) fans each step out to per-shard execute queues,
+and TimeCast (`time_cast/time_cast.h:70`) tells shards the safe watermark
+for MVCC reads.
+
+In-process v0: one Coordinator owns the monotonic (plan_step, tx_id)
+space. `propose` is the plan-step grant; because all shards live in this
+process, mediator fan-out degenerates to the caller applying the commit
+synchronously — the protocol boundary (propose → stamped version →
+per-shard apply) is kept so a networked mediator can slot in.
+"""
+
+from __future__ import annotations
+
+from ydb_tpu.storage.mvcc import Snapshot, WriteVersion
+
+
+class Coordinator:
+    def __init__(self, start_step: int = 1):
+        self._plan_step = max(1, start_step)
+        self._next_tx = 1
+
+    def begin_tx(self) -> int:
+        """Allocate a transaction id (the TxProxy tx-allocator analog)."""
+        tx = self._next_tx
+        self._next_tx += 1
+        return tx
+
+    def propose(self, tx_id: int = 0) -> WriteVersion:
+        """Grant the next plan step to a committing transaction."""
+        self._plan_step += 1
+        return WriteVersion(self._plan_step, tx_id)
+
+    def read_snapshot(self) -> Snapshot:
+        """Safe MVCC read watermark (the TimeCast analog): everything
+        planned so far is visible, nothing in flight is."""
+        return Snapshot(self._plan_step, 2 ** 62)
+
+    @property
+    def last_plan_step(self) -> int:
+        return self._plan_step

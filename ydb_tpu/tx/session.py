@@ -1,0 +1,138 @@
+"""Interactive transactions — BEGIN / COMMIT / ROLLBACK with optimistic
+locks.
+
+The reference's session actor holds per-session tx state
+(`ydb/core/kqp/session_actor/kqp_session_actor.cpp`), acquires optimistic
+locks during reads (`ydb/core/tx/locks/`), and commits through the
+coordinator plan-step protocol with lock validation at commit time.
+
+v0 semantics (snapshot isolation + table-granular optimistic locks):
+
+  * BEGIN captures the coordinator's read snapshot; every statement in the
+    tx reads at that snapshot PLUS the tx's own uncommitted writes
+    (`Snapshot.tx_view`);
+  * writes stage against storage tagged with the tx id — row tables get
+    unstamped version-chain entries, column tables uncommitted insert-table
+    writes — invisible to every other session;
+  * each table read or written records (uid, data_version-at-snapshot) in
+    the lock set; because own staged writes bump data_version, the lock
+    remembers how many bumps were self-inflicted;
+  * COMMIT validates every lock (any foreign bump since BEGIN → TxAborted,
+    the optimistic-lock-broken error), then takes one coordinator plan
+    step and stamps all staged writes at it — atomically visible, since
+    readers order by plan step;
+  * ROLLBACK (or abort) removes every staged write.
+
+Coarser than the reference's row/range locks (a foreign write to any READ
+table aborts), but sound: serializable over row tables, snapshot-write
+isolation over column tables.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ydb_tpu.storage.mvcc import Snapshot
+
+
+class TxAborted(Exception):
+    """Optimistic lock broken: a conflicting commit landed since BEGIN."""
+
+
+class Transaction:
+    def __init__(self, tx_id: int, snapshot: Snapshot,
+                 begin_versions: dict):
+        self.tx_id = tx_id
+        self.snapshot = Snapshot(snapshot.plan_step, snapshot.tx_id,
+                                 tx_view=tx_id)
+        # data_version of every table AS OF BEGIN — the lock baseline
+        # (first-touch versions would miss commits landing between BEGIN
+        # and the first read, which the tx's snapshot cannot see)
+        self.begin_versions = begin_versions
+        # uid -> [table, baseline version, self bumps since]
+        self.locks: dict = {}
+        self.row_writes: list = []     # (table, ops) in apply order
+        self.col_writes: list = []     # (table, [(shard, wid)])
+
+    def lock(self, table) -> None:
+        if table.uid not in self.locks:
+            seen = self.begin_versions.get(table.uid, table.data_version)
+            self.locks[table.uid] = [table, seen, 0]
+
+    def note_self_bump(self, table, n: int = 1) -> None:
+        self.lock(table)
+        self.locks[table.uid][2] += n
+
+    def validate(self) -> None:
+        for table, seen, self_bumps in self.locks.values():
+            if table.data_version - self_bumps != seen:
+                raise TxAborted(
+                    f"optimistic lock broken on table {table.name!r}")
+
+
+class Session:
+    """One interactive session over a shared engine (the session-actor
+    analog). Sessions share catalog/executor/coordinator; each holds at
+    most one open transaction."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.tx: Optional[Transaction] = None
+
+    # -- statement entry ---------------------------------------------------
+
+    def execute(self, sql: str):
+        return self.engine.execute(sql, session=self)
+
+    def query(self, sql: str):
+        return self.engine.execute(sql, session=self).to_pandas()
+
+    # -- tx control --------------------------------------------------------
+
+    def begin(self) -> None:
+        if self.tx is not None:
+            raise TxAborted("transaction already open")
+        coord = self.engine.coordinator
+        begin_versions = {t.uid: t.data_version
+                          for t in self.engine.catalog.tables.values()}
+        self.tx = Transaction(coord.begin_tx(), coord.read_snapshot(),
+                              begin_versions)
+
+    def commit(self) -> None:
+        tx = self._require_tx()
+        try:
+            tx.validate()
+        except TxAborted:
+            self._abort(tx)
+            raise
+        version = self.engine.coordinator.propose(tx.tx_id)
+        for table, ops in tx.row_writes:
+            table.stamp_tx(tx.tx_id, version, ops_for_wal=ops)
+        for table, writes in tx.col_writes:
+            table.commit(writes, version)
+            table.indexate()
+        if self.engine.catalog.store is not None:
+            self.engine.catalog.store.save_state(version.plan_step)
+        self.tx = None
+
+    def rollback(self) -> None:
+        tx = self._require_tx()
+        self._abort(tx)
+
+    def _abort(self, tx: Transaction) -> None:
+        for table, _ops in tx.row_writes:
+            table.rollback_tx(tx.tx_id)
+        for table, writes in tx.col_writes:
+            table.rollback(writes)
+        self.tx = None
+
+    def _require_tx(self) -> Transaction:
+        if self.tx is None:
+            raise TxAborted("no open transaction")
+        return self.tx
+
+    # -- engine integration ------------------------------------------------
+
+    @property
+    def snapshot(self) -> Optional[Snapshot]:
+        return self.tx.snapshot if self.tx is not None else None

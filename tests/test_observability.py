@@ -1,0 +1,64 @@
+"""Observability floor: per-query stats, EXPLAIN ANALYZE, counters,
+background compaction policy.
+
+The analog of the reference's plan-with-stats output + counters trees
+(`kqp_query_plan.cpp`, `library/cpp/monlib`, `.sys` query_metrics).
+"""
+
+from ydb_tpu.query import QueryEngine
+
+
+def mk():
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("""create table t (id Int64 not null, tag Utf8 not null,
+                 v Double not null, primary key (id))""")
+    e.execute("insert into t (id, tag, v) values "
+              "(1, 'a', 1.0), (2, 'b', 2.0), (3, 'a', 3.0)")
+    return e
+
+
+def test_query_stats_populated():
+    e = mk()
+    q = "select tag, sum(v) as s from t group by tag order by tag"
+    e.query(q)
+    st = e.last_stats
+    assert st.kind == "select" and st.rows_out == 2
+    assert st.total_ms > 0 and st.execute_ms > 0
+    assert st.tables == ["t"]
+    assert not st.plan_cache_hit
+    e.query(q)
+    assert e.last_stats.plan_cache_hit
+    assert e.last_stats.fused or not e.last_stats.distributed
+
+
+def test_explain_and_analyze_sql():
+    e = mk()
+    df = e.query("explain select tag, sum(v) as s from t group by tag")
+    text = "\n".join(df.plan)
+    assert "Scan t" in text and "groupby" in text
+    df = e.query("explain analyze select count(*) as n from t")
+    text = "\n".join(df.plan)
+    assert "-- stats:" in text and "rows out 1" in text
+
+
+def test_counters_snapshot():
+    e = mk()
+    e.query("select count(*) as n from t")
+    c = e.counters()
+    assert c["engine/statements"] >= 3
+    assert c["coordinator/plan_step"] >= 1
+    assert "device_cache/hits" in c and "program_cache/misses" in c
+
+
+def test_background_compaction_bounds_portions():
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("""create table t (id Int64 not null, primary key (id))
+                 with (partitions = 1)""")
+    t = e.catalog.table("t")
+    counts = []
+    for i in range(64):
+        e.execute(f"insert into t (id) values ({i})")
+        counts.append(len(t.shards[0].portions))
+    # sustained single-row inserts must not accumulate unbounded portions
+    assert max(counts) < 16
+    assert e.query("select count(*) as n from t").n[0] == 64

@@ -70,15 +70,36 @@ def test_prune_predicate_extraction():
 
 
 def test_compaction(rng):
+    # shard-level: small portions merge into full ones
     t = ColumnTable("t", SCHEMA, ["id"], shards=1, portion_rows=1000)
-    for i in range(10):
-        t.bulk_upsert(_df(rng, 100, base=i * 100), WriteVersion(1, 1))
     shard = t.shards[0]
+    for i in range(10):
+        wid = shard.write(
+            t._encode(_df(rng, 100, base=i * 100))
+            if hasattr(t, "_encode") else _block(t, rng, 100, i * 100))
+        shard.commit([wid], WriteVersion(1, 1))
+        shard.indexate()
     assert len(shard.portions) == 10
     merged = shard.compact()
     assert merged > 0
     assert len(shard.portions) == 1
     assert shard.num_rows == 1000
+
+
+def _block(t, rng, n, base):
+    from ydb_tpu.core.block import HostBlock
+    return HostBlock.from_pandas(_df(rng, n, base=base), schema=t.schema,
+                                 dictionaries=t.dictionaries)
+
+
+def test_auto_compaction_policy(rng):
+    # table-level: indexation triggers the background-compaction policy,
+    # keeping sustained small inserts bounded
+    t = ColumnTable("t", SCHEMA, ["id"], shards=1, portion_rows=1000)
+    for i in range(10):
+        t.bulk_upsert(_df(rng, 100, base=i * 100), WriteVersion(1 + i, 1))
+    assert len(t.shards[0].portions) < 10
+    assert t.shards[0].num_rows == 1000
 
 
 def test_multi_shard_routing(rng):

@@ -1,0 +1,152 @@
+"""Command-line interface — server, interactive SQL, benchmark workloads.
+
+The `ydb` CLI analog (`ydb/public/lib/ydb_cli`): `server` plays `ydbd
+server`, `sql` the query client, and `workload tpch init/run` the
+benchmark runner (`commands/tpch.h:9-66`, shared runner
+`benchmark_utils.cpp` — per-query times + geomean).
+
+    python -m ydb_tpu.cli server --data-dir /path --port 2136
+    python -m ydb_tpu.cli sql "select 1 as x" [--endpoint host:port]
+    python -m ydb_tpu.cli workload tpch init --sf 0.1 [--data-dir /path]
+    python -m ydb_tpu.cli workload tpch run [--queries q1,q6] [--repeat 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+
+
+def _embedded_engine(args):
+    from ydb_tpu.query import QueryEngine
+    return QueryEngine(data_dir=getattr(args, "data_dir", None))
+
+
+def cmd_server(args) -> int:
+    from ydb_tpu.server import serve
+    eng = _embedded_engine(args)
+    server, port = serve(eng, port=args.port)
+    print(f"ydb_tpu server listening on 127.0.0.1:{port} "
+          f"(data_dir={args.data_dir})", flush=True)
+    try:
+        server.wait_for_termination()
+    except KeyboardInterrupt:
+        server.stop(grace=1)
+    return 0
+
+
+def cmd_sql(args) -> int:
+    if args.endpoint:
+        from ydb_tpu.server import Client
+        client = Client(args.endpoint)
+        df = client.query(args.query)
+    else:
+        df = _embedded_engine(args).query(args.query)
+    print(df.to_string(index=False))
+    return 0
+
+
+def _tpch_queries(names):
+    sys.path.insert(0, ".")
+    try:
+        from tests.tpch_util import QUERIES
+    except ImportError:
+        import os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from tests.tpch_util import QUERIES
+    if names:
+        return {n: QUERIES[n] for n in names}
+    return dict(QUERIES)
+
+
+def cmd_workload_tpch_init(args) -> int:
+    from ydb_tpu.bench.tpch_gen import load_tpch
+    eng = _embedded_engine(args)
+    t0 = time.perf_counter()
+    load_tpch(eng.catalog, sf=args.sf)
+    rows = eng.catalog.table("lineitem").num_rows
+    print(f"loaded TPC-H sf={args.sf}: {rows} lineitem rows "
+          f"in {time.perf_counter() - t0:.1f}s", flush=True)
+    if args.data_dir:
+        print(f"durable at {args.data_dir}")
+    return 0
+
+
+def cmd_workload_tpch_run(args) -> int:
+    queries = _tpch_queries(args.queries.split(",") if args.queries else None)
+    if args.endpoint:
+        from ydb_tpu.server import Client
+        runner = Client(args.endpoint).query
+        eng = None
+    else:
+        from ydb_tpu.bench.tpch_gen import load_tpch
+        eng = _embedded_engine(args)
+        if not eng.catalog.has("lineitem"):
+            load_tpch(eng.catalog, sf=args.sf)
+        runner = eng.query
+
+    times = {}
+    for name, q in queries.items():
+        try:
+            runner(q)                       # warm-up (compile)
+            best = math.inf
+            for _ in range(args.repeat):
+                t0 = time.perf_counter()
+                runner(q)
+                best = min(best, time.perf_counter() - t0)
+            times[name] = best
+            print(f"{name:>5}: {best * 1000:9.1f} ms", flush=True)
+        except Exception as e:              # noqa: BLE001 — benchmark runner
+            print(f"{name:>5}: FAILED {type(e).__name__}: {e}", flush=True)
+    if times:
+        geo = math.exp(sum(math.log(t) for t in times.values())
+                       / len(times))
+        print(f"geomean over {len(times)} queries: {geo * 1000:.1f} ms")
+        print(json.dumps({"metric": "tpch_geomean_ms",
+                          "value": round(geo * 1000, 1),
+                          "queries": len(times)}))
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ydb_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("server", help="run the gRPC query service")
+    ps.add_argument("--port", type=int, default=2136)
+    ps.add_argument("--data-dir", default=None)
+    ps.set_defaults(fn=cmd_server)
+
+    pq = sub.add_parser("sql", help="run one SQL statement")
+    pq.add_argument("query")
+    pq.add_argument("--endpoint", default=None,
+                    help="host:port of a server (default: embedded engine)")
+    pq.add_argument("--data-dir", default=None)
+    pq.set_defaults(fn=cmd_sql)
+
+    pw = sub.add_parser("workload", help="benchmark workloads")
+    wsub = pw.add_subparsers(dest="workload", required=True)
+    pt = wsub.add_parser("tpch")
+    tsub = pt.add_subparsers(dest="action", required=True)
+    ti = tsub.add_parser("init")
+    ti.add_argument("--sf", type=float, default=0.1)
+    ti.add_argument("--data-dir", default=None)
+    ti.set_defaults(fn=cmd_workload_tpch_init)
+    tr = tsub.add_parser("run")
+    tr.add_argument("--queries", default=None, help="comma list, e.g. q1,q6")
+    tr.add_argument("--repeat", type=int, default=3)
+    tr.add_argument("--sf", type=float, default=0.1)
+    tr.add_argument("--endpoint", default=None)
+    tr.add_argument("--data-dir", default=None)
+    tr.set_defaults(fn=cmd_workload_tpch_run)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

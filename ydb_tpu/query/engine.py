@@ -90,40 +90,63 @@ class QueryEngine:
     # -- entry -------------------------------------------------------------
 
     def execute(self, sql: str, session=None) -> HostBlock:
+        from ydb_tpu.utils.metrics import GLOBAL, QueryStats, Timer
         session = session or self._default_session
+        t = Timer()
+        stats = QueryStats(sql=sql)
         stmt = parse(sql)
+        stats.parse_ms = t.lap()
+        stats.kind = type(stmt).__name__.lower()
+        GLOBAL.inc("engine/statements")
+        self.last_stats = stats
         tx = session.tx
         snap = tx.snapshot if tx is not None else self.snapshot()
         try:
-            if isinstance(stmt, ast.Begin):
-                session.begin()
-                return _unit_block()
-            if isinstance(stmt, ast.Commit):
-                from ydb_tpu.tx import TxAborted
+            from ydb_tpu.tx import TxAborted
+            if isinstance(stmt, (ast.Begin, ast.Commit, ast.Rollback)):
                 try:
-                    session.commit()
+                    if isinstance(stmt, ast.Begin):
+                        session.begin()
+                    elif isinstance(stmt, ast.Commit):
+                        session.commit()
+                    else:
+                        session.rollback()
                 except TxAborted as e:
                     raise QueryError(str(e)) from e
                 return _unit_block()
-            if isinstance(stmt, ast.Rollback):
-                session.rollback()
-                return _unit_block()
+            if isinstance(stmt, ast.Explain):
+                return self._explain_stmt(stmt, session)
             if isinstance(stmt, ast.Select):
+                if stmt.relation is None:
+                    block = self._select_without_from(stmt)
+                    self.executor.last_path = "literal"
+                    self._finish_stats(stats, t, block)
+                    return block
+                names = self._referenced_tables(stmt)
+                stats.tables = sorted(names)
                 if tx is not None:
-                    for name in self._referenced_tables(stmt):
+                    for name in names:
                         if self.catalog.has(name):
                             tx.lock(self.catalog.table(name))
                 if self._needs_materialize(stmt):
-                    return self._execute_materialized(stmt, snap)
+                    block = self._execute_materialized(stmt, snap)
+                    self._finish_stats(stats, t, block)
+                    return block
                 fp = self._table_fingerprint(stmt)
                 cached = self._plan_cache.get(sql)
                 if cached is not None and cached[0] == fp:
                     plan = cached[1]
                     self.plan_cache_hits += 1
+                    stats.plan_cache_hit = True
+                    GLOBAL.inc("engine/plan_cache_hits")
                 else:
                     plan = self.planner.plan_select(stmt)
                     self._plan_cache[sql] = (fp, plan)
-                return self.executor.execute(plan, snap)
+                    GLOBAL.inc("engine/plan_cache_misses")
+                stats.plan_ms = t.lap()
+                block = self.executor.execute(plan, snap)
+                self._finish_stats(stats, t, block)
+                return block
             if isinstance(stmt, ast.CreateTable):
                 if tx is not None:
                     raise QueryError("DDL inside a transaction is not "
@@ -146,6 +169,94 @@ class QueryEngine:
             raise QueryError(f"unsupported statement {type(stmt).__name__}")
         except (BindError, PlanError) as e:
             raise QueryError(str(e)) from e
+
+    def _select_without_from(self, sel: ast.Select) -> HostBlock:
+        """Constant SELECT (`select 1 + 1 as x`): fold each item host-side
+        — one row, no scan (the literal-executer analog)."""
+        from ydb_tpu.core import dtypes as dt
+        from ydb_tpu.core.dictionary import Dictionary
+        from ydb_tpu.query.binder import _try_fold
+        cols, arrays, valids, dicts = [], {}, {}, {}
+        for i, item in enumerate(sel.items):
+            folded = _try_fold(item.expr)
+            if folded is None:
+                raise QueryError(
+                    "SELECT without FROM supports constant expressions only")
+            name = item.alias or f"column{i}"
+            v = folded.value
+            if v is None:
+                cols.append(Column(name, dt.DType(dt.Kind.INT64, True)))
+                arrays[name] = np.zeros(1, np.int64)
+                valids[name] = np.zeros(1, bool)
+            elif isinstance(v, bool):
+                cols.append(Column(name, dt.DType(dt.Kind.BOOL, False)))
+                arrays[name] = np.array([v])
+            elif isinstance(v, int):
+                cols.append(Column(name, dt.DType(dt.Kind.INT64, False)))
+                arrays[name] = np.array([v], np.int64)
+            elif isinstance(v, float):
+                cols.append(Column(name, dt.DType(dt.Kind.FLOAT64, False)))
+                arrays[name] = np.array([v], np.float64)
+            else:
+                d = Dictionary()
+                cols.append(Column(name, dt.DType(dt.Kind.STRING, False)))
+                arrays[name] = d.encode([str(v)])
+                dicts[name] = d
+        return HostBlock.from_arrays(Schema(cols), arrays, valids, dicts)
+
+    def _finish_stats(self, stats, t, block) -> None:
+        from ydb_tpu.utils.metrics import GLOBAL
+        stats.execute_ms = t.lap()
+        stats.total_ms = stats.parse_ms + stats.plan_ms + stats.execute_ms
+        stats.rows_out = block.length
+        stats.fused = self.executor.last_path == "fused"
+        stats.distributed = self.executor.last_path == "distributed"
+        GLOBAL.inc("engine/rows_out", block.length)
+        GLOBAL.inc("engine/queries")
+
+    def counters(self) -> dict:
+        """Live counter snapshot (the /counters endpoint payload)."""
+        from ydb_tpu.ops.xla_exec import _GLOBAL_CACHE
+        from ydb_tpu.utils.metrics import GLOBAL
+        c = GLOBAL.snapshot()
+        c.update({
+            "engine/plan_cache_size": len(self._plan_cache),
+            "executor/fused_plans": len(self.executor._fused_cache),
+            "device_cache/hits": self.executor.device_cache.hits,
+            "device_cache/misses": self.executor.device_cache.misses,
+            "device_cache/bytes": self.executor.device_cache.bytes,
+            "program_cache/hits": _GLOBAL_CACHE.hits,
+            "program_cache/misses": _GLOBAL_CACHE.misses,
+            "coordinator/plan_step": self.coordinator.last_plan_step,
+        })
+        return c
+
+    def _explain_stmt(self, stmt: ast.Explain, session) -> HostBlock:
+        """EXPLAIN [ANALYZE] — plan text (+ live execution stats), the
+        `kqp_query_plan.cpp` plan-with-stats analog."""
+        from ydb_tpu.core.dictionary import Dictionary
+        from ydb_tpu.core import dtypes as dt
+        if self._needs_materialize(stmt.query):
+            # CTE/derived-table stages materialize at run time; their
+            # sub-plans depend on intermediate results
+            lines = ["(materialized CTE/derived-table stages; run EXPLAIN "
+                     "ANALYZE for live stats)"]
+        elif stmt.query.relation is None:
+            lines = ["(constant SELECT — literal executer, no scan)"]
+        else:
+            try:
+                lines = explain(
+                    self.planner.plan_select(stmt.query)).split("\n")
+            except (BindError, PlanError, KeyError) as e:
+                raise QueryError(str(e)) from e
+        if stmt.analyze:
+            block = self.execute(stmt.sql, session=session)
+            lines += self.last_stats.render().split("\n")
+        d = Dictionary()
+        codes = d.encode(lines)
+        schema = Schema([Column("plan", dt.DType(dt.Kind.STRING, False))])
+        return HostBlock.from_arrays(schema, {"plan": codes},
+                                     dictionaries={"plan": d})
 
     def _run_select(self, sel: ast.Select,
                     snap: Optional[Snapshot] = None) -> HostBlock:
@@ -396,11 +507,9 @@ class QueryEngine:
                 data[n].append(folded.value)
 
         if getattr(table, "store_kind", "column") == "row":
-            kind = {"insert": "insert", "upsert": "upsert",
-                    "replace": "replace"}[stmt.mode]
             ops = []
             for i in range(len(stmt.rows)):
-                ops.append((kind, {n: data[n][i] for n in names}))
+                ops.append((stmt.mode, {n: data[n][i] for n in names}))
             try:
                 self._apply_row_ops(table, ops, tx)
             except ValueError as e:
@@ -438,7 +547,7 @@ class QueryEngine:
             return _unit_block()
         writes = table.write(block)
         table.commit(writes, self._next_version())
-        table.indexate()
+        table.indexate(self.coordinator.safe_watermark())
         return _unit_block()
 
     def _apply_row_ops(self, table, ops, tx) -> None:
@@ -563,7 +672,8 @@ class QueryEngine:
         if pks.empty:
             return 0
         from ydb_tpu.storage.portion import Portion
-        table.indexate()          # inserts → portions first: the WAL must
+        # inserts → portions first: the WAL must
+        table.indexate(self.coordinator.safe_watermark())
         #                           never resurrect rewritten rows
         removed = 0
         for shard in table.shards:
@@ -602,9 +712,7 @@ class QueryEngine:
             # ops carry only the named columns — "upsert" must keep the
             # unmentioned ones, so no null-filling here (apply() enforces
             # NOT NULL for genuinely absent values)
-            kind = {"insert": "insert", "upsert": "upsert",
-                    "replace": "replace"}[stmt.mode]
-            ops = [(kind, {c: _native(v) for c, v in row.items()})
+            ops = [(stmt.mode, {c: _native(v) for c, v in row.items()})
                    for row in df.to_dict("records")]
             try:
                 self._apply_row_ops(table, ops, tx)

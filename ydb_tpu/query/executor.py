@@ -51,6 +51,8 @@ class Executor:
         # all_to_all hash shuffle.
         self.mesh = mesh
         self._dist_aggs: dict = {}
+        # which path the last execute() took: fused | portioned | distributed
+        self.last_path = ""
 
     # -- entry -------------------------------------------------------------
 
@@ -71,14 +73,17 @@ class Executor:
 
         if self.mesh is not None and self.mesh.devices.size > 1 \
                 and self._can_distribute(plan):
+            self.last_path = "distributed"
             merged = self._execute_distributed(plan, params, snapshot)
             return self._project_output(merged, plan.output)
 
         fused = self._try_execute_fused(plan, params, snapshot)
         if isinstance(fused, HostBlock):
+            self.last_path = "fused"
             return self._project_output(fused, plan.output)
 
         # fused path declined: it may have prepared the join builds already
+        self.last_path = "portioned"
         partials = self._run_pipeline(plan.pipeline, params, snapshot,
                                       builds=fused)
         merged = self._finalize(plan, partials, params)

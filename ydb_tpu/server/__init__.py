@@ -1,0 +1,1 @@
+from ydb_tpu.server.service import Client, serve  # noqa: F401

@@ -1,0 +1,196 @@
+"""gRPC query service — the public API surface.
+
+The reference exposes Ydb.* gRPC services (`ydb/public/api/grpc/
+ydb_query_v1.proto` QueryService.ExecuteQuery, routed by
+`grpc_services/grpc_request_proxy.cpp` into KQP). This server keeps the
+same shape — a network QueryService speaking gRPC — with JSON message
+bodies via custom (de)serializers instead of generated protobuf stubs
+(grpc-python supports arbitrary serializers; the wire protocol is still
+HTTP/2 gRPC framing).
+
+Methods (service `ydb_tpu.QueryService`):
+  ExecuteQuery  {sql, session_id?} → {columns, rows, stats} | {error}
+                session_id scopes interactive transactions (BEGIN/COMMIT
+                land on that session's state, the session-actor model)
+  Counters      {} → {counters}
+  Ping          {} → {ok: true}
+
+Statement execution is serialized under one lock: the engine's caches and
+the single TPU dispatch stream are not thread-safe, and the reference
+likewise runs a session's statements sequentially.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from concurrent import futures
+
+
+def _ser(obj) -> bytes:
+    return json.dumps(obj).encode()
+
+
+def _deser(data: bytes):
+    return json.loads(data.decode()) if data else {}
+
+
+SERVICE = "ydb_tpu.QueryService"
+
+
+def _result_payload(block, stats) -> dict:
+    df = block.to_pandas()
+    rows = []
+    for row in df.itertuples(index=False):
+        out = []
+        for v in row:
+            if v is None or (isinstance(v, float) and v != v):
+                out.append(None)
+            elif hasattr(v, "item"):
+                out.append(v.item())
+            else:
+                out.append(v)
+        rows.append(out)
+    return {
+        "columns": list(df.columns),
+        "rows": rows,
+        "stats": {
+            "total_ms": stats.total_ms,
+            "rows_out": stats.rows_out,
+            "plan_cache_hit": stats.plan_cache_hit,
+            "path": ("distributed" if stats.distributed
+                     else "fused" if stats.fused else "portioned"),
+        } if stats is not None else {},
+    }
+
+
+MAX_SESSIONS = 256
+
+
+class QueryServicer:
+    def __init__(self, engine, max_sessions: int = MAX_SESSIONS):
+        from collections import OrderedDict
+        self.engine = engine
+        self._lock = threading.Lock()
+        self._sessions: "OrderedDict" = OrderedDict()
+        self._max_sessions = max_sessions
+
+    def _session(self, session_id):
+        if not session_id:
+            return None                      # default (autocommit) session
+        s = self._sessions.get(session_id)
+        if s is None:
+            s = self.engine.session()
+            self._sessions[session_id] = s
+            # bounded session table: evict the least-recently-used idle
+            # session (rolling back any open tx) — abandoned clients must
+            # not pin staged writes forever
+            while len(self._sessions) > self._max_sessions:
+                _sid, old = self._sessions.popitem(last=False)
+                if old.tx is not None:
+                    old.rollback()
+        else:
+            self._sessions.move_to_end(session_id)
+        return s
+
+    def close_session(self, request, context):
+        sid = request.get("session_id")
+        with self._lock:
+            s = self._sessions.pop(sid, None)
+            if s is not None and s.tx is not None:
+                s.rollback()
+        return {"ok": True}
+
+    def execute_query(self, request, context):
+        sql = request.get("sql", "")
+        with self._lock:
+            try:
+                session = self._session(request.get("session_id"))
+                block = self.engine.execute(sql, session=session)
+                stats = getattr(self.engine, "last_stats", None)
+                return _result_payload(block, stats)
+            except Exception as e:               # noqa: BLE001 — wire boundary
+                return {"error": f"{type(e).__name__}: {e}"}
+
+    def counters(self, request, context):
+        with self._lock:
+            return {"counters": self.engine.counters()}
+
+    def ping(self, request, context):
+        return {"ok": True}
+
+
+def serve(engine, port: int = 2136, max_workers: int = 8):
+    """Start the gRPC server; returns (server, bound_port)."""
+    import grpc
+
+    servicer = QueryServicer(engine)
+    handlers = {
+        "ExecuteQuery": grpc.unary_unary_rpc_method_handler(
+            servicer.execute_query, request_deserializer=_deser,
+            response_serializer=_ser),
+        "Counters": grpc.unary_unary_rpc_method_handler(
+            servicer.counters, request_deserializer=_deser,
+            response_serializer=_ser),
+        "Ping": grpc.unary_unary_rpc_method_handler(
+            servicer.ping, request_deserializer=_deser,
+            response_serializer=_ser),
+        "CloseSession": grpc.unary_unary_rpc_method_handler(
+            servicer.close_session, request_deserializer=_deser,
+            response_serializer=_ser),
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+class Client:
+    """Minimal SDK client (the ydb-sdk QueryClient analog)."""
+
+    def __init__(self, endpoint: str, session_id: str = ""):
+        import grpc
+
+        self._channel = grpc.insecure_channel(endpoint)
+        self._exec = self._channel.unary_unary(
+            f"/{SERVICE}/ExecuteQuery", request_serializer=_ser,
+            response_deserializer=_deser)
+        self._counters = self._channel.unary_unary(
+            f"/{SERVICE}/Counters", request_serializer=_ser,
+            response_deserializer=_deser)
+        self._ping = self._channel.unary_unary(
+            f"/{SERVICE}/Ping", request_serializer=_ser,
+            response_deserializer=_deser)
+        self.session_id = session_id
+
+    def execute(self, sql: str) -> dict:
+        resp = self._exec({"sql": sql, "session_id": self.session_id})
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def query(self, sql: str):
+        """Execute and return a pandas DataFrame."""
+        import pandas as pd
+
+        resp = self.execute(sql)
+        return pd.DataFrame(resp["rows"], columns=resp["columns"])
+
+    def counters(self) -> dict:
+        return self._counters({})["counters"]
+
+    def ping(self) -> bool:
+        return bool(self._ping({}).get("ok"))
+
+    def close(self) -> None:
+        if self.session_id:
+            try:
+                self._channel.unary_unary(
+                    f"/{SERVICE}/CloseSession", request_serializer=_ser,
+                    response_deserializer=_deser)(
+                        {"session_id": self.session_id})
+            except Exception:                # noqa: BLE001 — best effort
+                pass
+        self._channel.close()

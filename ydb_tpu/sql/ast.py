@@ -221,6 +221,13 @@ class Update:
 
 
 @dataclass(frozen=True)
+class Explain:
+    query: "Select"
+    analyze: bool = False
+    sql: str = ""                  # inner statement text (re-run by ANALYZE)
+
+
+@dataclass(frozen=True)
 class Begin:
     pass
 
@@ -236,4 +243,4 @@ class Rollback:
 
 
 Statement = Union[Select, CreateTable, DropTable, Insert, Delete, Update,
-                  Begin, Commit, Rollback]
+                  Explain, Begin, Commit, Rollback]

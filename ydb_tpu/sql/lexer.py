@@ -26,7 +26,8 @@ KEYWORDS = {
     "create", "table", "primary", "key", "drop", "insert", "upsert",
     "replace", "into", "values", "delete", "update", "set", "if", "with",
     "union", "all", "escape", "substring", "for", "partition", "store",
-    "extract", "begin", "commit", "rollback", "transaction",
+    "extract", "begin", "commit", "rollback", "transaction", "explain",
+    "analyze",
 }
 
 _OPS = ["<>", "!=", ">=", "<=", "||", "(", ")", ",", "+", "-", "*", "/", "%",

@@ -15,6 +15,7 @@ from ydb_tpu.sql.lexer import SqlError, Token, tokenize
 
 class Parser:
     def __init__(self, text: str):
+        self.text = text
         self.toks = tokenize(text)
         self.i = 0
 
@@ -81,6 +82,10 @@ class Parser:
             stmt = self.parse_delete()
         elif self.at_kw("update"):
             stmt = self.parse_update()
+        elif self.accept_kw("explain"):
+            analyze = bool(self.accept_kw("analyze"))
+            inner_sql = self.text[self.peek().pos:]
+            stmt = ast.Explain(self.parse_select(), analyze, inner_sql)
         elif self.accept_kw("begin"):
             self.accept_kw("transaction")
             stmt = ast.Begin()

@@ -89,26 +89,33 @@ class ColumnShard:
                 made += 1
         return made
 
-    def compact(self) -> int:
-        """Merge adjacent small portions of the same version into full ones."""
-        small = [p for p in self.portions if p.num_rows < self.portion_rows // 2]
+    def compact(self, watermark: Optional[int] = None) -> int:
+        """Merge small portions into full ones (`general_compaction.cpp`).
+
+        The merged portion is stamped with the NEWEST version among its
+        inputs, so only portions at or below `watermark` (the highest plan
+        step no pinned snapshot is behind, `Coordinator.safe_watermark`)
+        are eligible — every pinned reader stays at or past the merged
+        version and sees identical data. Ad-hoc snapshots never registered
+        with the coordinator keep only per-portion granularity (the
+        reference tracks per-row versions inside portions — a later
+        refinement here)."""
+        small = [p for p in self.portions
+                 if p.num_rows < self.portion_rows // 2
+                 and (watermark is None
+                      or p.version.plan_step <= watermark)]
         if len(small) < COMPACT_MIN_PORTIONS:
             return 0
-        by_ver: dict[WriteVersion, list[Portion]] = {}
-        for p in small:
-            by_ver.setdefault(p.version, []).append(p)
-        merged_count = 0
-        for ver, ps in by_ver.items():
-            if len(ps) < 2:
-                continue
-            ids = {p.id for p in ps}
-            self.portions = [p for p in self.portions if p.id not in ids]
-            merged = HostBlock.concat([p.block for p in ps])
-            for start in range(0, merged.length, self.portion_rows):
-                chunk = merged.slice(start, min(start + self.portion_rows, merged.length))
-                self.portions.append(Portion.from_block(chunk, ver))
-                merged_count += len(ps)
-        return merged_count
+        ids = {p.id for p in small}
+        self.portions = [p for p in self.portions if p.id not in ids]
+        merged = HostBlock.concat([p.block for p in small])
+        ver = max(p.version for p in small)
+        for start in range(0, merged.length, self.portion_rows):
+            chunk = merged.slice(start,
+                                 min(start + self.portion_rows,
+                                     merged.length))
+            self.portions.append(Portion.from_block(chunk, ver))
+        return len(small)
 
     # -- read path --------------------------------------------------------
 

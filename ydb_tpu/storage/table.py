@@ -114,21 +114,26 @@ class ColumnTable:
             self.store.save_dictionaries(self)
             self.store.save_state(version.plan_step)
 
-    def indexate(self) -> int:
-        """Background indexation across shards (persists portion sets)."""
+    def indexate(self, watermark: Optional[int] = None) -> int:
+        """Background indexation across shards (persists portion sets),
+        followed by the compaction policy check — the background-controller
+        analog (`columnshard_impl.h` background changes): steady small
+        inserts must not accumulate unbounded small portions. `watermark`:
+        see `ColumnShard.compact` (snapshot safety)."""
         made = 0
         for s in self.shards:
             n = s.indexate()
+            merged = s.compact(watermark)
             made += n
-            if self.store is not None and n:
+            if self.store is not None and (n or merged):
                 self.store.save_indexation(self, s)
         return made
 
-    def compact(self) -> int:
+    def compact(self, watermark: Optional[int] = None) -> int:
         """Compaction across shards (persists the rewritten portion sets)."""
         merged = 0
         for s in self.shards:
-            n = s.compact()
+            n = s.compact(watermark)
             merged += n
             if self.store is not None and n:
                 self.store.save_indexation(self, s)

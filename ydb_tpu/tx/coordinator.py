@@ -23,6 +23,7 @@ class Coordinator:
     def __init__(self, start_step: int = 1):
         self._plan_step = max(1, start_step)
         self._next_tx = 1
+        self._pinned: dict[int, int] = {}   # open tx id -> snapshot step
 
     def begin_tx(self) -> int:
         """Allocate a transaction id (the TxProxy tx-allocator analog)."""
@@ -39,6 +40,22 @@ class Coordinator:
         """Safe MVCC read watermark (the TimeCast analog): everything
         planned so far is visible, nothing in flight is."""
         return Snapshot(self._plan_step, 2 ** 62)
+
+    # -- pinned snapshots (open interactive txs) --------------------------
+
+    def pin_snapshot(self, tx_id: int, plan_step: int) -> None:
+        self._pinned[tx_id] = plan_step
+
+    def unpin_snapshot(self, tx_id: int) -> None:
+        self._pinned.pop(tx_id, None)
+
+    def safe_watermark(self) -> int:
+        """Highest plan step no pinned snapshot is behind — background
+        maintenance (compaction re-stamps merged portions) must not touch
+        versions newer than this, or pinned readers lose rows."""
+        if self._pinned:
+            return min(self._pinned.values())
+        return self._plan_step
 
     @property
     def last_plan_step(self) -> int:

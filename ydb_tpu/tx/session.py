@@ -97,6 +97,7 @@ class Session:
                           for t in self.engine.catalog.tables.values()}
         self.tx = Transaction(coord.begin_tx(), coord.read_snapshot(),
                               begin_versions)
+        coord.pin_snapshot(self.tx.tx_id, self.tx.snapshot.plan_step)
 
     def commit(self) -> None:
         tx = self._require_tx()
@@ -113,6 +114,7 @@ class Session:
             table.indexate()
         if self.engine.catalog.store is not None:
             self.engine.catalog.store.save_state(version.plan_step)
+        self.engine.coordinator.unpin_snapshot(tx.tx_id)
         self.tx = None
 
     def rollback(self) -> None:
@@ -124,6 +126,7 @@ class Session:
             table.rollback_tx(tx.tx_id)
         for table, writes in tx.col_writes:
             table.rollback(writes)
+        self.engine.coordinator.unpin_snapshot(tx.tx_id)
         self.tx = None
 
     def _require_tx(self) -> Transaction:

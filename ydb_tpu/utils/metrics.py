@@ -1,0 +1,82 @@
+"""Counters and per-query statistics — the observability floor.
+
+The reference hangs monlib dynamic counter trees off every component
+(`library/cpp/monlib`, aggregated per tablet type by
+`tablet_counters_aggregator.cpp`, served at `/counters`) and fills
+per-task/per-channel stats protos that roll up into the query plan
+(`dq_tasks_runner.h:73` TDqTaskRunnerStatsView, `kqp_executer_stats.cpp`,
+`kqp_query_plan.cpp` — surfaced as EXPLAIN ANALYZE and `.sys` views).
+
+Here: a process-wide hierarchical counter registry (plain dict, sampled on
+read) and a QueryStats record the engine fills per statement — the inputs
+to `EXPLAIN ANALYZE`, `engine.counters()`, and the server's /counters
+endpoint.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class Counters:
+    """Hierarchical monotonic counters: `inc("engine/queries")`."""
+
+    def __init__(self):
+        self._c: dict[str, float] = {}
+
+    def inc(self, name: str, by: float = 1) -> None:
+        self._c[name] = self._c.get(name, 0) + by
+
+    def set(self, name: str, value: float) -> None:
+        self._c[name] = value
+
+    def get(self, name: str) -> float:
+        return self._c.get(name, 0)
+
+    def snapshot(self) -> dict:
+        return dict(sorted(self._c.items()))
+
+
+GLOBAL = Counters()
+
+
+@dataclass
+class QueryStats:
+    """Per-statement execution breakdown (TDqTaskRunnerStatsView analog)."""
+    sql: str = ""
+    kind: str = ""                 # select | insert | update | ddl | ...
+    parse_ms: float = 0.0
+    plan_ms: float = 0.0
+    execute_ms: float = 0.0
+    total_ms: float = 0.0
+    rows_out: int = 0
+    plan_cache_hit: bool = False
+    fused: bool = False            # whole-query single-dispatch path
+    distributed: bool = False      # mesh hash-shuffle path
+    tables: list = field(default_factory=list)
+
+    def render(self) -> str:
+        path = ("mesh-distributed" if self.distributed
+                else "fused single-dispatch" if self.fused
+                else "portioned")
+        return (f"-- stats: total {self.total_ms:.1f}ms "
+                f"(parse {self.parse_ms:.1f}, plan {self.plan_ms:.1f}"
+                f"{' [cache hit]' if self.plan_cache_hit else ''}, "
+                f"execute {self.execute_ms:.1f}) | "
+                f"rows out {self.rows_out} | path {path}")
+
+
+class Timer:
+    def __init__(self):
+        self.t0 = time.perf_counter()
+
+    def ms(self) -> float:
+        return (time.perf_counter() - self.t0) * 1000.0
+
+    def lap(self) -> float:
+        now = time.perf_counter()
+        out = (now - self.t0) * 1000.0
+        self.t0 = now
+        return out

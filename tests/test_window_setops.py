@@ -1,0 +1,168 @@
+"""Window functions and UNION / UNION ALL vs pandas oracles.
+
+The TPC-DS blockers from SURVEY §7 step 5: rank/row_number over
+partitions, running aggregates, and set operations — inner queries run on
+the device, the window/set pass host-side (`ydb_tpu/query/window.py`).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ydb_tpu.query import QueryEngine, QueryError
+
+
+@pytest.fixture
+def eng():
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("""create table s (id Int64 not null, g Utf8 not null,
+                 v Double not null, primary key (id))""")
+    rng = np.random.default_rng(3)
+    rows = ", ".join(
+        f"({i}, '{'abc'[int(rng.integers(3))]}', {float(rng.integers(1, 9))})"
+        for i in range(40))
+    e.execute(f"insert into s (id, g, v) values {rows}")
+    e.df = e.query("select id, g, v from s order by id")
+    return e
+
+
+def test_row_number_partition(eng):
+    got = eng.query("select id, row_number() over (partition by g "
+                    "order by v desc, id) rn from s order by id")
+    df = eng.df.sort_values(["g", "v", "id"], ascending=[True, False, True])
+    df["rn"] = df.groupby("g").cumcount() + 1
+    want = df.sort_values("id")
+    np.testing.assert_array_equal(got.rn, want.rn)
+
+
+def test_rank_dense_rank(eng):
+    got = eng.query("select id, rank() over (partition by g order by v) rk, "
+                    "dense_rank() over (partition by g order by v) dr "
+                    "from s order by id")
+    df = eng.df.copy()
+    df["rk"] = df.groupby("g").v.rank(method="min").astype(np.int64)
+    df["dr"] = df.groupby("g").v.rank(method="dense").astype(np.int64)
+    want = df.sort_values("id")
+    np.testing.assert_array_equal(got.rk, want.rk)
+    np.testing.assert_array_equal(got.dr, want.dr)
+
+
+def test_partition_aggregates(eng):
+    got = eng.query("select id, sum(v) over (partition by g) t, "
+                    "avg(v) over (partition by g) a, "
+                    "count(*) over (partition by g) n from s order by id")
+    df = eng.df.copy()
+    df["t"] = df.groupby("g").v.transform("sum")
+    df["a"] = df.groupby("g").v.transform("mean")
+    df["n"] = df.groupby("g").v.transform("size")
+    want = df.sort_values("id")
+    np.testing.assert_allclose(got.t, want.t, rtol=1e-12)
+    np.testing.assert_allclose(got.a, want.a, rtol=1e-12)
+    np.testing.assert_array_equal(got.n, want.n)
+
+
+def test_running_sum(eng):
+    got = eng.query("select id, sum(v) over (partition by g order by id) r "
+                    "from s order by id")
+    df = eng.df.sort_values(["g", "id"])
+    df["r"] = df.groupby("g").v.cumsum()
+    want = df.sort_values("id")
+    np.testing.assert_allclose(got.r, want.r, rtol=1e-12)
+
+
+def test_window_over_aggregate_result(eng):
+    # window over a grouped result — the common TPC-DS shape
+    got = eng.query(
+        "select g, sum(v) as tv, rank() over (order by sum(v) desc) rk "
+        "from s group by g order by rk, g")
+    df = eng.df.groupby("g", as_index=False).v.sum().rename(
+        columns={"v": "tv"})
+    df["rk"] = df.tv.rank(method="min", ascending=False).astype(np.int64)
+    want = df.sort_values(["rk", "g"])
+    assert list(got.g) == list(want.g)
+    np.testing.assert_allclose(got.tv, want.tv, rtol=1e-12)
+    np.testing.assert_array_equal(got.rk, want.rk)
+
+
+def test_union_all_and_union(eng):
+    got = eng.query("select g from s where v >= 5 union all "
+                    "select g from s where v < 5 order by g")
+    assert len(got) == 40
+    got = eng.query("select g from s union select g from s order by g")
+    assert list(got.g) == sorted(eng.df.g.unique())
+
+
+def test_union_with_limit(eng):
+    got = eng.query("select id from s where id < 3 union all "
+                    "select id from s where id >= 38 order by id desc limit 3")
+    assert list(got.id) == [39, 38, 2]
+
+
+def test_union_arity_mismatch(eng):
+    with pytest.raises(QueryError, match="arity"):
+        eng.query("select id, g from s union all select id from s")
+
+
+def test_union_in_cte(eng):
+    got = eng.query("""with u as (select id from s where id < 2 union all
+                                  select id from s where id >= 38)
+                       select count(*) as n from u""")
+    assert got.n[0] == 4
+
+
+def test_union_in_from_subquery(eng):
+    """Regression (r3 review): SetOp in derived-table position."""
+    got = eng.query("select count(*) as n from "
+                    "(select id from s where id < 3 union all "
+                    "select id from s where id >= 38) q")
+    assert got.n[0] == 5
+
+
+def test_union_in_in_subquery(eng):
+    """Regression (r3 review): SetOp inside IN (...)."""
+    got = eng.query("select count(*) as n from s where id in "
+                    "(select id from s where id < 2 union "
+                    "select id from s where id >= 39)")
+    assert got.n[0] == 3
+
+
+def test_cte_visible_to_all_union_arms(eng):
+    """Regression (r3 review): WITH binds to every arm of a union."""
+    got = eng.query("with c as (select id from s where id < 4) "
+                    "select id from c where id < 2 union all "
+                    "select id from c where id >= 2 order by id")
+    assert list(got.id) == [0, 1, 2, 3]
+
+
+def test_cte_chain_with_setop_body(eng):
+    """Regression (r3 review): a SetOp CTE body referencing an earlier
+    CTE."""
+    got = eng.query(
+        "with a as (select id from s where id < 2), "
+        "b as (select id from a union all select id from s where id = 10) "
+        "select count(*) as n from b")
+    assert got.n[0] == 3
+
+
+def test_windowed_cte_body(eng):
+    """TPC-DS shape: rank() inside a CTE, filtered outside."""
+    got = eng.query(
+        "with r as (select g, v, rank() over (partition by g order by v desc) rk "
+        "from s) select g, v from r where rk = 1 order by g")
+    want = eng.df.loc[eng.df.groupby("g").v.idxmax() if False else
+                      eng.df.sort_values("v").groupby("g").v.idxmax()]
+    top = eng.df.groupby("g").v.max()
+    assert dict(zip(got.g, got.v)) == top.to_dict()
+
+
+def test_tx_locks_cover_union_and_window(eng):
+    """Regression (r3 review): set-op / windowed selects inside a tx must
+    register read locks."""
+    from ydb_tpu.query import QueryError
+    s1 = eng.session()
+    s1.execute("begin")
+    s1.query("select g from s where id < 2 union all "
+             "select g from s where id > 38")
+    eng.execute("delete from s where id = 0")     # conflicting commit
+    with pytest.raises(QueryError, match="optimistic lock"):
+        s1.execute("commit")

@@ -133,6 +133,41 @@ def place(table: BuildTable, device) -> BuildTable:
         None if table.lut is None else put(table.lut), table.lut_base)
 
 
+@dataclass
+class PartitionedBuild:
+    """GraceJoin-style hash-partitioned build side (`mkql_grace_join.cpp`):
+    the build rows are split host-side by key hash into partitions small
+    enough for the device budget; the probe side routes each row to its
+    key's partition, so every partition joins independently. Partitions
+    stay in host DRAM until probed — the HBM→host spill discipline of
+    SURVEY §5.7 (the reference spills buckets to disk)."""
+    tables: list                   # [BuildTable] per partition
+    n_partitions: int
+    key: str
+
+
+def build_partitioned(block: HostBlock, key: str, payload_names: list[str],
+                      budget_bytes: int) -> PartitionedBuild:
+    """Partition a too-big build side by key hash (splitmix64, matching
+    the device-side routing in the probe)."""
+    from ydb_tpu.utils.hashing import splitmix64
+
+    row_bytes = max(1, sum(block.columns[n].data.itemsize
+                           for n in payload_names) + 8)
+    total = row_bytes * max(block.length, 1)
+    nparts = 1
+    while total / nparts > budget_bytes:
+        nparts *= 2
+    enc, _valid = _host_key(block, key)
+    h = splitmix64(np, enc.astype(np.int64))
+    part = (h % np.uint64(nparts)).astype(np.int64)
+    tables = []
+    for p in range(nparts):
+        idx = np.nonzero(part == p)[0]
+        tables.append(build(block.take(idx), key, payload_names))
+    return PartitionedBuild(tables, nparts, key)
+
+
 def probe_lut_traced(env: dict, sel, bt_arrays: dict, meta: dict):
     """LUT probe, callable inside a fused query trace (`ops/fused.py`).
 

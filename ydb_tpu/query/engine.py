@@ -116,18 +116,31 @@ class QueryEngine:
                 return _unit_block()
             if isinstance(stmt, ast.Explain):
                 return self._explain_stmt(stmt, session)
-            if isinstance(stmt, ast.Select):
-                if stmt.relation is None:
-                    block = self._select_without_from(stmt)
-                    self.executor.last_path = "literal"
-                    self._finish_stats(stats, t, block)
-                    return block
+            if isinstance(stmt, (ast.SetOp, ast.Select)):
+                # read locks FIRST — every select path (fused, windowed,
+                # set-op, materialized) must register conflicts
                 names = self._referenced_tables(stmt)
                 stats.tables = sorted(names)
                 if tx is not None:
                     for name in names:
                         if self.catalog.has(name):
                             tx.lock(self.catalog.table(name))
+            if isinstance(stmt, ast.SetOp):
+                block = self._execute_set_op(stmt, snap)
+                self.executor.last_path = "set-op"
+                self._finish_stats(stats, t, block)
+                return block
+            if isinstance(stmt, ast.Select):
+                from ydb_tpu.query import window as W
+                if W.has_window(stmt):
+                    block = self._execute_windowed(stmt, snap)
+                    self._finish_stats(stats, t, block)
+                    return block
+                if stmt.relation is None:
+                    block = self._select_without_from(stmt)
+                    self.executor.last_path = "literal"
+                    self._finish_stats(stats, t, block)
+                    return block
                 if self._needs_materialize(stmt):
                     block = self._execute_materialized(stmt, snap)
                     self._finish_stats(stats, t, block)
@@ -258,15 +271,79 @@ class QueryEngine:
         return HostBlock.from_arrays(schema, {"plan": codes},
                                      dictionaries={"plan": d})
 
-    def _run_select(self, sel: ast.Select,
+    def _run_select(self, sel,
                     snap: Optional[Snapshot] = None) -> HostBlock:
-        """Execute an in-memory Select AST (DML subflows: INSERT…SELECT,
-        UPDATE/DELETE row evaluation) — no text-keyed plan cache."""
+        """Execute an in-memory Select/SetOp AST (DML subflows, CTE
+        bodies, window inner queries) — no text-keyed plan cache."""
+        from ydb_tpu.query import window as W
         snap = snap or self.snapshot()
+        if isinstance(sel, ast.SetOp):
+            return self._execute_set_op(sel, snap)
+        if W.has_window(sel):
+            return self._execute_windowed(sel, snap)
         if self._needs_materialize(sel):
             return self._execute_materialized(sel, snap)
         plan = self.planner.plan_select(sel)
         return self.executor.execute(plan, snap)
+
+    def _execute_set_op(self, stmt: ast.SetOp,
+                        snap: Optional[Snapshot] = None) -> HostBlock:
+        """UNION / UNION ALL: CTEs materialize once (visible to every
+        arm), arms run through the normal device path, the combine (and
+        dedup for UNION) runs host-side."""
+        from ydb_tpu.query import window as W
+        snap = snap or self.snapshot()
+        temps: list = []
+        try:
+            rewritten = self._rewrite_sel(stmt, {}, temps, snap)
+            df = self._eval_setop_df(rewritten, snap)
+            try:
+                df = W.apply_order_limit(df, stmt.order_by, stmt.limit,
+                                         stmt.offset)
+            except ValueError as e:
+                raise QueryError(str(e)) from e
+            return HostBlock.from_pandas(df)
+        finally:
+            for tn in temps:
+                if self.catalog.has(tn):
+                    self.catalog.drop_table(tn)
+
+    def _eval_setop_df(self, node, snap):
+        """Evaluate an already-rewritten SetOp tree to a pandas frame."""
+        import pandas as pd
+        if isinstance(node, ast.SetOp):
+            left = self._eval_setop_df(node.left, snap)
+            right = self._eval_setop_df(node.right, snap)
+            if len(left.columns) != len(right.columns):
+                raise QueryError("UNION arms have different arity")
+            right.columns = left.columns
+            out = pd.concat([left, right], ignore_index=True)
+            if node.op == "union":
+                out = out.drop_duplicates(ignore_index=True)
+            return out
+        return self._run_select(node, snap).to_pandas()
+
+    def _execute_windowed(self, sel: ast.Select,
+                          snap: Optional[Snapshot] = None) -> HostBlock:
+        """Window functions: the inner query (scan/filter/join/agg) runs
+        on the device; the window pass runs host-side over its (usually
+        post-aggregation) result — see `ydb_tpu/query/window.py`."""
+        from ydb_tpu.query import window as W
+        snap = snap or self.snapshot()
+        try:
+            inner, outer = W.split_windowed(sel)
+        except ValueError as e:
+            raise QueryError(str(e)) from e
+        inner_block = self._run_select(inner, snap)
+        df = W.compute_windows(inner_block.to_pandas(), outer)
+        if sel.distinct:
+            df = df.drop_duplicates(ignore_index=True)
+        try:
+            df = W.apply_order_limit(df, sel.order_by, sel.limit,
+                                     sel.offset)
+        except ValueError as e:
+            raise QueryError(str(e)) from e
+        return HostBlock.from_pandas(df)
 
     def explain(self, sql: str) -> str:
         stmt = parse(sql)
@@ -294,7 +371,11 @@ class QueryEngine:
         transaction read-lock acquisition)."""
         names: set = set()
 
-        def walk_sel(s: ast.Select):
+        def walk_sel(s):
+            if isinstance(s, ast.SetOp):
+                walk_sel(s.left)
+                walk_sel(s.right)
+                return
             for (_n, body) in s.ctes:
                 walk_sel(body)
             if s.relation is not None:
@@ -341,7 +422,9 @@ class QueryEngine:
     # strategy of DQ precompute stages (`dq_opt_phy_finalizing.cpp`
     # DqBuildStages: a stage result becomes the next stage's source).
 
-    def _needs_materialize(self, sel: ast.Select) -> bool:
+    def _needs_materialize(self, sel) -> bool:
+        if isinstance(sel, ast.SetOp):
+            return True
         if sel.ctes:
             return True
 
@@ -390,13 +473,25 @@ class QueryEngine:
                 if self.catalog.has(t):
                     self.catalog.drop_table(t)
 
-    def _rewrite_sel(self, sel: ast.Select, cte_map: dict,
-                     temps: list, snap: Optional[Snapshot] = None
-                     ) -> ast.Select:
+    def _rewrite_sel(self, sel, cte_map: dict,
+                     temps: list, snap: Optional[Snapshot] = None):
+        if isinstance(sel, ast.SetOp):
+            cte_map = dict(cte_map)
+            for (name, body) in sel.ctes:
+                cte_map[name] = self._materialize(
+                    self._rewrite_sel(body, cte_map, temps, snap), temps,
+                    snap)
+            out = ast.SetOp(
+                sel.op,
+                self._rewrite_sel(sel.left, cte_map, temps, snap),
+                self._rewrite_sel(sel.right, cte_map, temps, snap),
+                sel.order_by, sel.limit, sel.offset)
+            return out
         cte_map = dict(cte_map)
         for (name, body) in sel.ctes:
             cte_map[name] = self._materialize(
-                self._rewrite_sel(body, cte_map, temps, snap), temps, snap)
+                self._rewrite_sel(body, cte_map, temps, snap), temps,
+                snap)
 
         def rewrite_rel(r):
             if isinstance(r, ast.TableRef):
@@ -412,7 +507,7 @@ class QueryEngine:
                 t = self._materialize(
                     self._rewrite_sel(r.query, cte_map, temps, snap), temps,
                     snap)
-                return ast.TableRef(t, r.alias)
+                return ast.TableRef(t, r.alias)   # Select OR SetOp body
             return r
 
         def rewrite_expr(e):
@@ -421,8 +516,18 @@ class QueryEngine:
                 return e
             if isinstance(e, (ast.Exists, ast.InSubquery,
                               ast.ScalarSubquery)):
-                kw = {"query": self._rewrite_sel(e.query, cte_map, temps,
-                                                 snap)}
+                q = self._rewrite_sel(e.query, cte_map, temps, snap)
+                if isinstance(q, ast.SetOp):
+                    # plan over a materialized temp: the planner only
+                    # decorrelates plain selects (explicit column items —
+                    # Star would lose the planner's naming contract)
+                    tname = self._materialize(q, temps, snap)
+                    cols = self.catalog.table(tname).schema.names
+                    q = ast.Select(
+                        items=[ast.SelectItem(ast.Name((c,)), c)
+                               for c in cols],
+                        relation=ast.TableRef(tname))
+                kw = {"query": q}
                 if isinstance(e, ast.InSubquery):
                     kw["arg"] = rewrite_expr(e.arg)
                 return dataclasses.replace(e, **kw)
@@ -448,10 +553,30 @@ class QueryEngine:
                                       o.nulls_first) for o in out.order_by]
         return out
 
-    def _materialize(self, sel: ast.Select, temps: list,
+    def _materialize(self, sel, temps: list,
                      snap: Optional[Snapshot] = None) -> str:
+        """Materialize an already-rewritten Select or SetOp into a
+        transient table; returns its name."""
+        from ydb_tpu.query import window as W
         snap = snap or self.snapshot()
-        block = self.executor.execute(self.planner.plan_select(sel), snap)
+        if isinstance(sel, ast.SetOp):
+            df = self._eval_setop_df(sel, snap)
+            try:
+                df = W.apply_order_limit(df, sel.order_by, sel.limit,
+                                         sel.offset)
+            except ValueError as e:
+                raise QueryError(str(e)) from e
+            block = HostBlock.from_pandas(df)
+        elif W.has_window(sel):
+            block = self._execute_windowed(sel, snap)
+        else:
+            block = self.executor.execute(self.planner.plan_select(sel),
+                                          snap)
+        return self._register_temp(block, temps, snap)
+
+    def _register_temp(self, block: HostBlock, temps: list,
+                       snap: Optional[Snapshot] = None) -> str:
+        snap = snap or self.snapshot()
         tname = f"__tmp{self._tmp_n}"
         self._tmp_n += 1
         t = self.catalog.create_table(tname, block.schema,

@@ -53,6 +53,11 @@ class Executor:
         self._dist_aggs: dict = {}
         # which path the last execute() took: fused | portioned | distributed
         self.last_path = ""
+        # build sides above this estimate hash-partition into a GraceJoin
+        # (host-DRAM partitions probed one at a time — the spill budget)
+        import os as _os
+        self.grace_budget_bytes = int(
+            _os.environ.get("YDB_TPU_GRACE_BUDGET", 1 << 29))
 
     # -- entry -------------------------------------------------------------
 
@@ -115,9 +120,9 @@ class Executor:
         builds = [self._prepare_join(step, params, snapshot)
                   for step in join_steps]
         for step, bt in zip(join_steps, builds):
-            if bt.lut is None or (
+            if isinstance(bt, J.PartitionedBuild) or bt.lut is None or (
                     not bt.unique and step.kind in ("inner", "left", "mark")):
-                return builds              # un-LUT-able / expanding join
+                return builds   # partitioned / un-LUT-able / expanding
 
         scan_cols = [Column(i, table.schema.dtype(s))
                      for (s, i) in pipe.scan.columns]
@@ -316,37 +321,80 @@ class Executor:
         if builds is None:
             builds = [self._prepare_join(step, params, snapshot)
                       for kind, step in pipe.steps if kind == "join"]
-        out = [self._run_block(pipe, d, builds, params)
-               for d in self._scan_device_blocks(pipe, snapshot)]
+        out = []
+        for d in self._scan_device_blocks(pipe, snapshot):
+            out.extend(self._run_block_multi(pipe, d, builds, params))
         if not out:
-            out = [self._run_block(pipe, to_device(self._empty_scan_block(pipe)),
-                                   builds, params)]
+            out = self._run_block_multi(
+                pipe, to_device(self._empty_scan_block(pipe)), builds,
+                params)
         return out
 
     def _run_block(self, pipe: Pipeline, d: DeviceBlock, builds: list,
                    params: dict) -> DeviceBlock:
+        """Single-stream block runner (mesh path — partitioned builds are
+        not routed here)."""
+        out = self._run_block_multi(pipe, d, builds, params)
+        assert len(out) == 1, "partitioned join on the mesh path"
+        return out[0]
+
+    def _run_block_multi(self, pipe: Pipeline, d: DeviceBlock, builds: list,
+                         params: dict) -> list:
+        """Run one scan block through the pipeline. A GraceJoin-partitioned
+        build forks the stream: probe rows route to their key's partition
+        (device-side splitmix64 matches the host partitioner) and each
+        partition continues through the remaining steps independently —
+        their partials merge like any other blocks."""
         if pipe.pre_program is not None:
             d = run_on_device(pipe.pre_program, d, params)
-        bi = 0
-        for kind, step in pipe.steps:
-            if kind == "join":
-                table = builds[bi]
-                bi += 1
-                if not table.unique and step.kind in ("inner", "left"):
-                    # duplicate build keys → expanding probe (GraceJoin
-                    # analog); output is already compact
-                    d = J.probe_expand(d, table, step.probe_key, step.kind)
+
+        def run_steps(d: DeviceBlock, si: int, bi: int) -> list:
+            while si < len(pipe.steps):
+                kind, step = pipe.steps[si]
+                if kind != "join":
+                    d = run_on_device(step, d, params)
+                    si += 1
                     continue
-                d, sel = J.probe(d, table, step.probe_key, step.kind,
-                                 sel=None, mark_col=step.mark_col or None,
-                                 not_in=step.not_in)
-                if step.kind != "mark":
-                    d = compress_block(d, sel)
-            else:
-                d = run_on_device(step, d, params)
-        if pipe.partial is not None:
-            d = run_on_device(pipe.partial, d, params)
-        return d
+                table = builds[bi]
+                if isinstance(table, J.PartitionedBuild):
+                    out = []
+                    for p, bt in enumerate(table.tables):
+                        dp = self._partition_block(d, step.probe_key, p,
+                                                   table.n_partitions)
+                        out.extend(self._probe_one(dp, bt, step, pipe,
+                                                   run_steps, si, bi))
+                    return out
+                return self._probe_one(d, table, step, pipe, run_steps,
+                                       si, bi)
+            if pipe.partial is not None:
+                d = run_on_device(pipe.partial, d, params)
+            return [d]
+
+        return run_steps(d, 0, 0)
+
+    def _probe_one(self, d: DeviceBlock, table, step, pipe, run_steps,
+                   si: int, bi: int) -> list:
+        if not table.unique and step.kind in ("inner", "left"):
+            # duplicate build keys → expanding probe; output compact
+            d = J.probe_expand(d, table, step.probe_key, step.kind)
+            return run_steps(d, si + 1, bi + 1)
+        d, sel = J.probe(d, table, step.probe_key, step.kind,
+                         sel=None, mark_col=step.mark_col or None,
+                         not_in=step.not_in)
+        if step.kind != "mark":
+            d = compress_block(d, sel)
+        return run_steps(d, si + 1, bi + 1)
+
+    @staticmethod
+    def _partition_block(d: DeviceBlock, key: str, p: int,
+                         nparts: int) -> DeviceBlock:
+        """Rows whose key hashes to partition p, compacted."""
+        import jax.numpy as jnp
+
+        from ydb_tpu.utils.hashing import splitmix64
+        enc = d.arrays[key].astype(jnp.int64)
+        part = splitmix64(jnp, enc) % jnp.uint64(nparts)
+        return compress_block(d, part == jnp.uint64(p))
 
     def _prepare_join(self, step: JoinStep, params: dict,
                       snapshot: Snapshot) -> J.BuildTable:
@@ -365,6 +413,18 @@ class Executor:
                 raise NotImplementedError(
                     "NOT IN over a subquery producing NULLs (SQL: always "
                     "empty) is not supported yet")
+        # GraceJoin spill: a build side above the device budget hash-
+        # partitions into host DRAM (single-device path only — the mesh
+        # path replicates builds per device and would need partition
+        # placement instead)
+        single_dev = self.mesh is None or self.mesh.devices.size <= 1
+        if single_dev and not step.not_in and built.length:
+            cols = list(dict.fromkeys([step.build_key] + list(step.payload)))
+            row_bytes = sum(built.columns[n].data.itemsize for n in cols)
+            if built.length * row_bytes > self.grace_budget_bytes:
+                return J.build_partitioned(built, step.build_key,
+                                           list(step.payload),
+                                           self.grace_budget_bytes)
         return J.build(built, step.build_key, list(step.payload))
 
     def _scan_device_blocks(self, pipe: Pipeline, snapshot: Snapshot,

@@ -1,0 +1,190 @@
+"""Window functions and set operations — engine-level evaluation.
+
+The reference expands window functions in the logical optimizer
+(`yql/core/common_opt/` window expansion) into partition-sorted traversals,
+and UNION ALL into `Extend` callables. Here both evaluate over the result
+of the core columnar engine: the inner query (scan/filter/join/aggregate)
+runs on the device through the normal fused path; the window pass and the
+set combine run host-side over the (usually post-aggregation, small)
+result — the "host fallback lane" of SURVEY §7. Device-native segmented
+window kernels can replace the host pass without changing the SQL surface.
+
+Supported: ROW_NUMBER / RANK / DENSE_RANK (PARTITION BY + ORDER BY),
+SUM/MIN/MAX/COUNT/AVG OVER (PARTITION BY [ORDER BY → running aggregates,
+ROWS semantics]). Frames (ROWS BETWEEN ...) are not parsed yet.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from ydb_tpu.sql import ast
+
+WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "min", "max",
+                "count", "avg"}
+
+
+def has_window(sel: ast.Select) -> bool:
+    return any(isinstance(i.expr, ast.WindowFunc) for i in sel.items)
+
+
+def split_windowed(sel: ast.Select):
+    """Split a windowed select into (inner select, outer plan).
+
+    inner: every non-window item plus synthesized aliases for each window
+    function's args / partition keys / order keys.
+    outer: ordered [(kind, payload)] describing how to assemble the final
+    frame — ("col", alias) or ("win", spec dict).
+    """
+    inner_items: list = []
+    outer: list = []
+    for idx, item in enumerate(sel.items):
+        e = item.expr
+        if isinstance(e, ast.WindowFunc):
+            if e.func not in WINDOW_FUNCS:
+                raise ValueError(f"unsupported window function {e.func}")
+            if e.distinct:
+                raise ValueError(
+                    "DISTINCT inside a window function is not supported")
+            spec = {"func": e.func, "args": [], "part": [], "order": [],
+                    "asc": [],
+                    "alias": item.alias or f"column{idx}"}
+            for j, a in enumerate(e.args):
+                al = f"__w{idx}a{j}"
+                inner_items.append(ast.SelectItem(a, al))
+                spec["args"].append(al)
+            for j, p in enumerate(e.partition_by):
+                al = f"__w{idx}p{j}"
+                inner_items.append(ast.SelectItem(p, al))
+                spec["part"].append(al)
+            for j, o in enumerate(e.order_by):
+                al = f"__w{idx}o{j}"
+                inner_items.append(ast.SelectItem(o.expr, al))
+                spec["order"].append(al)
+                spec["asc"].append(o.ascending)
+            outer.append(("win", spec))
+        else:
+            alias = item.alias
+            if alias is None and isinstance(e, ast.Name):
+                alias = e.parts[-1]
+            alias = alias or f"column{idx}"
+            inner_items.append(ast.SelectItem(e, alias))
+            outer.append(("col", alias))
+    # SQL applies DISTINCT to the FINAL output, after window evaluation —
+    # the engine dedups the computed frame, never the inner query
+    inner = ast.Select(items=inner_items, relation=sel.relation,
+                       where=sel.where, group_by=list(sel.group_by),
+                       having=sel.having, distinct=False)
+    inner.ctes = list(sel.ctes)
+    return inner, outer
+
+
+def compute_windows(df: pd.DataFrame, outer: list) -> pd.DataFrame:
+    """Evaluate the window specs over the inner result, returning the
+    final frame with columns in the original item order."""
+    work = df.copy()
+    work["__row"] = np.arange(len(work))
+    cols = []
+    for kind, payload in outer:
+        if kind == "col":
+            cols.append(payload)
+            continue
+        spec = payload
+        out_name = spec["alias"]
+        cols.append(out_name)
+        part = spec["part"] or ["__const"]
+        if "__const" in part and "__const" not in work.columns:
+            work["__const"] = 0
+        by = part + spec["order"]
+        asc = [True] * len(part) + list(spec["asc"])
+        s = work.sort_values(by, ascending=asc, kind="stable")
+        grp = s.groupby(part, sort=False, dropna=False)
+        fn = spec["func"]
+        if fn == "row_number":
+            vals = grp.cumcount() + 1
+        elif fn in ("rank", "dense_rank"):
+            rn = grp.cumcount() + 1
+            if spec["order"]:
+                okeys = s[spec["order"]]
+                newkey = okeys.ne(okeys.shift()).any(axis=1)
+            else:
+                newkey = pd.Series(False, index=s.index)
+            first_of_part = rn == 1
+            newkey = newkey | first_of_part
+            if fn == "rank":
+                vals = rn.where(newkey).groupby(
+                    [s[c] for c in part], sort=False, dropna=False).ffill()
+            else:
+                vals = newkey.astype(np.int64).groupby(
+                    [s[c] for c in part], sort=False, dropna=False).cumsum()
+            vals = vals.astype(np.int64)
+        else:
+            arg = spec["args"][0] if spec["args"] else None
+            running = bool(spec["order"])
+            if fn == "count" and arg is None:
+                vals = (grp.cumcount() + 1 if running
+                        else grp["__row"].transform("size"))
+            else:
+                col = s[arg]
+                keys = [s[c] for c in part]
+                g = col.groupby(keys, sort=False, dropna=False)
+                if running:       # SQL default frame with ORDER BY
+                    # NULL rows don't contribute, but the running value
+                    # at a NULL row still reflects the frame so far
+                    nn = col.notna().groupby(keys, sort=False,
+                                             dropna=False).cumsum()
+                    filled = col.fillna(0).groupby(
+                        keys, sort=False, dropna=False)
+                    if fn == "sum":
+                        vals = filled.cumsum().where(nn > 0)
+                    elif fn == "count":
+                        vals = nn
+                    elif fn == "avg":
+                        vals = (filled.cumsum() / nn).where(nn > 0)
+                    else:          # min / max: patch NULL-row gaps
+                        cm = g.cummin() if fn == "min" else g.cummax()
+                        vals = cm.groupby(keys, sort=False,
+                                          dropna=False).ffill().where(
+                                              nn > 0)
+                else:
+                    vals = g.transform({"sum": "sum", "min": "min",
+                                        "max": "max", "count": "count",
+                                        "avg": "mean"}[fn])
+        work.loc[s.index, out_name] = vals
+        if spec["func"] in ("row_number", "rank", "dense_rank") or (
+                spec["func"] == "count"):
+            work[out_name] = work[out_name].astype(np.int64)
+    out = work.sort_values("__row", kind="stable")
+    return out[cols].reset_index(drop=True)
+
+
+def apply_order_limit(df: pd.DataFrame, order_by, limit, offset):
+    """Trailing ORDER BY/LIMIT over a host frame (set ops, window tails).
+    Order expressions must reference output columns by name. NULL
+    placement honors each key's nulls_first (default = YQL's
+    NULL-is-smallest: first when ascending)."""
+    if order_by:
+        keys = []
+        for o in order_by:
+            if not isinstance(o.expr, ast.Name):
+                raise ValueError(
+                    "ORDER BY over a set/window result must reference "
+                    "output columns by name")
+            name = o.expr.parts[-1]
+            if name not in df.columns:
+                raise ValueError(f"unknown ORDER BY column {name!r}")
+            nf = o.nulls_first
+            if nf is None:
+                nf = o.ascending
+            keys.append((name, o.ascending, nf))
+        # per-key NULL placement: stable sorts applied minor-key-first
+        for name, asc, nf in reversed(keys):
+            df = df.sort_values(name, ascending=asc, kind="stable",
+                                na_position="first" if nf else "last")
+    lo = offset or 0
+    if limit is not None:
+        df = df.iloc[lo:lo + limit]
+    elif lo:
+        df = df.iloc[lo:]
+    return df.reset_index(drop=True)

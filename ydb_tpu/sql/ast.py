@@ -115,6 +115,19 @@ class Star:
 
 
 @dataclass(frozen=True)
+class WindowFunc:
+    """fn(args) OVER (PARTITION BY ... ORDER BY ...) — no frame clauses
+    yet (the reference's window support lives in
+    `yql/core/common_opt/yql_window.cpp`)."""
+    func: str                      # row_number | rank | dense_rank |
+    #                                sum | min | max | count | avg
+    args: tuple                    # tuple[Expr, ...] (empty for row_number)
+    partition_by: tuple = ()       # tuple[Expr, ...]
+    order_by: tuple = ()           # tuple[OrderItem, ...]
+    distinct: bool = False         # parsed but rejected (explicit error)
+
+
+@dataclass(frozen=True)
 class BoundParam:
     """Planner-synthesized runtime parameter (uncorrelated scalar subquery
     result). Never produced by the parser."""
@@ -180,6 +193,19 @@ class Select:
     offset: Optional[int] = None
     distinct: bool = False
     ctes: list = field(default_factory=list)           # list[(name, Select)]
+
+
+@dataclass
+class SetOp:
+    """UNION / UNION ALL chain; trailing ORDER BY/LIMIT bind to the whole
+    set result (the `yql_expr` Extend/UnionAll callables)."""
+    op: str                        # union | union_all
+    left: object                   # Select | SetOp
+    right: object                  # Select
+    order_by: list = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: Optional[int] = None
+    ctes: list = field(default_factory=list)   # visible to every arm
 
 
 @dataclass

@@ -101,7 +101,10 @@ class Parser:
             raise SqlError(f"trailing input at {self.peek().pos}")
         return stmt
 
-    def parse_select(self) -> ast.Select:
+    def parse_select(self):
+        """[WITH ...] select possibly chained with UNION [ALL]; the CTEs
+        are visible to every arm and the trailing ORDER BY/LIMIT of a
+        chain bind to the whole set result."""
         ctes = []
         if self.accept_kw("with"):
             while True:
@@ -112,9 +115,26 @@ class Parser:
                 self.expect_op(")")
                 if not self.accept_op(","):
                     break
+        node = self.parse_select_core()
+        while self.at_kw("union"):
+            self.next()
+            op = "union_all" if self.accept_kw("all") else "union"
+            right = self.parse_select_core()
+            node = ast.SetOp(op, node, right)
+        if isinstance(node, ast.SetOp):
+            # the last arm grabbed the chain's trailing ORDER BY/LIMIT
+            last = node.right
+            node.order_by, node.limit, node.offset = \
+                last.order_by, last.limit, last.offset
+            last.order_by, last.limit, last.offset = [], None, None
+            node.ctes = ctes
+        else:
+            node.ctes = ctes
+        return node
+
+    def parse_select_core(self) -> ast.Select:
         self.expect_kw("select")
         sel = ast.Select()
-        sel.ctes = ctes
         if self.accept_kw("distinct"):
             sel.distinct = True
         sel.items = [self.select_item()]
@@ -455,16 +475,39 @@ class Parser:
         self.expect_op("(")
         if self.accept_op("*"):
             self.expect_op(")")
-            return ast.FuncCall(name, (), star=True)
+            return self._maybe_over(ast.FuncCall(name, (), star=True))
         distinct = bool(self.accept_kw("distinct"))
         if self.at_op(")"):
             self.next()
-            return ast.FuncCall(name, ())
+            return self._maybe_over(ast.FuncCall(name, ()))
         args = [self.expr()]
         while self.accept_op(","):
             args.append(self.expr())
         self.expect_op(")")
-        return ast.FuncCall(name, tuple(args), distinct=distinct)
+        call = ast.FuncCall(name, tuple(args), distinct=distinct)
+        return self._maybe_over(call)
+
+    def _maybe_over(self, call: ast.FuncCall) -> ast.Expr:
+        """`fn(...) OVER (PARTITION BY ... ORDER BY ...)`."""
+        if not self.at_kw("over"):
+            return call
+        self.next()
+        self.expect_op("(")
+        partition: list = []
+        order: list = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.accept_op(","):
+                partition.append(self.expr())
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            order.append(self.order_item())
+            while self.accept_op(","):
+                order.append(self.order_item())
+        self.expect_op(")")
+        return ast.WindowFunc(call.name, call.args, tuple(partition),
+                              tuple(order), call.distinct)
 
     def case_expr(self) -> ast.Expr:
         self.expect_kw("case")

@@ -1,0 +1,147 @@
+"""ClickBench query subset + pandas oracles.
+
+The standard public ClickBench queries (the reference carries all 43 in
+`ydb/public/lib/ydb_cli/commands/click_bench_queries.sql`), adapted only
+in table/column casing. This subset covers the suite's shapes that the
+engine supports today: plain counts, high-cardinality distincts, skewed
+group-bys, string equality/LIKE through dictionary LUTs, top-k with
+LIMIT, and multi-key aggregation. (Regex/substring-heavy queries arrive
+with the UDF lane.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+QUERIES = {
+    # Q0
+    "c0": "select count(*) as c from hits",
+    # Q1
+    "c1": "select count(*) as c from hits where AdvEngineID <> 0",
+    # Q2
+    "c2": ("select sum(AdvEngineID) as s, count(*) as c, "
+           "avg(ResolutionWidth) as a from hits"),
+    # Q3
+    "c3": "select avg(UserID) as a from hits",
+    # Q4
+    "c4": "select count(distinct UserID) as u from hits",
+    # Q5
+    "c5": "select count(distinct SearchPhrase) as p from hits",
+    # Q6
+    "c6": "select min(EventDate) as mn, max(EventDate) as mx from hits",
+    # Q7
+    "c7": ("select AdvEngineID, count(*) as c from hits "
+           "where AdvEngineID <> 0 group by AdvEngineID "
+           "order by c desc, AdvEngineID"),
+    # Q8
+    "c8": ("select RegionID, count(distinct UserID) as u from hits "
+           "group by RegionID order by u desc, RegionID limit 10"),
+    # Q9
+    "c9": ("select RegionID, sum(AdvEngineID) as s, count(*) as c, "
+           "avg(ResolutionWidth) as a, count(distinct UserID) as u "
+           "from hits group by RegionID order by c desc, RegionID limit 10"),
+    # Q10
+    "c10": ("select MobilePhoneModel, count(distinct UserID) as u from hits "
+            "where MobilePhoneModel <> '' group by MobilePhoneModel "
+            "order by u desc, MobilePhoneModel limit 10"),
+    # Q12
+    "c12": ("select SearchPhrase, count(*) as c from hits "
+            "where SearchPhrase <> '' group by SearchPhrase "
+            "order by c desc, SearchPhrase limit 10"),
+    # Q13
+    "c13": ("select SearchPhrase, count(distinct UserID) as u from hits "
+            "where SearchPhrase <> '' group by SearchPhrase "
+            "order by u desc, SearchPhrase limit 10"),
+    # Q15
+    "c15": ("select UserID, count(*) as c from hits group by UserID "
+            "order by c desc, UserID limit 10"),
+    # Q16 (multi-key)
+    "c16": ("select UserID, SearchPhrase, count(*) as c from hits "
+            "group by UserID, SearchPhrase "
+            "order by c desc, UserID, SearchPhrase limit 10"),
+    # Q21 (LIKE through the dictionary lane)
+    "c21": ("select SearchPhrase, min(URL) as mu, count(*) as c from hits "
+            "where URL like '%google%' and SearchPhrase <> '' "
+            "group by SearchPhrase order by c desc, SearchPhrase limit 10"),
+    # Q23-ish: top by a filtered count
+    "c23": ("select count(*) as c from hits "
+            "where Title like '%Google%' and URL not like '%music%'"),
+    # Q38-ish shape
+    "c38": ("select ResolutionWidth, count(*) as c from hits "
+            "group by ResolutionWidth order by ResolutionWidth"),
+}
+
+
+def oracle(name: str, raw: dict) -> pd.DataFrame:
+    df = pd.DataFrame(raw)
+    if name == "c0":
+        return pd.DataFrame({"c": [len(df)]})
+    if name == "c1":
+        return pd.DataFrame({"c": [int((df.AdvEngineID != 0).sum())]})
+    if name == "c2":
+        return pd.DataFrame({"s": [df.AdvEngineID.sum()], "c": [len(df)],
+                             "a": [df.ResolutionWidth.mean()]})
+    if name == "c3":
+        return pd.DataFrame({"a": [df.UserID.mean()]})
+    if name == "c4":
+        return pd.DataFrame({"u": [df.UserID.nunique()]})
+    if name == "c5":
+        return pd.DataFrame({"p": [df.SearchPhrase.nunique()]})
+    if name == "c6":
+        return pd.DataFrame({"mn": [df.EventDate.min()],
+                             "mx": [df.EventDate.max()]})
+    if name == "c7":
+        g = df[df.AdvEngineID != 0].groupby("AdvEngineID").size() \
+            .reset_index(name="c")
+        return g.sort_values(["c", "AdvEngineID"], ascending=[False, True])
+    if name == "c8":
+        g = df.groupby("RegionID").UserID.nunique().reset_index(name="u")
+        return g.sort_values(["u", "RegionID"],
+                             ascending=[False, True]).head(10)
+    if name == "c9":
+        g = df.groupby("RegionID").agg(
+            s=("AdvEngineID", "sum"), c=("AdvEngineID", "size"),
+            a=("ResolutionWidth", "mean"),
+            u=("UserID", "nunique")).reset_index()
+        return g.sort_values(["c", "RegionID"],
+                             ascending=[False, True]).head(10)
+    if name == "c10":
+        d = df[df.MobilePhoneModel != ""]
+        g = d.groupby("MobilePhoneModel").UserID.nunique() \
+            .reset_index(name="u")
+        return g.sort_values(["u", "MobilePhoneModel"],
+                             ascending=[False, True]).head(10)
+    if name == "c12":
+        d = df[df.SearchPhrase != ""]
+        g = d.groupby("SearchPhrase").size().reset_index(name="c")
+        return g.sort_values(["c", "SearchPhrase"],
+                             ascending=[False, True]).head(10)
+    if name == "c13":
+        d = df[df.SearchPhrase != ""]
+        g = d.groupby("SearchPhrase").UserID.nunique().reset_index(name="u")
+        return g.sort_values(["u", "SearchPhrase"],
+                             ascending=[False, True]).head(10)
+    if name == "c15":
+        g = df.groupby("UserID").size().reset_index(name="c")
+        return g.sort_values(["c", "UserID"],
+                             ascending=[False, True]).head(10)
+    if name == "c16":
+        g = df.groupby(["UserID", "SearchPhrase"]).size() \
+            .reset_index(name="c")
+        return g.sort_values(["c", "UserID", "SearchPhrase"],
+                             ascending=[False, True, True]).head(10)
+    if name == "c21":
+        d = df[df.URL.str.contains("google") & (df.SearchPhrase != "")]
+        g = d.groupby("SearchPhrase").agg(
+            mu=("URL", "min"), c=("URL", "size")).reset_index()
+        return g.sort_values(["c", "SearchPhrase"],
+                             ascending=[False, True]).head(10)
+    if name == "c23":
+        d = df[df.Title.str.contains("Google")
+               & ~df.URL.str.contains("music")]
+        return pd.DataFrame({"c": [len(d)]})
+    if name == "c38":
+        g = df.groupby("ResolutionWidth").size().reset_index(name="c")
+        return g.sort_values("ResolutionWidth")
+    raise KeyError(name)

@@ -1,0 +1,32 @@
+"""ClickBench subset end-to-end vs pandas oracle (BASELINE config #5).
+
+The analog of `ydb/core/kqp/ut/olap/clickbench_ut.cpp` +
+`tests/functional/clickbench`: the standard queries over a generated
+hits table, results pinned against an independent oracle.
+"""
+
+import pytest
+
+from ydb_tpu.bench.clickbench_gen import load_hits
+from ydb_tpu.query import QueryEngine
+
+from tests.clickbench_util import QUERIES, oracle
+from tests.tpch_util import assert_frames_match
+
+ROWS = 20_000
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = QueryEngine(block_rows=1 << 13)
+    e.hits_raw = load_hits(e.catalog, n_rows=ROWS, shards=2,
+                           portion_rows=1 << 12)
+    return e
+
+
+@pytest.mark.parametrize("name", list(QUERIES))
+def test_clickbench_query(eng, name):
+    got = eng.query(QUERIES[name])
+    want = oracle(name, eng.hits_raw)
+    want.columns = list(got.columns)
+    assert_frames_match(got, want, ordered=True, rtol=1e-9)

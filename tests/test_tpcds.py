@@ -1,0 +1,25 @@
+"""TPC-DS query subset end-to-end vs pandas oracle (BASELINE config #4)."""
+
+import pytest
+
+from ydb_tpu.bench.tpcds_gen import load_tpcds
+from ydb_tpu.query import QueryEngine
+
+from tests.tpcds_util import QUERIES, oracle
+from tests.tpch_util import assert_frames_match
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = QueryEngine(block_rows=1 << 13)
+    e.raw = load_tpcds(e.catalog, sf=0.01, shards=2,
+                       portion_rows=1 << 12)
+    return e
+
+
+@pytest.mark.parametrize("name", list(QUERIES))
+def test_tpcds_query(eng, name):
+    got = eng.query(QUERIES[name])
+    want = oracle(name, eng.raw)
+    want.columns = list(got.columns)
+    assert_frames_match(got, want, ordered=True, rtol=1e-9)

@@ -1,0 +1,123 @@
+"""TPC-DS query subset + pandas oracles.
+
+Standard TPC-DS query shapes (the reference templates live in
+`ydb/library/benchmarks/queries/tpcds/`): star joins over store_sales
+with date/item/store dimensions, grouped reports with LIMIT, and the
+rank-over-partition window pattern of the q67 family.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+QUERIES = {
+    # q3: brand report for one manufacturer in December
+    "ds3": """
+select d.d_year, i.i_brand_id, i.i_brand, sum(ss.ss_ext_sales_price) as sum_agg
+from store_sales ss
+join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+join item i on i.i_item_sk = ss.ss_item_sk
+where i.i_manufact_id = 28 and d.d_moy = 12
+group by d.d_year, i.i_brand_id, i.i_brand
+order by d.d_year, sum_agg desc, i.i_brand_id
+limit 100""",
+    # q42: category report for one year/month
+    "ds42": """
+select d.d_year, i.i_category_id, i.i_category, sum(ss.ss_ext_sales_price) as s
+from store_sales ss
+join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+join item i on i.i_item_sk = ss.ss_item_sk
+where d.d_moy = 11 and d.d_year = 2000
+group by d.d_year, i.i_category_id, i.i_category
+order by s desc, d.d_year, i.i_category_id, i.i_category
+limit 100""",
+    # q52: brand report for one year/month
+    "ds52": """
+select d.d_year, i.i_brand_id, i.i_brand, sum(ss.ss_ext_sales_price) as ext_price
+from store_sales ss
+join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+join item i on i.i_item_sk = ss.ss_item_sk
+where d.d_moy = 11 and d.d_year = 2000
+group by d.d_year, i.i_brand_id, i.i_brand
+order by d.d_year, ext_price desc, i.i_brand_id
+limit 100""",
+    # q55: brand revenue for one manager-month shape
+    "ds55": """
+select i.i_brand_id, i.i_brand, sum(ss.ss_ext_sales_price) as ext_price
+from store_sales ss
+join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+join item i on i.i_item_sk = ss.ss_item_sk
+where d.d_moy = 11 and d.d_year = 1999 and i.i_manufact_id < 40
+group by i.i_brand_id, i.i_brand
+order by ext_price desc, i.i_brand_id
+limit 100""",
+    # q67 family: rank categories' sales within state via a windowed CTE
+    "ds67": """
+with sales as (
+  select s.s_state as s_state, i.i_category as i_category,
+         sum(ss.ss_net_profit) as profit
+  from store_sales ss
+  join store s on s.s_store_sk = ss.ss_store_sk
+  join item i on i.i_item_sk = ss.ss_item_sk
+  group by s.s_state, i.i_category
+)
+select s_state, i_category, profit,
+       rank() over (partition by s_state order by profit desc) as rk
+from sales
+order by s_state, rk, i_category""",
+}
+
+
+def _frames(raw):
+    return {k: pd.DataFrame(v) for k, v in raw.items()}
+
+
+def oracle(name: str, raw: dict) -> pd.DataFrame:
+    f = _frames(raw)
+    ss, d, i, s = f["store_sales"], f["date_dim"], f["item"], f["store"]
+    j = ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk") \
+          .merge(i, left_on="ss_item_sk", right_on="i_item_sk")
+    if name == "ds3":
+        x = j[(j.i_manufact_id == 28) & (j.d_moy == 12)]
+        g = x.groupby(["d_year", "i_brand_id", "i_brand"],
+                      as_index=False).ss_ext_sales_price.sum()
+        g = g.rename(columns={"ss_ext_sales_price": "sum_agg"})
+        return g.sort_values(["d_year", "sum_agg", "i_brand_id"],
+                             ascending=[True, False, True],
+                             kind="stable").head(100)
+    if name in ("ds42", "ds52", "ds55"):
+        if name == "ds55":
+            x = j[(j.d_moy == 11) & (j.d_year == 1999)
+                  & (j.i_manufact_id < 40)]
+            g = x.groupby(["i_brand_id", "i_brand"],
+                          as_index=False).ss_ext_sales_price.sum()
+            return g.sort_values(["ss_ext_sales_price", "i_brand_id"],
+                                 ascending=[False, True],
+                                 kind="stable").head(100)
+        x = j[(j.d_moy == 11) & (j.d_year == 2000)]
+        if name == "ds42":
+            g = x.groupby(["d_year", "i_category_id", "i_category"],
+                          as_index=False).ss_ext_sales_price.sum()
+            return g.sort_values(
+                ["ss_ext_sales_price", "d_year", "i_category_id",
+                 "i_category"], ascending=[False, True, True, True],
+                kind="stable").head(100)[
+                ["d_year", "i_category_id", "i_category",
+                 "ss_ext_sales_price"]]
+        g = x.groupby(["d_year", "i_brand_id", "i_brand"],
+                      as_index=False).ss_ext_sales_price.sum()
+        return g.sort_values(["d_year", "ss_ext_sales_price", "i_brand_id"],
+                             ascending=[True, False, True],
+                             kind="stable").head(100)
+    if name == "ds67":
+        js = ss.merge(s, left_on="ss_store_sk", right_on="s_store_sk") \
+               .merge(i, left_on="ss_item_sk", right_on="i_item_sk")
+        g = js.groupby(["s_state", "i_category"],
+                       as_index=False).ss_net_profit.sum() \
+              .rename(columns={"ss_net_profit": "profit"})
+        g["rk"] = g.groupby("s_state").profit.rank(
+            method="min", ascending=False).astype(np.int64)
+        return g.sort_values(["s_state", "rk", "i_category"],
+                             kind="stable")
+    raise KeyError(name)

@@ -1,0 +1,105 @@
+"""ClickBench-style `hits` table generator (BASELINE config #5).
+
+The reference ships the 43-query ClickBench suite with canonical results
+(`ydb/public/lib/ydb_cli/commands/click_bench_queries.sql`,
+`click_bench_canonical/`). The public dataset is a 100M-row web-analytics
+log; this generator produces a statistically similar table (the column
+subset the query suite touches): high-cardinality ids, skewed categorical
+ids, zipfian search phrases/URLs, timestamps over a month.
+
+Deterministic (seeded) — oracle results are reproducible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ydb_tpu.core import dtypes as dt
+from ydb_tpu.core.schema import Column, Schema
+
+HITS_SCHEMA = Schema([
+    Column("WatchID", dt.DType(dt.Kind.INT64, False)),
+    Column("JavaEnable", dt.DType(dt.Kind.INT64, False)),
+    Column("EventTime", dt.DType(dt.Kind.INT64, False)),   # unix seconds
+    Column("EventDate", dt.DType(dt.Kind.DATE32, False)),
+    Column("CounterID", dt.DType(dt.Kind.INT64, False)),
+    Column("ClientIP", dt.DType(dt.Kind.INT64, False)),
+    Column("RegionID", dt.DType(dt.Kind.INT64, False)),
+    Column("UserID", dt.DType(dt.Kind.INT64, False)),
+    Column("OS", dt.DType(dt.Kind.INT64, False)),
+    Column("AdvEngineID", dt.DType(dt.Kind.INT64, False)),
+    Column("IsRefresh", dt.DType(dt.Kind.INT64, False)),
+    Column("ResolutionWidth", dt.DType(dt.Kind.INT64, False)),
+    Column("IsLink", dt.DType(dt.Kind.INT64, False)),
+    Column("IsDownload", dt.DType(dt.Kind.INT64, False)),
+    Column("SearchEngineID", dt.DType(dt.Kind.INT64, False)),
+    Column("SearchPhrase", dt.DType(dt.Kind.STRING, False)),
+    Column("MobilePhoneModel", dt.DType(dt.Kind.STRING, False)),
+    Column("URL", dt.DType(dt.Kind.STRING, False)),
+    Column("Title", dt.DType(dt.Kind.STRING, False)),
+    Column("UserAgent", dt.DType(dt.Kind.INT64, False)),
+])
+
+_WORDS = np.array(["google", "yandex", "weather", "news", "cars", "phones",
+                   "games", "music", "maps", "cinema", "travel", "recipes",
+                   "football", "crypto", "python", "shoes", "hotels", ""])
+_MODELS = np.array(["", "", "", "iPhone", "Galaxy", "Pixel", "Nokia"])
+
+
+def gen_hits(n_rows: int, seed: int = 20260729) -> dict:
+    rng = np.random.default_rng(seed)
+    n = n_rows
+    zipf = lambda k, size: np.minimum(  # noqa: E731
+        rng.zipf(1.5, size), k) - 1
+    day0 = 19530                       # 2023-06-22
+    date = day0 + rng.integers(0, 31, n)
+    phrase_ix = zipf(len(_WORDS), n)
+    # ~60% empty search phrases, like the real data
+    phrase_ix = np.where(rng.random(n) < 0.6, len(_WORDS) - 1, phrase_ix)
+    phrases = _WORDS[phrase_ix]
+    two = _WORDS[zipf(len(_WORDS) - 1, n)]
+    phrases = np.where(
+        (phrases != "") & (rng.random(n) < 0.4),
+        np.char.add(np.char.add(phrases.astype(str), " "), two.astype(str)),
+        phrases)
+    urls = np.char.add("http://example.com/",
+                       _WORDS[zipf(len(_WORDS) - 1, n)].astype(str))
+    titles = np.char.add(np.char.capitalize(
+        _WORDS[zipf(len(_WORDS) - 1, n)].astype(str)), " page")
+    return {
+        "WatchID": rng.integers(1, 1 << 60, n),
+        "JavaEnable": rng.integers(0, 2, n),
+        "EventTime": (date.astype(np.int64) * 86400
+                      + rng.integers(0, 86400, n)),
+        "EventDate": date.astype(np.int32),
+        "CounterID": zipf(8000, n) + 1,
+        "ClientIP": rng.integers(0, 1 << 31, n),
+        "RegionID": zipf(5000, n) + 1,
+        "UserID": rng.integers(1, n // 3 + 2, n),
+        "OS": zipf(80, n),
+        "AdvEngineID": np.where(rng.random(n) < 0.95, 0, zipf(60, n) + 1),
+        "IsRefresh": (rng.random(n) < 0.13).astype(np.int64),
+        "ResolutionWidth": rng.choice(
+            [0, 1024, 1280, 1366, 1440, 1536, 1600, 1920, 2560], n),
+        "IsLink": (rng.random(n) < 0.07).astype(np.int64),
+        "IsDownload": (rng.random(n) < 0.02).astype(np.int64),
+        "SearchEngineID": np.where(phrases == "", 0, zipf(90, n) + 1),
+        "SearchPhrase": phrases.astype(object),
+        "MobilePhoneModel": _MODELS[zipf(len(_MODELS), n)].astype(object),
+        "URL": urls.astype(object),
+        "Title": titles.astype(object),
+        "UserAgent": zipf(80, n) + 1,
+    }
+
+
+def load_hits(catalog, n_rows: int = 100_000, shards: int = 1,
+              portion_rows: int = 1 << 20, seed: int = 20260729) -> dict:
+    """Create and fill the `hits` table; returns the raw numpy arrays."""
+    import pandas as pd
+
+    from ydb_tpu.storage.mvcc import WriteVersion
+    raw = gen_hits(n_rows, seed)
+    table = catalog.create_table("hits", HITS_SCHEMA, ["WatchID"],
+                                 shards=shards, portion_rows=portion_rows)
+    table.bulk_upsert(pd.DataFrame(raw), WriteVersion(1, 1))
+    return raw

@@ -1,0 +1,126 @@
+"""TPC-DS table subset generator (BASELINE config #4).
+
+The reference carries the full 99-query TPC-DS templates
+(`ydb/library/benchmarks/queries/tpcds/`). This generator produces the
+retail-star subset that the supported query shapes touch — store_sales
+(fact), date_dim, item, customer, store — with TPC-DS-like domains
+(brands/categories/manufacturers, a 5-year calendar). Deterministic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ydb_tpu.core import dtypes as dt
+from ydb_tpu.core.schema import Column, Schema
+
+
+def _i64(name):
+    return Column(name, dt.DType(dt.Kind.INT64, False))
+
+
+def _f64(name):
+    return Column(name, dt.DType(dt.Kind.FLOAT64, False))
+
+
+def _s(name):
+    return Column(name, dt.DType(dt.Kind.STRING, False))
+
+
+SCHEMAS = {
+    "date_dim": (Schema([_i64("d_date_sk"), _i64("d_year"), _i64("d_moy"),
+                         _i64("d_dom"), _s("d_day_name")]), ["d_date_sk"]),
+    "item": (Schema([_i64("i_item_sk"), _i64("i_brand_id"), _s("i_brand"),
+                     _i64("i_category_id"), _s("i_category"),
+                     _i64("i_manufact_id"), _s("i_manufact"),
+                     _f64("i_current_price")]), ["i_item_sk"]),
+    "store": (Schema([_i64("s_store_sk"), _s("s_store_name"),
+                      _s("s_state")]), ["s_store_sk"]),
+    "customer": (Schema([_i64("c_customer_sk"), _s("c_first_name"),
+                         _s("c_last_name"), _i64("c_birth_year")]),
+                 ["c_customer_sk"]),
+    "store_sales": (Schema([_i64("ss_ticket_sk"), _i64("ss_sold_date_sk"),
+                            _i64("ss_item_sk"), _i64("ss_customer_sk"),
+                            _i64("ss_store_sk"), _i64("ss_quantity"),
+                            _f64("ss_sales_price"),
+                            _f64("ss_ext_sales_price"),
+                            _f64("ss_net_profit")]), ["ss_ticket_sk"]),
+}
+
+_CATS = np.array(["Books", "Home", "Electronics", "Jewelry", "Sports",
+                  "Music", "Women", "Men", "Children", "Shoes"])
+_DAYS = np.array(["Sunday", "Monday", "Tuesday", "Wednesday", "Thursday",
+                  "Friday", "Saturday"])
+_STATES = np.array(["TN", "CA", "TX", "OH", "GA", "WA", "NY"])
+
+
+def gen_tpcds(sf: float = 0.01, seed: int = 20260730) -> dict:
+    rng = np.random.default_rng(seed)
+    tables: dict = {}
+
+    n_dates = 365 * 5
+    d_sk = np.arange(1, n_dates + 1)
+    yr = 1998 + (d_sk - 1) // 365
+    doy = (d_sk - 1) % 365
+    tables["date_dim"] = {
+        "d_date_sk": d_sk, "d_year": yr, "d_moy": doy // 31 + 1,
+        "d_dom": doy % 31 + 1,
+        "d_day_name": _DAYS[d_sk % 7].astype(object)}
+
+    n_item = max(200, int(1800 * sf * 10))
+    i_sk = np.arange(1, n_item + 1)
+    brand_id = rng.integers(1, 100, n_item) * 100 + rng.integers(1, 10,
+                                                                 n_item)
+    cat_ix = rng.integers(0, len(_CATS), n_item)
+    manu = rng.integers(1, 100, n_item)
+    tables["item"] = {
+        "i_item_sk": i_sk, "i_brand_id": brand_id,
+        "i_brand": np.array([f"brand#{b}" for b in brand_id], object),
+        "i_category_id": cat_ix + 1,
+        "i_category": _CATS[cat_ix].astype(object),
+        "i_manufact_id": manu,
+        "i_manufact": np.array([f"manu#{m}" for m in manu], object),
+        "i_current_price": (rng.random(n_item) * 100).round(2)}
+
+    n_store = 12
+    tables["store"] = {
+        "s_store_sk": np.arange(1, n_store + 1),
+        "s_store_name": np.array([f"store_{i}" for i in range(n_store)],
+                                 object),
+        "s_state": _STATES[rng.integers(0, len(_STATES), n_store)]
+        .astype(object)}
+
+    n_cust = max(500, int(100_000 * sf))
+    tables["customer"] = {
+        "c_customer_sk": np.arange(1, n_cust + 1),
+        "c_first_name": np.array([f"fn{i % 997}" for i in range(n_cust)],
+                                 object),
+        "c_last_name": np.array([f"ln{i % 499}" for i in range(n_cust)],
+                                object),
+        "c_birth_year": rng.integers(1930, 2005, n_cust)}
+
+    n_ss = max(2000, int(2_880_000 * sf))
+    tables["store_sales"] = {
+        "ss_ticket_sk": np.arange(1, n_ss + 1),
+        "ss_sold_date_sk": rng.integers(1, n_dates + 1, n_ss),
+        "ss_item_sk": rng.integers(1, n_item + 1, n_ss),
+        "ss_customer_sk": rng.integers(1, n_cust + 1, n_ss),
+        "ss_store_sk": rng.integers(1, n_store + 1, n_ss),
+        "ss_quantity": rng.integers(1, 100, n_ss),
+        "ss_sales_price": (rng.random(n_ss) * 200).round(2),
+        "ss_ext_sales_price": (rng.random(n_ss) * 2000).round(2),
+        "ss_net_profit": ((rng.random(n_ss) - 0.3) * 1000).round(2)}
+    return tables
+
+
+def load_tpcds(catalog, sf: float = 0.01, shards: int = 1,
+               portion_rows: int = 1 << 20, seed: int = 20260730) -> dict:
+    import pandas as pd
+
+    from ydb_tpu.storage.mvcc import WriteVersion
+    tables = gen_tpcds(sf, seed)
+    for name, (schema, pk) in SCHEMAS.items():
+        t = catalog.create_table(name, schema, pk, shards=shards,
+                                 portion_rows=portion_rows)
+        t.bulk_upsert(pd.DataFrame(tables[name]), WriteVersion(1, 1))
+    return tables

@@ -49,44 +49,75 @@ def cmd_sql(args) -> int:
     return 0
 
 
-def _tpch_queries(names):
-    sys.path.insert(0, ".")
-    try:
-        from tests.tpch_util import QUERIES
-    except ImportError:
-        import os
-        sys.path.insert(0, os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        from tests.tpch_util import QUERIES
-    if names:
-        return {n: QUERIES[n] for n in names}
-    return dict(QUERIES)
+def _ensure_repo_on_path() -> None:
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for p in (repo, "."):
+        if p not in sys.path:
+            sys.path.insert(0, p)
 
 
-def cmd_workload_tpch_init(args) -> int:
+def _tpch_loader(catalog, sf):
     from ydb_tpu.bench.tpch_gen import load_tpch
+    load_tpch(catalog, sf=sf)
+
+
+def _clickbench_loader(catalog, sf):
+    from ydb_tpu.bench.clickbench_gen import load_hits
+    load_hits(catalog, n_rows=max(1000, int(sf * 1e6)))
+
+
+def _tpcds_loader(catalog, sf):
+    from ydb_tpu.bench.tpcds_gen import load_tpcds
+    load_tpcds(catalog, sf=sf)
+
+
+# workload name -> (fact table, loader, queries module)
+WORKLOADS = {
+    "tpch": ("lineitem", _tpch_loader, "tests.tpch_util"),
+    "clickbench": ("hits", _clickbench_loader, "tests.clickbench_util"),
+    "tpcds": ("store_sales", _tpcds_loader, "tests.tpcds_util"),
+}
+
+
+def _workload_queries(workload: str, names):
+    import importlib
+    _ensure_repo_on_path()
+    qs = importlib.import_module(WORKLOADS[workload][2]).QUERIES
+    if names:
+        return {n: qs[n] for n in names}
+    return dict(qs)
+
+
+def _load_workload(eng, workload: str, args) -> None:
+    fact, loader, _qm = WORKLOADS[workload]
+    if not eng.catalog.has(fact):
+        loader(eng.catalog, args.sf)
+
+
+def cmd_workload_init(args) -> int:
     eng = _embedded_engine(args)
     t0 = time.perf_counter()
-    load_tpch(eng.catalog, sf=args.sf)
-    rows = eng.catalog.table("lineitem").num_rows
-    print(f"loaded TPC-H sf={args.sf}: {rows} lineitem rows "
+    _load_workload(eng, args.workload, args)
+    fact = WORKLOADS[args.workload][0]
+    rows = eng.catalog.table(fact).num_rows
+    print(f"loaded {args.workload} sf={args.sf}: {rows} {fact} rows "
           f"in {time.perf_counter() - t0:.1f}s", flush=True)
     if args.data_dir:
         print(f"durable at {args.data_dir}")
     return 0
 
 
-def cmd_workload_tpch_run(args) -> int:
-    queries = _tpch_queries(args.queries.split(",") if args.queries else None)
+def cmd_workload_run(args) -> int:
+    queries = _workload_queries(
+        args.workload, args.queries.split(",") if args.queries else None)
     if args.endpoint:
         from ydb_tpu.server import Client
         runner = Client(args.endpoint).query
         eng = None
     else:
-        from ydb_tpu.bench.tpch_gen import load_tpch
         eng = _embedded_engine(args)
-        if not eng.catalog.has("lineitem"):
-            load_tpch(eng.catalog, sf=args.sf)
+        _load_workload(eng, args.workload, args)
         runner = eng.query
 
     times = {}
@@ -106,7 +137,7 @@ def cmd_workload_tpch_run(args) -> int:
         geo = math.exp(sum(math.log(t) for t in times.values())
                        / len(times))
         print(f"geomean over {len(times)} queries: {geo * 1000:.1f} ms")
-        print(json.dumps({"metric": "tpch_geomean_ms",
+        print(json.dumps({"metric": f"{args.workload}_geomean_ms",
                           "value": round(geo * 1000, 1),
                           "queries": len(times)}))
     return 0
@@ -130,19 +161,21 @@ def main(argv=None) -> int:
 
     pw = sub.add_parser("workload", help="benchmark workloads")
     wsub = pw.add_subparsers(dest="workload", required=True)
-    pt = wsub.add_parser("tpch")
-    tsub = pt.add_subparsers(dest="action", required=True)
-    ti = tsub.add_parser("init")
-    ti.add_argument("--sf", type=float, default=0.1)
-    ti.add_argument("--data-dir", default=None)
-    ti.set_defaults(fn=cmd_workload_tpch_init)
-    tr = tsub.add_parser("run")
-    tr.add_argument("--queries", default=None, help="comma list, e.g. q1,q6")
-    tr.add_argument("--repeat", type=int, default=3)
-    tr.add_argument("--sf", type=float, default=0.1)
-    tr.add_argument("--endpoint", default=None)
-    tr.add_argument("--data-dir", default=None)
-    tr.set_defaults(fn=cmd_workload_tpch_run)
+    for wname in ("tpch", "clickbench", "tpcds"):
+        pt = wsub.add_parser(wname)
+        tsub = pt.add_subparsers(dest="action", required=True)
+        ti = tsub.add_parser("init")
+        ti.add_argument("--sf", type=float, default=0.1)
+        ti.add_argument("--data-dir", default=None)
+        ti.set_defaults(fn=cmd_workload_init)
+        tr = tsub.add_parser("run")
+        tr.add_argument("--queries", default=None,
+                        help="comma list, e.g. q1,q6")
+        tr.add_argument("--repeat", type=int, default=3)
+        tr.add_argument("--sf", type=float, default=0.1)
+        tr.add_argument("--endpoint", default=None)
+        tr.add_argument("--data-dir", default=None)
+        tr.set_defaults(fn=cmd_workload_run)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -17,6 +17,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+import numpy as np
+
 from ydb_tpu.core import dtypes as dt
 from ydb_tpu.ops import ir
 from ydb_tpu.query import binder as B
@@ -1207,6 +1209,9 @@ class Planner:
 
         sealed = [False]
 
+        string_agg_decodes: list = []
+        string_rank_luts: dict = {}   # column name -> (rank col, inv param)
+
         def register(call: ast.FuncCall) -> dict:
             nonlocal n
             if call.distinct:
@@ -1272,6 +1277,47 @@ class Planner:
                     partial_aggs.append(ir.Agg(out, "count", arg_name))
                     final_aggs.append(ir.Agg(out, "sum", out))
                     inst["col"] = out
+                elif call.name in ("min", "max") and isinstance(
+                        arg_ir, ir.Col) and self._string_dict(arg_ir.name):
+                    # lexicographic MIN/MAX over a dictionary-coded string:
+                    # aggregate the code's lexicographic RANK (plan-time
+                    # LUT — the plan cache keys on data_version, so the
+                    # dictionary snapshot stays valid), then map the
+                    # winning rank back to a code in the final stage
+                    dic = self._string_dict(arg_ir.name)
+                    i32 = dt.DType(dt.Kind.INT32, False)
+                    # the inverse LUT holds string CODES — typing it as
+                    # STRING makes the decoded column a real string
+                    # (codes + dictionary) through schema inference
+                    sstr = dt.DType(dt.Kind.STRING, False)
+                    cached = string_rank_luts.get(arg_ir.name)
+                    if cached is None:
+                        vals = dic.values_array()
+                        order = np.argsort(vals) if len(vals) else None
+                        ranks = (np.argsort(order).astype(np.int32)
+                                 if order is not None
+                                 else np.zeros(1, np.int32))
+                        inv = (order.astype(np.int32) if order is not None
+                               else np.zeros(1, np.int32))
+                        rp, ip = f"__aggrank{n}", f"__agginv{n}"
+                        plan.params[rp] = ranks
+                        plan.params[ip] = inv
+                        rank_col = f"aggarg{n}"
+                        partial.assign(rank_col, ir.call(
+                            "take_lut", arg_ir, ir.Param(rp, i32,
+                                                         is_array=True)))
+                        cached = (rank_col, ip)
+                        string_rank_luts[arg_ir.name] = cached
+                    rank_col, ip = cached
+                    out = f"agg{n}"; n += 1
+                    partial_aggs.append(ir.Agg(out, call.name, rank_col))
+                    final_aggs.append(ir.Agg(out, call.name, out))
+                    dec = f"{out}dec"
+                    string_agg_decodes.append(
+                        (dec, ir.call("take_lut", ir.Col(out),
+                                      ir.Param(ip, sstr, is_array=True))))
+                    plan.result_dicts[dec] = dic
+                    inst["col"] = dec
                 elif call.name in ("sum", "min", "max", "some"):
                     out = f"agg{n}"; n += 1
                     f = call.name
@@ -1293,6 +1339,8 @@ class Planner:
             plan.pipeline.partial = partial
             # -- final stage: merge aggs, having, outputs, sort -----------
             final = ir.Program().group_by(key_names, final_aggs, domains)
+            for (dec, expr) in string_agg_decodes:
+                final.assign(dec, expr)
         else:
             ddom = self._key_domains([dcol])
             partial.group_by(key_names + [dcol], partial_aggs,
@@ -1308,6 +1356,8 @@ class Planner:
                 key_names,
                 [ir.Agg(a.out, a.func, a.out) for a in final_aggs]
                 + final2_aggs, domains)
+            for (dec, expr) in string_agg_decodes:
+                final.assign(dec, expr)
 
         planner = self
 
@@ -1370,6 +1420,13 @@ class Planner:
         if isinstance(e, ir.Call) and e.op == "take_lut" \
                 and len(e.args) == 2 and isinstance(e.args[1], ir.Param):
             return self.pool.param_dicts.get(e.args[1].name)
+        return None
+
+    def _string_dict(self, name: str):
+        """The dictionary of a string scan column (None otherwise)."""
+        b = self.scope.by_internal(name)
+        if b is not None and b.dtype.is_string and b.dictionary is not None:
+            return b.dictionary
         return None
 
     def _key_domains(self, key_names: list) -> tuple:

@@ -59,9 +59,15 @@ def build_fused_fn(pipe, final_program: Optional[ir.Program],
     `probe_lut_traced` plus "payload_cols" ([Column] appended to the
     schema by the probe).
 
-    Returns (fn, out_schema); fn(sb, sbv, lengths, builds, params) →
-    (out_d, out_v, length)."""
+    Returns (fn, layout_box); fn(sb, sbv, lengths, builds, params) →
+    (data_stacks {dtype: (k, cap)}, valid_stack (m, cap) | None, length).
+    Outputs are STACKED by dtype so the result crosses the link in a
+    handful of transfers instead of one per column (each device→host
+    round trip costs ~15 ms on this platform — PERF.md); `layout_box`
+    is filled at trace time with {"data": [(name, dtype_str, row)],
+    "valids": [name]} describing the stacking."""
     lim2 = None if limit is None else limit + (offset or 0)
+    layout_box: dict = {}
 
     @jax.jit
     def fn(sb, sbv, lengths, builds, params):
@@ -120,11 +126,22 @@ def build_fused_fn(pipe, final_program: Optional[ir.Program],
             env = {n: (d[:out_cap], v[:out_cap] if v is not None else None)
                    for n, (d, v) in env.items()}
         out_names = [n for n in keep if n in env] or list(env.keys())
-        out_d = {n: env[n][0] for n in out_names}
-        out_v = {n: env[n][1] for n in out_names if env[n][1] is not None}
-        return out_d, out_v, length
+        groups: dict = {}
+        data_layout = []
+        for n in out_names:
+            d = env[n][0]
+            key = str(d.dtype)
+            groups.setdefault(key, []).append(d)
+            data_layout.append((n, key, len(groups[key]) - 1))
+        valid_names = [n for n in out_names if env[n][1] is not None]
+        layout_box["data"] = data_layout
+        layout_box["valids"] = valid_names
+        data_stacks = {k: jnp.stack(v) for k, v in groups.items()}
+        valid_stack = (jnp.stack([env[n][1] for n in valid_names])
+                       if valid_names else None)
+        return data_stacks, valid_stack, length
 
-    return fn
+    return fn, layout_box
 
 
 def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,

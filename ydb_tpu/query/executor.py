@@ -190,30 +190,60 @@ class Executor:
                                 tuple(sorted(all_params)))
         entry = self._fused_cache.get(key)
         if entry is None:
-            fn = F.build_fused_fn(
+            fn, layout_box = F.build_fused_fn(
                 pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
                 join_metas, rank_assigns, sort_spec, plan.limit, plan.offset,
                 tuple(dict.fromkeys(n for (n, _lbl) in plan.output)))
             keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
             out_cols = [c for c in schema.columns if c.name in keep] \
                 or list(schema.columns)
-            entry = (fn, Schema(out_cols))
+            entry = (fn, layout_box, Schema(out_cols))
             self._fused_cache[key] = entry
-        fn, out_schema = entry
+        fn, layout_box, out_schema = entry
 
         dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
                       for k, v in all_params.items()}
         build_inputs = [F.build_traced_inputs(bt) for bt in builds]
-        out_d, out_v, length = fn(arrays, valids, lengths, build_inputs,
-                                  dev_params)
+        data_stacks, valid_stack, length = fn(arrays, valids, lengths,
+                                              build_inputs, dev_params)
 
-        out_dicts = {n: d for n, d in dicts.items() if out_schema.has(n)}
-        out_dicts.update({n: d for n, d in plan.result_dicts.items()
-                          if out_schema.has(n)})
-        out_cap = (next(iter(out_d.values())).shape[0] if out_d else 0)
-        dblock = DeviceBlock(out_schema, out_d, out_v, length, out_cap,
-                             out_dicts)
-        block = to_host(dblock)
+        # ONE device→host transfer for the whole result (length included):
+        # per-column fetches pay a full link round trip each. Large
+        # row-level outputs sync the length first and slice device-side
+        # so padding doesn't cross the link.
+        cap_out = (next(iter(data_stacks.values())).shape[1]
+                   if data_stacks else 0)
+        if cap_out > (1 << 16):
+            n = int(length)
+            m = max(n, 1)
+            data_stacks = {k: v[:, :m] for k, v in data_stacks.items()}
+            if valid_stack is not None:
+                valid_stack = valid_stack[:, :m]
+            host_stacks, host_valids = jax.device_get(
+                (data_stacks, valid_stack))
+        else:
+            host_stacks, host_valids, n = jax.device_get(
+                (data_stacks, valid_stack, length))
+            n = int(n)
+        out_dicts = {n2: d for n2, d in dicts.items() if out_schema.has(n2)}
+        out_dicts.update({n2: d for n2, d in plan.result_dicts.items()
+                          if out_schema.has(n2)})
+        valid_row = {nm: i for i, nm in enumerate(layout_box["valids"])}
+        cols = {}
+        out_cols = []
+        for (name, dtype_key, row) in layout_box["data"]:
+            if not out_schema.has(name):
+                continue
+            dt_ = out_schema.dtype(name)
+            data = host_stacks[dtype_key][row][:n].astype(dt_.np)
+            valid = None
+            if name in valid_row and host_valids is not None:
+                v = host_valids[valid_row[name]][:n]
+                if not v.all():
+                    valid = v
+            cols[name] = ColumnData(data, valid, out_dicts.get(name))
+            out_cols.append(out_schema.col(name))
+        block = HostBlock(Schema(out_cols), cols, n)
         lo = plan.offset or 0
         if lo:
             hi = lo + plan.limit if plan.limit is not None else block.length

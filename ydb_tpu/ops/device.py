@@ -67,6 +67,19 @@ def to_device(block: HostBlock, capacity: Optional[int] = None,
     return DeviceBlock(block.schema, arrays, valids, length, cap, dicts)
 
 
+def host_column(data, valid, dtype, dictionary) -> ColumnData:
+    """Host materialization convention shared by every device→host path
+    (`to_host`, the fused unpack): restore the schema dtype, collapse
+    all-valid masks to None, reattach the dictionary."""
+    d = np.asarray(data).astype(dtype.np)
+    v = valid
+    if v is not None:
+        v = np.asarray(v)
+        if v.all():
+            v = None
+    return ColumnData(d, v, dictionary)
+
+
 def to_host(dblock: DeviceBlock) -> HostBlock:
     import jax
 
@@ -79,11 +92,6 @@ def to_host(dblock: DeviceBlock) -> HostBlock:
     host_a, host_v = jax.device_get((sliced, vsliced))
     cols = {}
     for c in dblock.schema:
-        d = np.asarray(host_a[c.name]).astype(c.dtype.np)
-        v = host_v.get(c.name)
-        if v is not None:
-            v = np.asarray(v)
-            if v.all():
-                v = None
-        cols[c.name] = ColumnData(d, v, dblock.dictionaries.get(c.name))
+        cols[c.name] = host_column(host_a[c.name], host_v.get(c.name),
+                                   c.dtype, dblock.dictionaries.get(c.name))
     return HostBlock(dblock.schema, cols, n)

@@ -234,14 +234,13 @@ class Executor:
         for (name, dtype_key, row) in layout_box["data"]:
             if not out_schema.has(name):
                 continue
-            dt_ = out_schema.dtype(name)
-            data = host_stacks[dtype_key][row][:n].astype(dt_.np)
-            valid = None
-            if name in valid_row and host_valids is not None:
-                v = host_valids[valid_row[name]][:n]
-                if not v.all():
-                    valid = v
-            cols[name] = ColumnData(data, valid, out_dicts.get(name))
+            valid = (host_valids[valid_row[name]][:n]
+                     if name in valid_row and host_valids is not None
+                     else None)
+            from ydb_tpu.ops.device import host_column
+            cols[name] = host_column(host_stacks[dtype_key][row][:n], valid,
+                                     out_schema.dtype(name),
+                                     out_dicts.get(name))
             out_cols.append(out_schema.col(name))
         block = HostBlock(Schema(out_cols), cols, n)
         lo = plan.offset or 0

@@ -49,3 +49,37 @@ def test_tpch_distributed(eng, name):
 def test_distributed_path_taken(eng):
     # the aggregation boundary must actually route through the mesh
     assert eng.executor._dist_aggs, "distributed path was never exercised"
+
+
+def test_map_distribution_non_agg(eng):
+    """Non-aggregating queries (scan/filter/join/sort) fan out over the
+    mesh as map-style per-device pipelines (the UnionAll connection)."""
+    sql = ("select l_orderkey, l_extendedprice from lineitem "
+           "where l_quantity > 45 and l_discount >= 0.05 "
+           "order by l_extendedprice desc, l_orderkey limit 20")
+    got = eng.query(sql)
+    assert eng.executor.last_path == "distributed-map"
+    # oracle
+    import pandas as pd
+    li = pd.DataFrame(eng.tpch_data.tables["lineitem"])
+    w = li[(li.l_quantity > 45) & (li.l_discount >= 0.05)] \
+        .sort_values(["l_extendedprice", "l_orderkey"],
+                     ascending=[False, True]).head(20)
+    assert list(got.l_orderkey) == list(w.l_orderkey)
+
+
+def test_map_distribution_with_join(eng):
+    sql = ("select o.o_orderkey, c.c_name from orders o "
+           "join customer c on o.o_custkey = c.c_custkey "
+           "where o.o_totalprice > 400000 "
+           "order by o.o_orderkey limit 15")
+    got = eng.query(sql)
+    assert eng.executor.last_path == "distributed-map"
+    import pandas as pd
+    od = pd.DataFrame(eng.tpch_data.tables["orders"])
+    cu = pd.DataFrame(eng.tpch_data.tables["customer"])
+    w = od[od.o_totalprice > 400000].merge(
+        cu, left_on="o_custkey", right_on="c_custkey") \
+        .sort_values("o_orderkey").head(15)
+    assert list(got.o_orderkey) == list(w.o_orderkey)
+    assert list(got.c_name) == list(w.c_name)

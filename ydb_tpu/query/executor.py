@@ -51,7 +51,8 @@ class Executor:
         # all_to_all hash shuffle.
         self.mesh = mesh
         self._dist_aggs: dict = {}
-        # which path the last execute() took: fused | portioned | distributed
+        # which path the last execute() took:
+        # fused | portioned | distributed | distributed-map | literal
         self.last_path = ""
         # build sides above this estimate hash-partition into a GraceJoin
         # (host-DRAM partitions probed one at a time — the spill budget)
@@ -76,11 +77,16 @@ class Executor:
             else:
                 params[pname] = sub.columns[sub.schema.names[0]].data[0]
 
-        if self.mesh is not None and self.mesh.devices.size > 1 \
-                and self._can_distribute(plan):
-            self.last_path = "distributed"
-            merged = self._execute_distributed(plan, params, snapshot)
-            return self._project_output(merged, plan.output)
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            if self._can_distribute(plan):
+                self.last_path = "distributed"
+                merged = self._execute_distributed(plan, params, snapshot)
+                return self._project_output(merged, plan.output)
+            if self._can_distribute_map(plan, snapshot):
+                self.last_path = "distributed-map"
+                merged = self._execute_distributed_map(plan, params,
+                                                       snapshot)
+                return self._project_output(merged, plan.output)
 
         fused = self._try_execute_fused(plan, params, snapshot)
         if isinstance(fused, HostBlock):
@@ -289,6 +295,56 @@ class Executor:
         fp = plan.final_program
         return (fp is not None and fp.commands
                 and isinstance(fp.commands[0], ir.GroupBy))
+
+    def _can_distribute_map(self, plan: QueryPlan,
+                            snapshot: Snapshot) -> bool:
+        """Map-style distribution (the DqCnMap/UnionAll connection): the
+        pipeline has no aggregation boundary — scan/filter/join work
+        spreads across devices and per-device results union for the final
+        stage. Needs >1 scan source to be worth a fan-out."""
+        pipe = plan.pipeline
+        if pipe.partial is not None and any(
+                isinstance(c, ir.GroupBy) for c in pipe.partial.commands):
+            return False
+        if plan.final_program is not None and any(
+                isinstance(c, ir.GroupBy)
+                for c in plan.final_program.commands):
+            return False
+        return self._scan_source_count(plan, snapshot) > 1
+
+    def _scan_source_count(self, plan: QueryPlan, snapshot: Snapshot) -> int:
+        pipe = plan.pipeline
+        table = self.catalog.table(pipe.scan.table)
+        return sum(len(p) + len(e)
+                   for (p, e) in (s.scan_sources(snapshot,
+                                                 pipe.scan.prune or None)
+                                  for s in table.shards))
+
+    def _execute_distributed_map(self, plan: QueryPlan, params: dict,
+                                 snapshot: Snapshot) -> HostBlock:
+        """Per-device pipelines (scan → filter → joins), results unioned
+        host-side, final stage (exprs/sort/limit) single-device — the
+        UnionAll-connection analog for non-aggregating queries.
+
+        Guarded by `_can_distribute_map` (>1 scan source), so at least two
+        per-device results always arrive."""
+        nsrc = self._scan_source_count(plan, snapshot)
+        # no point replicating builds onto devices that get no blocks
+        devs = list(self.mesh.devices.flat)[:max(2, min(
+            self.mesh.devices.size, nsrc))]
+        builds = [self._prepare_join(step, params, snapshot)
+                  for kind, step in plan.pipeline.steps if kind == "join"]
+        builds_by_dev = [[J.place(b, d) for b in builds] for d in devs]
+        # dispatch every device's pipeline first; transfers afterwards —
+        # to_host blocks, and fetching inside the loop would serialize the
+        # fan-out this path exists for
+        pending = [self._run_block(plan.pipeline, dblock,
+                                   builds_by_dev[di], params)
+                   for di, dblock in self._scan_device_blocks(
+                       plan.pipeline, snapshot, devices=devs)]
+        outs = [to_host(d) for d in pending]
+        union = HostBlock.concat(outs) if len(outs) > 1 else outs[0]
+        return self._finalize(plan, [to_device(union)], params)
 
     def _execute_distributed(self, plan: QueryPlan, params: dict,
                              snapshot: Snapshot) -> HostBlock:

@@ -66,6 +66,31 @@ select s_state, i_category, profit,
        rank() over (partition by s_state order by profit desc) as rk
 from sales
 order by s_state, rk, i_category""",
+    # q7 family: average report over a category/year slice
+    "ds7": """
+select i.i_item_sk, avg(ss.ss_quantity) as agg1,
+       avg(ss.ss_sales_price) as agg2, avg(ss.ss_ext_sales_price) as agg3
+from store_sales ss
+join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+join item i on i.i_item_sk = ss.ss_item_sk
+where d.d_year = 2001 and i.i_category = 'Books'
+group by i.i_item_sk
+order by i.i_item_sk
+limit 100""",
+    # q73 family: frequent buyers via a HAVING derived table joined back
+    "ds73": """
+select c.c_last_name, c.c_first_name, dj.cnt
+from (
+  select ss.ss_customer_sk as ss_customer_sk, count(*) as cnt
+  from store_sales ss
+  join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+  where d.d_year = 2000
+  group by ss.ss_customer_sk
+  having count(*) > 8
+) as dj
+join customer c on c.c_customer_sk = dj.ss_customer_sk
+order by dj.cnt desc, c.c_last_name, c.c_first_name
+limit 50""",
 }
 
 
@@ -120,4 +145,22 @@ def oracle(name: str, raw: dict) -> pd.DataFrame:
             method="min", ascending=False).astype(np.int64)
         return g.sort_values(["s_state", "rk", "i_category"],
                              kind="stable")
+    if name == "ds7":
+        x = j[(j.d_year == 2001) & (j.i_category == "Books")]
+        g = x.groupby("i_item_sk", as_index=False).agg(
+            agg1=("ss_quantity", "mean"), agg2=("ss_sales_price", "mean"),
+            agg3=("ss_ext_sales_price", "mean"))
+        return g.sort_values("i_item_sk").head(100)
+    if name == "ds73":
+        c = f["customer"]
+        x = ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        x = x[x.d_year == 2000]
+        g = x.groupby("ss_customer_sk").size().reset_index(name="cnt")
+        g = g[g.cnt > 8]
+        m = g.merge(c, left_on="ss_customer_sk", right_on="c_customer_sk")
+        m = m.sort_values(["cnt", "c_last_name", "c_first_name"],
+                          ascending=[False, True, True],
+                          kind="stable").head(50)
+        return m[["c_last_name", "c_first_name", "cnt"]]
     raise KeyError(name)
+

@@ -45,6 +45,15 @@ QUERIES = {
     "c10": ("select MobilePhoneModel, count(distinct UserID) as u from hits "
             "where MobilePhoneModel <> '' group by MobilePhoneModel "
             "order by u desc, MobilePhoneModel limit 10"),
+    # Q11
+    "c11": ("select MobilePhoneModel, AdvEngineID, count(distinct UserID) as u "
+            "from hits where MobilePhoneModel <> '' "
+            "group by MobilePhoneModel, AdvEngineID "
+            "order by u desc, MobilePhoneModel, AdvEngineID limit 10"),
+    # Q14
+    "c14": ("select SearchEngineID, SearchPhrase, count(*) as c from hits "
+            "where SearchPhrase <> '' group by SearchEngineID, SearchPhrase "
+            "order by c desc, SearchEngineID, SearchPhrase limit 10"),
     # Q12
     "c12": ("select SearchPhrase, count(*) as c from hits "
             "where SearchPhrase <> '' group by SearchPhrase "
@@ -112,6 +121,18 @@ def oracle(name: str, raw: dict) -> pd.DataFrame:
             .reset_index(name="u")
         return g.sort_values(["u", "MobilePhoneModel"],
                              ascending=[False, True]).head(10)
+    if name == "c11":
+        dd = df[df.MobilePhoneModel != ""]
+        g = dd.groupby(["MobilePhoneModel", "AdvEngineID"]) \
+            .UserID.nunique().reset_index(name="u")
+        return g.sort_values(["u", "MobilePhoneModel", "AdvEngineID"],
+                             ascending=[False, True, True]).head(10)
+    if name == "c14":
+        dd = df[df.SearchPhrase != ""]
+        g = dd.groupby(["SearchEngineID", "SearchPhrase"]).size() \
+            .reset_index(name="c")
+        return g.sort_values(["c", "SearchEngineID", "SearchPhrase"],
+                             ascending=[False, True, True]).head(10)
     if name == "c12":
         d = df[df.SearchPhrase != ""]
         g = d.groupby("SearchPhrase").size().reset_index(name="c")

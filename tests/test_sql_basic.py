@@ -337,3 +337,66 @@ def test_order_by_unprojected_column():
     e.execute("insert into t (id, bal) values (2, 20), (1, 10), (3, 30)")
     df = e.query("select bal from t order by id desc")
     assert list(df.bal) == [30, 20, 10]
+
+
+def test_string_functions_lut_lane(eng):
+    """length/lower/upper/trim/replace/regexp_replace fold through the
+    dictionary LUT lane (the string/re2 UDF-module analog,
+    ydb/library/yql/udfs/common)."""
+    df = eng.query("""select name, length(name) as l, upper(name) as u
+                      from t where name is not null
+                      group by name order by name""")
+    for _, r in df.iterrows():
+        assert r.l == len(r["name"])
+        assert r.u == r["name"].upper()
+    df = eng.query("""select replace(name, 'n', 'm') as m, count(*) as c
+                      from t where name is not null
+                      group by replace(name, 'n', 'm') order by m""")
+    assert list(df.m) == [f"m{k}" for k in (0, 1, 2, 3, 4)]
+    df = eng.query(r"""select regexp_replace(name, '^n(\d)$', 'x\1') as x
+                       from t where name = 'n3' limit 1""")
+    assert df.x[0] == "x3"
+    # predicate position: length() in WHERE
+    df = eng.query("select count(*) as c from t where length(name) = 2")
+    want = sum(1 for i in range(100) if i % 7 != 0)
+    assert df.c[0] == want
+
+
+def test_time_of_day_extraction(eng):
+    e2 = QueryEngine(block_rows=1 << 10)
+    e2.execute("create table ts (id Int64 not null, t Int64 not null, "
+               "primary key (id))")
+    e2.execute("insert into ts (id, t) values (1, 3723), (2, 86399), (3, 0)")
+    df = e2.query("select id, hour(t) as h, minute(t) as m, second(t) as s "
+                  "from ts order by id")
+    assert list(df.h) == [1, 23, 0]
+    assert list(df.m) == [2, 59, 0]
+    assert list(df.s) == [3, 59, 0]
+    # extract() syntax routes to the same kernels
+    df = e2.query("select extract(minute from t) as m from ts order by id")
+    assert list(df.m) == [2, 59, 0]
+
+
+def test_string_case_shared_dictionary(eng):
+    """String-valued CASE: literal and column branches encode into one
+    derived dictionary; distinct-source branches are rejected."""
+    df = eng.query("""select case when grp = 0 then name else '' end as src,
+                      count(*) as c from t where name is not null
+                      group by case when grp = 0 then name else '' end
+                      order by src""")
+    assert df.src[0] == ""
+    assert set(df.src[1:]) <= {"n0", "n1", "n2", "n3", "n4"}
+    # all-literal branches still decode as strings (not raw codes)
+    df = eng.query("""select case when grp = 1 then 'one' else 'rest' end as k,
+                      count(*) as c from t
+                      group by case when grp = 1 then 'one' else 'rest' end
+                      order by k""")
+    assert list(df.k) == ["one", "rest"]
+    assert df.c.sum() == 100
+    # if() over two DIFFERENT source columns must error, not mis-decode
+    e2 = QueryEngine(block_rows=1 << 10)
+    e2.execute("create table two (id Int64 not null, a Utf8, b Utf8, "
+               "primary key (id))")
+    e2.execute("insert into two (id, a, b) values (1, 'x', 'y')")
+    with pytest.raises(QueryError):
+        e2.query("select if(id = 1, a, b) as s from two")

@@ -34,16 +34,35 @@ HITS_SCHEMA = Schema([
     Column("IsDownload", dt.DType(dt.Kind.INT64, False)),
     Column("SearchEngineID", dt.DType(dt.Kind.INT64, False)),
     Column("SearchPhrase", dt.DType(dt.Kind.STRING, False)),
+    Column("MobilePhone", dt.DType(dt.Kind.INT64, False)),
     Column("MobilePhoneModel", dt.DType(dt.Kind.STRING, False)),
     Column("URL", dt.DType(dt.Kind.STRING, False)),
     Column("Title", dt.DType(dt.Kind.STRING, False)),
+    Column("Referer", dt.DType(dt.Kind.STRING, False)),
     Column("UserAgent", dt.DType(dt.Kind.INT64, False)),
+    Column("TraficSourceID", dt.DType(dt.Kind.INT64, False)),
+    Column("DontCountHits", dt.DType(dt.Kind.INT64, False)),
+    Column("URLHash", dt.DType(dt.Kind.INT64, False)),
+    Column("RefererHash", dt.DType(dt.Kind.INT64, False)),
+    Column("WindowClientWidth", dt.DType(dt.Kind.INT64, False)),
+    Column("WindowClientHeight", dt.DType(dt.Kind.INT64, False)),
 ])
 
 _WORDS = np.array(["google", "yandex", "weather", "news", "cars", "phones",
                    "games", "music", "maps", "cinema", "travel", "recipes",
                    "football", "crypto", "python", "shoes", "hotels", ""])
 _MODELS = np.array(["", "", "", "iPhone", "Galaxy", "Pixel", "Nokia"])
+_REF_HOSTS = np.array(["google.com", "www.yandex.ru", "news.site",
+                       "example.com", "forum.example.org", "blog.io"])
+
+
+def content_hash(s: str) -> int:
+    """Deterministic content-addressed 63-bit string hash (URLHash /
+    RefererHash columns — the real dataset carries precomputed sipHash-like
+    url hashes; content addressing keeps query constants stable)."""
+    import hashlib
+    return int.from_bytes(hashlib.sha1(s.encode()).digest()[:8],
+                          "little") >> 1
 
 
 def gen_hits(n_rows: int, seed: int = 20260729) -> dict:
@@ -66,6 +85,17 @@ def gen_hits(n_rows: int, seed: int = 20260729) -> dict:
                        _WORDS[zipf(len(_WORDS) - 1, n)].astype(str))
     titles = np.char.add(np.char.capitalize(
         _WORDS[zipf(len(_WORDS) - 1, n)].astype(str)), " page")
+    ref_host = _REF_HOSTS[zipf(len(_REF_HOSTS), n)]
+    ref_path = _WORDS[zipf(len(_WORDS) - 1, n)]
+    referers = np.char.add(np.char.add(np.char.add(
+        "https://", ref_host.astype(str)), "/"), ref_path.astype(str))
+    referers = np.where(rng.random(n) < 0.4, "", referers)
+    def _hashes(arr):
+        uniq, inv = np.unique(arr, return_inverse=True)
+        return np.array([content_hash(u) for u in uniq],
+                        dtype=np.int64)[inv]
+    url_hashes = _hashes(urls)
+    ref_hashes = _hashes(referers)
     return {
         "WatchID": rng.integers(1, 1 << 60, n),
         "JavaEnable": rng.integers(0, 2, n),
@@ -85,10 +115,19 @@ def gen_hits(n_rows: int, seed: int = 20260729) -> dict:
         "IsDownload": (rng.random(n) < 0.02).astype(np.int64),
         "SearchEngineID": np.where(phrases == "", 0, zipf(90, n) + 1),
         "SearchPhrase": phrases.astype(object),
+        "MobilePhone": zipf(9, n),
         "MobilePhoneModel": _MODELS[zipf(len(_MODELS), n)].astype(object),
         "URL": urls.astype(object),
         "Title": titles.astype(object),
+        "Referer": referers.astype(object),
         "UserAgent": zipf(80, n) + 1,
+        "TraficSourceID": rng.integers(-1, 10, n),
+        "DontCountHits": (rng.random(n) < 0.05).astype(np.int64),
+        "URLHash": url_hashes,
+        "RefererHash": ref_hashes,
+        "WindowClientWidth": rng.choice(
+            [0, 1024, 1280, 1366, 1440, 1920], n),
+        "WindowClientHeight": rng.choice([0, 600, 720, 768, 900, 1080], n),
     }
 
 

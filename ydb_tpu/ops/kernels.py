@@ -247,6 +247,15 @@ _reg("year", _rt_i32, lambda xp, a, e: _civil(xp, a[0])[0].astype(np.int32))
 _reg("month", _rt_i32, lambda xp, a, e: _civil(xp, a[0])[1].astype(np.int32))
 _reg("day_of_month", _rt_i32, lambda xp, a, e: _civil(xp, a[0])[2].astype(np.int32))
 
+# time-of-day extraction over unix-seconds int64 (TIMESTAMP storage is
+# seconds; the reference's datetime2 UDF module is the analog surface)
+_reg("hour_of_day", _rt_i32,
+     lambda xp, a, e: ((a[0] // 3600) % 24).astype(np.int32))
+_reg("minute_of_hour", _rt_i32,
+     lambda xp, a, e: ((a[0] // 60) % 60).astype(np.int32))
+_reg("second_of_minute", _rt_i32,
+     lambda xp, a, e: (a[0] % 60).astype(np.int32))
+
 
 # -- dictionary-coded string ops ------------------------------------------
 

@@ -273,6 +273,34 @@ def _string_fn(e: ast.Expr, scope: Scope):
                 return None
             return s[start:start + length] if length is not None else s[start:]
         return b, g
+    if isinstance(e, ast.FuncCall) and e.name in _STR_UNARY \
+            and len(e.args) == 1:
+        inner = _string_fn(e.args[0], scope)
+        if inner is None:
+            return None
+        b, f = inner
+        g0 = _STR_UNARY[e.name]
+        return b, (lambda s, f=f, g0=g0:
+                   None if f(s) is None else g0(f(s)))
+    if isinstance(e, ast.FuncCall) and e.name == "replace" \
+            and len(e.args) == 3:
+        inner = _string_fn(e.args[0], scope)
+        old_f, new_f = _try_fold(e.args[1]), _try_fold(e.args[2])
+        if inner is None or old_f is None or new_f is None:
+            return None
+        b, f = inner
+        return b, (lambda s, f=f, o=str(old_f.value), n=str(new_f.value):
+                   None if f(s) is None else f(s).replace(o, n))
+    if isinstance(e, ast.FuncCall) and e.name == "regexp_replace" \
+            and len(e.args) == 3:
+        inner = _string_fn(e.args[0], scope)
+        pat_f, rep_f = _try_fold(e.args[1]), _try_fold(e.args[2])
+        if inner is None or pat_f is None or rep_f is None:
+            return None
+        b, f = inner
+        rx = re.compile(str(pat_f.value))
+        return b, (lambda s, f=f, rx=rx, r=str(rep_f.value):
+                   None if f(s) is None else rx.sub(r, f(s)))
     if isinstance(e, ast.BinOp) and e.op == "||":
         lf = _try_fold(e.right)
         if lf is not None and isinstance(lf.value, str):
@@ -292,6 +320,18 @@ def _string_fn(e: ast.Expr, scope: Scope):
     return None
 
 
+# pure python string transforms usable inside the dictionary-LUT lane
+# (the analog of the reference's String/Unicode UDF modules,
+# ydb/library/yql/udfs/common/string)
+_STR_UNARY: dict[str, Callable] = {
+    "lower": str.lower,
+    "upper": str.upper,
+    "trim": str.strip,
+    "ltrim": str.lstrip,
+    "rtrim": str.rstrip,
+}
+
+
 def _lut_pred(binding: ColumnBinding, fn: Callable, pool: ParamPool) -> ir.Expr:
     """bool-LUT gather over a dictionary column."""
     d = binding.dictionary
@@ -299,6 +339,17 @@ def _lut_pred(binding: ColumnBinding, fn: Callable, pool: ParamPool) -> ir.Expr:
     for i, v in enumerate(d.values_array()):
         lut[i] = bool(fn(v))
     p = pool.add(lut, dt.DType(dt.Kind.BOOL, False), is_array=True)
+    return ir.call("take_lut", ir.Col(binding.internal), p)
+
+
+def _lut_int(binding: ColumnBinding, fn: Callable, pool: ParamPool) -> ir.Expr:
+    """int64-LUT gather over a dictionary column (length() and friends)."""
+    d = binding.dictionary
+    lut = np.zeros(max(len(d), 1), dtype=np.int64)
+    for i, v in enumerate(d.values_array()):
+        r = fn(v)
+        lut[i] = 0 if r is None else int(r)
+    p = pool.add(lut, dt.DType(dt.Kind.INT64, False), is_array=True)
     return ir.call("take_lut", ir.Col(binding.internal), p)
 
 
@@ -495,6 +546,9 @@ class ExprBinder:
         return None
 
     def _case(self, e: ast.Case) -> ir.Expr:
+        sc = self._maybe_string_case(e)
+        if sc is not None:
+            return sc
         whens = []
         for cond, res in e.whens:
             if e.operand is not None:
@@ -508,11 +562,102 @@ class ExprBinder:
             out = ir.call("if", cond, res, out)
         return out
 
+    def _maybe_string_case(self, e: ast.Case) -> Optional[ir.Expr]:
+        """String-valued CASE: branch values are string expressions of one
+        source column and/or string literals. All branches encode into ONE
+        fresh derived dictionary; the device selects int32 codes with the
+        `if` kernel (the string CASE in ClickBench Q39's Src column).
+        Mirrors how the reference keeps CASE over utf8 inside the block
+        engine via dictionary-encoded arrays."""
+        from ydb_tpu.core.dictionary import Dictionary
+        branches = [res for _, res in e.whens]
+        if e.default is not None:
+            branches.append(e.default)
+        kinds = []               # ("lit", str) | ("col", binding, fn)
+        src_binding = None
+        any_string = False
+        for r in branches:
+            f = _try_fold(r)
+            if f is not None and isinstance(f.value, str):
+                kinds.append(("lit", f.value))
+                any_string = True
+                continue
+            sf = _string_fn(r, self.scope)
+            if sf is None:
+                return None      # non-string branch → normal CASE path
+            b, fn = sf
+            if src_binding is not None and b.internal != src_binding.internal:
+                raise BindError(
+                    "string CASE branches must derive from one column")
+            src_binding = b
+            kinds.append(("col", b, fn))
+            any_string = True
+        if not any_string:
+            return None
+        cache = self.pool.__dict__.setdefault("_derived_cache", {})
+        ckey = ("case", repr(e))
+        hit = cache.get(ckey)
+        if hit is not None:
+            return hit
+        nd = Dictionary()
+        irs = []
+        lut_params = []
+        for kind in kinds:
+            if kind[0] == "lit":
+                code = int(nd.encode([kind[1]])[0])
+                irs.append(ir.Const(code, dt.DType(dt.Kind.STRING, False)))
+            else:
+                _, b, fn = kind
+                src = b.dictionary.values_array()
+                lut = np.full(max(len(src), 1), -1, dtype=np.int32)
+                for i, v in enumerate(src):
+                    r = fn(v)
+                    if r is not None:
+                        lut[i] = nd.encode([r])[0]
+                p = self.pool.add(lut, dt.DType(dt.Kind.STRING, False),
+                                  is_array=True)
+                lut_params.append(p.name)
+                irs.append(ir.call("take_lut", ir.Col(b.internal), p))
+        default_ir = irs.pop() if e.default is not None else ir.Const(
+            -1, dt.DType(dt.Kind.STRING, False))
+        out = default_ir
+        conds = []
+        for cond, _ in e.whens:
+            if e.operand is not None:
+                cond = ast.BinOp("=", e.operand, cond)
+            conds.append(self.bind(cond))
+        for cond_ir, res_ir in zip(reversed(conds), reversed(irs)):
+            out = ir.call("if", cond_ir, res_ir, out)
+        for pname in lut_params:
+            self.pool.param_dicts[pname] = nd
+        # all-literal CASE has no take_lut param to carry the dictionary —
+        # key it on the root IR node identity (the memo cache returns this
+        # exact object for every rebinding)
+        self.pool.__dict__.setdefault("expr_dicts", {})[id(out)] = nd
+        cache[ckey] = out
+        return out
+
     def _func(self, e: ast.FuncCall) -> ir.Expr:
         name = e.name
         if name in AGG_NAMES:
             raise BindError(f"aggregate {name} not allowed here")
+        # string-valued if/coalesce must share ONE derived dictionary —
+        # route through the string-CASE path (independent dictionaries
+        # would decode each other's codes)
+        if name == "if" and len(e.args) == 3:
+            sc = self._maybe_string_case(ast.Case(
+                None, ((e.args[0], e.args[1]),), e.args[2]))
+            if sc is not None:
+                return sc
+        if name == "coalesce" and len(e.args) >= 2:
+            sc = self._maybe_string_case(ast.Case(
+                None, tuple((ast.IsNull(a, negated=True), a)
+                            for a in e.args[:-1]), e.args[-1]))
+            if sc is not None:
+                return sc
         simple = {"year": "year", "month": "month", "day": "day_of_month",
+                  "hour": "hour_of_day", "minute": "minute_of_hour",
+                  "second": "second_of_minute",
                   "abs": "abs", "floor": "floor", "ceil": "ceil",
                   "sqrt": "sqrt", "exp": "exp", "ln": "ln", "round": "round",
                   "coalesce": "coalesce", "if": "if"}
@@ -520,6 +665,16 @@ class ExprBinder:
             return ir.call(simple[name], *[self.bind(a) for a in e.args])
         if name == "power":
             return ir.call("pow", *[self.bind(a) for a in e.args])
+        if name == "length":
+            if len(e.args) != 1:
+                raise BindError("length takes one argument")
+            sf = _string_fn(e.args[0], self.scope)
+            if sf is None:
+                raise BindError("length needs a string expression")
+            b, fn = sf
+            return _lut_int(
+                b, lambda s: None if s is None or fn(s) is None
+                else len(fn(s)), self.pool)
         if name in ("startswith", "endswith", "contains_string"):
             sf = _string_fn(e.args[0], self.scope)
             lit = _try_fold(e.args[1])

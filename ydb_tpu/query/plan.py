@@ -75,6 +75,9 @@ class QueryPlan:
     # dictionaries for derived string columns (substring/concat results):
     # internal column name -> Dictionary
     result_dicts: dict = field(default_factory=dict)
+    # schema-declaration order of every FROM relation's columns
+    # ("alias.col" internal names) — SELECT * output order
+    star_order: list = field(default_factory=list)
 
 
 def explain(plan: QueryPlan, indent: int = 0) -> str:

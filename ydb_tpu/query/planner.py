@@ -362,6 +362,8 @@ class Planner:
 
         plan = QueryPlan(pipeline=pipeline, params=pool.values,
                          init_subplans=list(self._init_subplans))
+        plan.star_order = [f"{r.alias}.{col.name}"
+                           for r in rels.values() for col in r.table.schema]
         self._plan_projection_agg(sel, plan, binder)
         return plan
 
@@ -1114,7 +1116,11 @@ class Planner:
         out_names = []
         for i, item in enumerate(sel.items):
             if isinstance(item.expr, ast.Star):
-                names = plan.pipeline.out_names
+                # pipeline out_names are demand-set derived (unordered);
+                # emit * in schema-declaration order
+                avail = set(plan.pipeline.out_names)
+                names = [n for n in plan.star_order if n in avail] \
+                    or plan.pipeline.out_names
                 if item.expr.table is not None:
                     prefix = item.expr.table + "."
                     names = [n for n in names if n.startswith(prefix)]
@@ -1417,9 +1423,24 @@ class Planner:
     def _maybe_result_dict(self, e) -> object:
         """Dictionary of a derived string expression (take_lut through a
         pool param), or the source column's dictionary for plain columns."""
+        d = getattr(self.pool, "expr_dicts", None)
+        if d is not None and id(e) in d:
+            return d[id(e)]
         if isinstance(e, ir.Call) and e.op == "take_lut" \
                 and len(e.args) == 2 and isinstance(e.args[1], ir.Param):
             return self.pool.param_dicts.get(e.args[1].name)
+        if isinstance(e, ir.Call) and e.op in ("if", "coalesce"):
+            # string CASE: every string branch encodes into one shared
+            # derived dictionary (binder._maybe_string_case); branches from
+            # DIFFERENT dictionaries would decode through the wrong one
+            found = {id(x): x for x in
+                     (self._maybe_result_dict(a) for a in e.args)
+                     if x is not None}
+            if len(found) > 1:
+                raise PlanError("string branches of if/coalesce come from "
+                                "different dictionaries")
+            if found:
+                return next(iter(found.values()))
         return None
 
     def _string_dict(self, name: str):

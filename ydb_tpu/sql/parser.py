@@ -433,14 +433,17 @@ class Parser:
                 arg = self.expr()
                 self.expect_op(")")
                 return ast.FuncCall(field, (arg,))
-            if t.value in ("if",):
-                self.next()
+            if t.value in ("if", "replace") \
+                    and self.peek(1).kind == "op" \
+                    and self.peek(1).value == "(":
+                # keywords that double as function names
+                name = self.next().value
                 self.expect_op("(")
                 args = [self.expr()]
                 while self.accept_op(","):
                     args.append(self.expr())
                 self.expect_op(")")
-                return ast.FuncCall("if", tuple(args))
+                return ast.FuncCall(name, tuple(args))
         if t.kind == "ident":
             nxt = self.peek(1)
             if nxt.kind == "op" and nxt.value == "(":

@@ -66,16 +66,162 @@ select s_state, i_category, profit,
        rank() over (partition by s_state order by profit desc) as rk
 from sales
 order by s_state, rk, i_category""",
-    # q7 family: average report over a category/year slice
+    # q7: demographic/promotion average report (official form)
     "ds7": """
-select i.i_item_sk, avg(ss.ss_quantity) as agg1,
-       avg(ss.ss_sales_price) as agg2, avg(ss.ss_ext_sales_price) as agg3
+select i.i_item_id, avg(ss.ss_quantity) as agg1,
+       avg(ss.ss_list_price) as agg2, avg(ss.ss_coupon_amt) as agg3,
+       avg(ss.ss_sales_price) as agg4
+from store_sales ss
+join customer_demographics cd on cd.cd_demo_sk = ss.ss_cdemo_sk
+join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+join item i on i.i_item_sk = ss.ss_item_sk
+join promotion p on p.p_promo_sk = ss.ss_promo_sk
+where cd.cd_gender = 'M' and cd.cd_marital_status = 'S'
+  and cd.cd_education_status = 'College'
+  and (p.p_channel_email = 'N' or p.p_channel_event = 'N')
+  and d.d_year = 2000
+group by i.i_item_id
+order by i.i_item_id
+limit 100""",
+    # q19: brand report where the customer's zip differs from the store's
+    # (zip prefixes carried as ints; the reference compares substr(zip,1,5))
+    "ds19": """
+select i.i_brand_id, i.i_brand, i.i_manufact_id, i.i_manufact,
+       sum(ss.ss_ext_sales_price) as ext_price
 from store_sales ss
 join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
 join item i on i.i_item_sk = ss.ss_item_sk
-where d.d_year = 2001 and i.i_category = 'Books'
-group by i.i_item_sk
-order by i.i_item_sk
+join customer c on c.c_customer_sk = ss.ss_customer_sk
+join customer_address ca on ca.ca_address_sk = c.c_current_addr_sk
+join store s on s.s_store_sk = ss.ss_store_sk
+where d.d_moy = 11 and d.d_year = 1999 and i.i_manager_id = 8
+  and ca.ca_zip_num <> s.s_zip_num
+group by i.i_brand_id, i.i_brand, i.i_manufact_id, i.i_manufact
+order by ext_price desc, i.i_brand_id, i.i_manufact_id
+limit 100""",
+    # q33 family: per-manufacturer category sales across channels,
+    # UNION ALL re-aggregated (two channels in this schema subset)
+    "ds33": """
+with ssr as (
+  select i.i_manufact_id as i_manufact_id,
+         sum(ss.ss_ext_sales_price) as total_sales
+  from store_sales ss
+  join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+  join item i on i.i_item_sk = ss.ss_item_sk
+  where i.i_category = 'Electronics' and d.d_year = 1998 and d.d_moy = 5
+  group by i.i_manufact_id),
+wsr as (
+  select i.i_manufact_id as i_manufact_id,
+         sum(ws.ws_ext_sales_price) as total_sales
+  from web_sales ws
+  join date_dim d on d.d_date_sk = ws.ws_sold_date_sk
+  join item i on i.i_item_sk = ws.ws_item_sk
+  where i.i_category = 'Electronics' and d.d_year = 1998 and d.d_moy = 5
+  group by i.i_manufact_id)
+select i_manufact_id, sum(total_sales) as total_sales
+from (select * from ssr union all select * from wsr) as tmp
+group by i_manufact_id
+order by total_sales desc, i_manufact_id
+limit 100""",
+    # q59 family: week-over-week per-store day-of-week sales ratios
+    # (CASE-pivoted weekly CTE self-joined at a 52-week offset)
+    "ds59": """
+with wss as (
+  select d.d_week_seq as d_week_seq, ss.ss_store_sk as ss_store_sk,
+         sum(case when d.d_day_name = 'Sunday'
+             then ss.ss_sales_price end) as sun_sales,
+         sum(case when d.d_day_name = 'Monday'
+             then ss.ss_sales_price end) as mon_sales,
+         sum(case when d.d_day_name = 'Friday'
+             then ss.ss_sales_price end) as fri_sales
+  from store_sales ss
+  join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+  group by d.d_week_seq, ss.ss_store_sk)
+select s.s_store_name, y.d_week_seq,
+       y.sun_sales / x.sun_sales as r1,
+       y.mon_sales / x.mon_sales as r2,
+       y.fri_sales / x.fri_sales as r3
+from wss y
+join wss x on y.ss_store_sk = x.ss_store_sk
+join store s on s.s_store_sk = y.ss_store_sk
+where y.d_week_seq >= 20 and y.d_week_seq <= 25
+  and x.d_week_seq = y.d_week_seq + 52
+order by s.s_store_name, y.d_week_seq
+limit 100""",
+    # q65: items selling at <=10% of their store's average revenue
+    "ds65": """
+with sc as (
+  select ss.ss_store_sk as ss_store_sk, ss.ss_item_sk as ss_item_sk,
+         sum(ss.ss_sales_price) as revenue
+  from store_sales ss group by ss.ss_store_sk, ss.ss_item_sk),
+sb as (
+  select sc.ss_store_sk as ss_store_sk, avg(sc.revenue) as ave
+  from sc group by sc.ss_store_sk)
+select s.s_store_name, i.i_item_id, sc.revenue
+from sb
+join sc on sc.ss_store_sk = sb.ss_store_sk
+join store s on s.s_store_sk = sc.ss_store_sk
+join item i on i.i_item_sk = sc.ss_item_sk
+where sc.revenue <= 0.1 * sb.ave
+order by s.s_store_name, i.i_item_id
+limit 100""",
+    # q88 family: store-hour traffic slots as scalar subqueries
+    "ds88": """
+select
+ (select count(*) from store_sales ss
+   join household_demographics hd on hd.hd_demo_sk = ss.ss_hdemo_sk
+   join time_dim t on t.t_time_sk = ss.ss_sold_time_sk
+   join store s on s.s_store_sk = ss.ss_store_sk
+   where t.t_hour = 8 and t.t_minute >= 30 and hd.hd_dep_count = 4
+     and s.s_store_name = 'store_1') as h8_30,
+ (select count(*) from store_sales ss
+   join household_demographics hd on hd.hd_demo_sk = ss.ss_hdemo_sk
+   join time_dim t on t.t_time_sk = ss.ss_sold_time_sk
+   join store s on s.s_store_sk = ss.ss_store_sk
+   where t.t_hour = 9 and t.t_minute < 30 and hd.hd_dep_count = 4
+     and s.s_store_name = 'store_1') as h9_00,
+ (select count(*) from store_sales ss
+   join household_demographics hd on hd.hd_demo_sk = ss.ss_hdemo_sk
+   join time_dim t on t.t_time_sk = ss.ss_sold_time_sk
+   join store s on s.s_store_sk = ss.ss_store_sk
+   where t.t_hour = 9 and t.t_minute >= 30 and hd.hd_dep_count = 4
+     and s.s_store_name = 'store_1') as h9_30,
+ (select count(*) from store_sales ss
+   join household_demographics hd on hd.hd_demo_sk = ss.ss_hdemo_sk
+   join time_dim t on t.t_time_sk = ss.ss_sold_time_sk
+   join store s on s.s_store_sk = ss.ss_store_sk
+   where t.t_hour = 10 and t.t_minute < 30 and hd.hd_dep_count = 4
+     and s.s_store_name = 'store_1') as h10_00""",
+    # q96: half-hour store traffic count
+    "ds96": """
+select count(*) as cnt
+from store_sales ss
+join household_demographics hd on hd.hd_demo_sk = ss.ss_hdemo_sk
+join time_dim t on t.t_time_sk = ss.ss_sold_time_sk
+join store s on s.s_store_sk = ss.ss_store_sk
+where t.t_hour = 20 and t.t_minute >= 30 and hd.hd_dep_count = 7
+  and s.s_store_name = 'store_2'""",
+    # q98: revenue share of each item within its class
+    # (windowed class total over an aggregated CTE)
+    "ds98": """
+with rev as (
+  select i.i_item_id as i_item_id, i.i_class as i_class,
+         i.i_category as i_category,
+         sum(ss.ss_ext_sales_price) as itemrevenue
+  from store_sales ss
+  join item i on i.i_item_sk = ss.ss_item_sk
+  join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
+  where i.i_category in ('Sports', 'Books', 'Home') and d.d_year = 1999
+    and d.d_moy >= 2 and d.d_moy <= 3
+  group by i.i_item_id, i.i_class, i.i_category),
+w2 as (
+  select i_item_id, i_class, i_category, itemrevenue,
+         sum(itemrevenue) over (partition by i_class) as classrevenue
+  from rev)
+select i_item_id, i_class, i_category, itemrevenue,
+       itemrevenue * 100 / classrevenue as revenueratio
+from w2
+order by i_category, i_class, i_item_id, itemrevenue, revenueratio
 limit 100""",
     # q73 family: frequent buyers via a HAVING derived table joined back
     "ds73": """
@@ -146,11 +292,129 @@ def oracle(name: str, raw: dict) -> pd.DataFrame:
         return g.sort_values(["s_state", "rk", "i_category"],
                              kind="stable")
     if name == "ds7":
-        x = j[(j.d_year == 2001) & (j.i_category == "Books")]
-        g = x.groupby("i_item_sk", as_index=False).agg(
-            agg1=("ss_quantity", "mean"), agg2=("ss_sales_price", "mean"),
-            agg3=("ss_ext_sales_price", "mean"))
-        return g.sort_values("i_item_sk").head(100)
+        cd, p = f["customer_demographics"], f["promotion"]
+        x = j.merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk") \
+             .merge(p, left_on="ss_promo_sk", right_on="p_promo_sk")
+        x = x[(x.cd_gender == "M") & (x.cd_marital_status == "S")
+              & (x.cd_education_status == "College")
+              & ((x.p_channel_email == "N") | (x.p_channel_event == "N"))
+              & (x.d_year == 2000)]
+        g = x.groupby("i_item_id", as_index=False).agg(
+            agg1=("ss_quantity", "mean"), agg2=("ss_list_price", "mean"),
+            agg3=("ss_coupon_amt", "mean"), agg4=("ss_sales_price", "mean"))
+        return g.sort_values("i_item_id").head(100)
+    if name == "ds19":
+        c, ca = f["customer"], f["customer_address"]
+        x = j.merge(c, left_on="ss_customer_sk", right_on="c_customer_sk") \
+             .merge(ca, left_on="c_current_addr_sk",
+                    right_on="ca_address_sk") \
+             .merge(s, left_on="ss_store_sk", right_on="s_store_sk")
+        x = x[(x.d_moy == 11) & (x.d_year == 1999) & (x.i_manager_id == 8)
+              & (x.ca_zip_num != x.s_zip_num)]
+        g = x.groupby(["i_brand_id", "i_brand", "i_manufact_id",
+                       "i_manufact"], as_index=False) \
+             .ss_ext_sales_price.sum() \
+             .rename(columns={"ss_ext_sales_price": "ext_price"})
+        return g.sort_values(["ext_price", "i_brand_id", "i_manufact_id"],
+                             ascending=[False, True, True],
+                             kind="stable").head(100)[
+            ["i_brand_id", "i_brand", "i_manufact_id", "i_manufact",
+             "ext_price"]]
+    if name == "ds33":
+        ws = f["web_sales"]
+        xs = j[(j.i_category == "Electronics") & (j.d_year == 1998)
+               & (j.d_moy == 5)]
+        ssr = xs.groupby("i_manufact_id", as_index=False) \
+                .ss_ext_sales_price.sum() \
+                .rename(columns={"ss_ext_sales_price": "total_sales"})
+        xw = ws.merge(d, left_on="ws_sold_date_sk", right_on="d_date_sk") \
+               .merge(i, left_on="ws_item_sk", right_on="i_item_sk")
+        xw = xw[(xw.i_category == "Electronics") & (xw.d_year == 1998)
+                & (xw.d_moy == 5)]
+        wsr = xw.groupby("i_manufact_id", as_index=False) \
+                .ws_ext_sales_price.sum() \
+                .rename(columns={"ws_ext_sales_price": "total_sales"})
+        u = pd.concat([ssr, wsr], ignore_index=True)
+        g = u.groupby("i_manufact_id", as_index=False).total_sales.sum()
+        return g.sort_values(["total_sales", "i_manufact_id"],
+                             ascending=[False, True],
+                             kind="stable").head(100)
+    if name == "ds59":
+        x = ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        def dow(day):
+            v = x.ss_sales_price.where(x.d_day_name == day)
+            return v
+        x = x.assign(sun=dow("Sunday"), mon=dow("Monday"),
+                     fri=dow("Friday"))
+        wss = x.groupby(["d_week_seq", "ss_store_sk"], as_index=False) \
+               .agg(sun_sales=("sun", "sum"), mon_sales=("mon", "sum"),
+                    fri_sales=("fri", "sum"),
+                    sun_n=("sun", "count"), mon_n=("mon", "count"),
+                    fri_n=("fri", "count"))
+        for col in ("sun", "mon", "fri"):
+            wss[f"{col}_sales"] = wss[f"{col}_sales"] \
+                .where(wss[f"{col}_n"] > 0)
+        y = wss[(wss.d_week_seq >= 20) & (wss.d_week_seq <= 25)]
+        xx = wss.copy()
+        m = y.merge(xx, left_on=["ss_store_sk"], right_on=["ss_store_sk"],
+                    suffixes=("_y", "_x"))
+        m = m[m.d_week_seq_x == m.d_week_seq_y + 52]
+        m = m.merge(s, left_on="ss_store_sk", right_on="s_store_sk")
+        out = pd.DataFrame({
+            "s_store_name": m.s_store_name,
+            "d_week_seq": m.d_week_seq_y,
+            "r1": m.sun_sales_y / m.sun_sales_x,
+            "r2": m.mon_sales_y / m.mon_sales_x,
+            "r3": m.fri_sales_y / m.fri_sales_x})
+        return out.sort_values(["s_store_name", "d_week_seq"],
+                               kind="stable").head(100)
+    if name == "ds65":
+        sc = ss.groupby(["ss_store_sk", "ss_item_sk"], as_index=False) \
+               .ss_sales_price.sum() \
+               .rename(columns={"ss_sales_price": "revenue"})
+        sb = sc.groupby("ss_store_sk", as_index=False).revenue.mean() \
+               .rename(columns={"revenue": "ave"})
+        m = sc.merge(sb, on="ss_store_sk")
+        m = m[m.revenue <= 0.1 * m.ave]
+        m = m.merge(s, left_on="ss_store_sk", right_on="s_store_sk") \
+             .merge(i, left_on="ss_item_sk", right_on="i_item_sk")
+        return m.sort_values(["s_store_name", "i_item_id"],
+                             kind="stable").head(100)[
+            ["s_store_name", "i_item_id", "revenue"]]
+    if name in ("ds88", "ds96"):
+        hd, t = f["household_demographics"], f["time_dim"]
+        x = ss.merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk") \
+              .merge(t, left_on="ss_sold_time_sk", right_on="t_time_sk") \
+              .merge(s, left_on="ss_store_sk", right_on="s_store_sk")
+        if name == "ds96":
+            n = len(x[(x.t_hour == 20) & (x.t_minute >= 30)
+                      & (x.hd_dep_count == 7)
+                      & (x.s_store_name == "store_2")])
+            return pd.DataFrame({"cnt": [n]})
+        base = x[(x.hd_dep_count == 4) & (x.s_store_name == "store_1")]
+        def slot(h, half):
+            mm = base[(base.t_hour == h)
+                      & ((base.t_minute >= 30) if half
+                         else (base.t_minute < 30))]
+            return len(mm)
+        return pd.DataFrame({"h8_30": [slot(8, True)],
+                             "h9_00": [slot(9, False)],
+                             "h9_30": [slot(9, True)],
+                             "h10_00": [slot(10, False)]})
+    if name == "ds98":
+        x = j[j.i_category.isin(["Sports", "Books", "Home"])
+              & (j.d_year == 1999) & (j.d_moy >= 2) & (j.d_moy <= 3)]
+        g = x.groupby(["i_item_id", "i_class", "i_category"],
+                      as_index=False).ss_ext_sales_price.sum() \
+             .rename(columns={"ss_ext_sales_price": "itemrevenue"})
+        g["classrevenue"] = g.groupby("i_class").itemrevenue \
+                             .transform("sum")
+        g["revenueratio"] = g.itemrevenue * 100 / g.classrevenue
+        g = g.sort_values(["i_category", "i_class", "i_item_id",
+                           "itemrevenue", "revenueratio"],
+                          kind="stable").head(100)
+        return g[["i_item_id", "i_class", "i_category", "itemrevenue",
+                  "revenueratio"]]
     if name == "ds73":
         c = f["customer"]
         x = ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")

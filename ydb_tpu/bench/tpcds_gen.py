@@ -29,22 +29,48 @@ def _s(name):
 
 SCHEMAS = {
     "date_dim": (Schema([_i64("d_date_sk"), _i64("d_year"), _i64("d_moy"),
-                         _i64("d_dom"), _s("d_day_name")]), ["d_date_sk"]),
-    "item": (Schema([_i64("i_item_sk"), _i64("i_brand_id"), _s("i_brand"),
+                         _i64("d_dom"), _i64("d_week_seq"),
+                         _s("d_day_name")]), ["d_date_sk"]),
+    "item": (Schema([_i64("i_item_sk"), _s("i_item_id"),
+                     _i64("i_brand_id"), _s("i_brand"),
+                     _i64("i_class_id"), _s("i_class"),
                      _i64("i_category_id"), _s("i_category"),
                      _i64("i_manufact_id"), _s("i_manufact"),
+                     _i64("i_manager_id"),
                      _f64("i_current_price")]), ["i_item_sk"]),
     "store": (Schema([_i64("s_store_sk"), _s("s_store_name"),
-                      _s("s_state")]), ["s_store_sk"]),
-    "customer": (Schema([_i64("c_customer_sk"), _s("c_first_name"),
-                         _s("c_last_name"), _i64("c_birth_year")]),
-                 ["c_customer_sk"]),
+                      _s("s_state"), _i64("s_zip_num")]), ["s_store_sk"]),
+    "customer": (Schema([_i64("c_customer_sk"), _i64("c_current_addr_sk"),
+                         _s("c_first_name"), _s("c_last_name"),
+                         _i64("c_birth_year")]), ["c_customer_sk"]),
+    "customer_address": (Schema([_i64("ca_address_sk"), _s("ca_state"),
+                                 _i64("ca_zip_num")]), ["ca_address_sk"]),
+    "customer_demographics": (Schema([_i64("cd_demo_sk"), _s("cd_gender"),
+                                      _s("cd_marital_status"),
+                                      _s("cd_education_status")]),
+                              ["cd_demo_sk"]),
+    "household_demographics": (Schema([_i64("hd_demo_sk"),
+                                       _i64("hd_dep_count"),
+                                       _i64("hd_vehicle_count")]),
+                               ["hd_demo_sk"]),
+    "time_dim": (Schema([_i64("t_time_sk"), _i64("t_hour"),
+                         _i64("t_minute")]), ["t_time_sk"]),
+    "promotion": (Schema([_i64("p_promo_sk"), _s("p_channel_email"),
+                          _s("p_channel_event")]), ["p_promo_sk"]),
     "store_sales": (Schema([_i64("ss_ticket_sk"), _i64("ss_sold_date_sk"),
+                            _i64("ss_sold_time_sk"),
                             _i64("ss_item_sk"), _i64("ss_customer_sk"),
+                            _i64("ss_cdemo_sk"), _i64("ss_hdemo_sk"),
+                            _i64("ss_promo_sk"),
                             _i64("ss_store_sk"), _i64("ss_quantity"),
-                            _f64("ss_sales_price"),
+                            _f64("ss_sales_price"), _f64("ss_list_price"),
+                            _f64("ss_coupon_amt"),
                             _f64("ss_ext_sales_price"),
                             _f64("ss_net_profit")]), ["ss_ticket_sk"]),
+    "web_sales": (Schema([_i64("ws_order_sk"), _i64("ws_sold_date_sk"),
+                          _i64("ws_item_sk"),
+                          _i64("ws_bill_customer_sk"),
+                          _f64("ws_ext_sales_price")]), ["ws_order_sk"]),
 }
 
 _CATS = np.array(["Books", "Home", "Electronics", "Jewelry", "Sports",
@@ -64,7 +90,7 @@ def gen_tpcds(sf: float = 0.01, seed: int = 20260730) -> dict:
     doy = (d_sk - 1) % 365
     tables["date_dim"] = {
         "d_date_sk": d_sk, "d_year": yr, "d_moy": doy // 31 + 1,
-        "d_dom": doy % 31 + 1,
+        "d_dom": doy % 31 + 1, "d_week_seq": (d_sk - 1) // 7 + 1,
         "d_day_name": _DAYS[d_sk % 7].astype(object)}
 
     n_item = max(200, int(1800 * sf * 10))
@@ -73,13 +99,19 @@ def gen_tpcds(sf: float = 0.01, seed: int = 20260730) -> dict:
                                                                  n_item)
     cat_ix = rng.integers(0, len(_CATS), n_item)
     manu = rng.integers(1, 100, n_item)
+    class_id = rng.integers(1, 17, n_item)
     tables["item"] = {
-        "i_item_sk": i_sk, "i_brand_id": brand_id,
+        "i_item_sk": i_sk,
+        "i_item_id": np.array([f"AAAA{k:012d}" for k in i_sk], object),
+        "i_brand_id": brand_id,
         "i_brand": np.array([f"brand#{b}" for b in brand_id], object),
+        "i_class_id": class_id,
+        "i_class": np.array([f"class#{c}" for c in class_id], object),
         "i_category_id": cat_ix + 1,
         "i_category": _CATS[cat_ix].astype(object),
         "i_manufact_id": manu,
         "i_manufact": np.array([f"manu#{m}" for m in manu], object),
+        "i_manager_id": rng.integers(1, 100, n_item),
         "i_current_price": (rng.random(n_item) * 100).round(2)}
 
     n_store = 12
@@ -88,28 +120,85 @@ def gen_tpcds(sf: float = 0.01, seed: int = 20260730) -> dict:
         "s_store_name": np.array([f"store_{i}" for i in range(n_store)],
                                  object),
         "s_state": _STATES[rng.integers(0, len(_STATES), n_store)]
-        .astype(object)}
+        .astype(object),
+        "s_zip_num": rng.integers(10000, 10040, n_store)}
+
+    n_addr = max(300, int(50_000 * sf))
+    tables["customer_address"] = {
+        "ca_address_sk": np.arange(1, n_addr + 1),
+        "ca_state": _STATES[rng.integers(0, len(_STATES), n_addr)]
+        .astype(object),
+        "ca_zip_num": rng.integers(10000, 10040, n_addr)}
 
     n_cust = max(500, int(100_000 * sf))
     tables["customer"] = {
         "c_customer_sk": np.arange(1, n_cust + 1),
+        "c_current_addr_sk": rng.integers(1, n_addr + 1, n_cust),
         "c_first_name": np.array([f"fn{i % 997}" for i in range(n_cust)],
                                  object),
         "c_last_name": np.array([f"ln{i % 499}" for i in range(n_cust)],
                                 object),
         "c_birth_year": rng.integers(1930, 2005, n_cust)}
 
+    # cross-joined demographic/time/promotion dimensions (TPC-DS keeps
+    # these small and dense)
+    n_cdemo = 7 * 6 * 4
+    genders = np.array(["M", "F"])
+    marital = np.array(["S", "M", "D", "W", "U"])
+    edu = np.array(["Primary", "Secondary", "College", "2 yr Degree",
+                    "4 yr Degree", "Advanced Degree", "Unknown"])
+    cd_sk = np.arange(1, n_cdemo + 1)
+    tables["customer_demographics"] = {
+        "cd_demo_sk": cd_sk,
+        "cd_gender": genders[cd_sk % 2].astype(object),
+        "cd_marital_status": marital[cd_sk % 5].astype(object),
+        "cd_education_status": edu[cd_sk % 7].astype(object)}
+
+    n_hdemo = 40
+    hd_sk = np.arange(1, n_hdemo + 1)
+    tables["household_demographics"] = {
+        "hd_demo_sk": hd_sk, "hd_dep_count": hd_sk % 10,
+        "hd_vehicle_count": hd_sk % 5}
+
+    n_time = 24 * 60
+    t_sk = np.arange(1, n_time + 1)
+    tables["time_dim"] = {
+        "t_time_sk": t_sk, "t_hour": (t_sk - 1) // 60,
+        "t_minute": (t_sk - 1) % 60}
+
+    n_promo = 30
+    p_sk = np.arange(1, n_promo + 1)
+    yn = np.array(["Y", "N"])
+    tables["promotion"] = {
+        "p_promo_sk": p_sk,
+        "p_channel_email": yn[p_sk % 2].astype(object),
+        "p_channel_event": yn[(p_sk // 2) % 2].astype(object)}
+
     n_ss = max(2000, int(2_880_000 * sf))
     tables["store_sales"] = {
         "ss_ticket_sk": np.arange(1, n_ss + 1),
         "ss_sold_date_sk": rng.integers(1, n_dates + 1, n_ss),
+        "ss_sold_time_sk": rng.integers(1, n_time + 1, n_ss),
         "ss_item_sk": rng.integers(1, n_item + 1, n_ss),
         "ss_customer_sk": rng.integers(1, n_cust + 1, n_ss),
+        "ss_cdemo_sk": rng.integers(1, n_cdemo + 1, n_ss),
+        "ss_hdemo_sk": rng.integers(1, n_hdemo + 1, n_ss),
+        "ss_promo_sk": rng.integers(1, n_promo + 1, n_ss),
         "ss_store_sk": rng.integers(1, n_store + 1, n_ss),
         "ss_quantity": rng.integers(1, 100, n_ss),
         "ss_sales_price": (rng.random(n_ss) * 200).round(2),
+        "ss_list_price": (rng.random(n_ss) * 250).round(2),
+        "ss_coupon_amt": (rng.random(n_ss) * 50).round(2),
         "ss_ext_sales_price": (rng.random(n_ss) * 2000).round(2),
         "ss_net_profit": ((rng.random(n_ss) - 0.3) * 1000).round(2)}
+
+    n_ws = max(800, int(720_000 * sf))
+    tables["web_sales"] = {
+        "ws_order_sk": np.arange(1, n_ws + 1),
+        "ws_sold_date_sk": rng.integers(1, n_dates + 1, n_ws),
+        "ws_item_sk": rng.integers(1, n_item + 1, n_ws),
+        "ws_bill_customer_sk": rng.integers(1, n_cust + 1, n_ws),
+        "ws_ext_sales_price": (rng.random(n_ws) * 2000).round(2)}
     return tables
 
 

@@ -374,7 +374,16 @@ class ExprBinder:
             return ir.Col(self.scope.resolve(e.parts).internal)
 
         if isinstance(e, ast.BoundParam):
-            return ir.Param(e.name, e.dtype)
+            p = ir.Param(e.name, e.dtype)
+            if e.dtype.nullable:
+                # scalar-subquery params can be NULL: the executor supplies
+                # a `<name>__valid` companion and a typed zero placeholder,
+                # so NULL propagates through ANY dtype (not just the old
+                # NaN-coercion trick that only worked for float compares)
+                valid = ir.Param(e.name + "__valid",
+                                 dt.DType(dt.Kind.BOOL, False))
+                return ir.call("if", valid, p, ir.call("typed_null", p))
+            return p
 
         # string-VALUED expression (substring/concat of a dict column) used
         # as a value (group key / output): map source codes to a fresh

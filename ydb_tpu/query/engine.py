@@ -137,7 +137,7 @@ class QueryEngine:
                     self._finish_stats(stats, t, block)
                     return block
                 if stmt.relation is None:
-                    block = self._select_without_from(stmt)
+                    block = self._select_without_from(stmt, snap)
                     self.executor.last_path = "literal"
                     self._finish_stats(stats, t, block)
                     return block
@@ -183,15 +183,57 @@ class QueryEngine:
         except (BindError, PlanError) as e:
             raise QueryError(str(e)) from e
 
-    def _select_without_from(self, sel: ast.Select) -> HostBlock:
+    def _select_without_from(self, sel: ast.Select,
+                             snap: Optional[Snapshot] = None) -> HostBlock:
         """Constant SELECT (`select 1 + 1 as x`): fold each item host-side
-        — one row, no scan (the literal-executer analog)."""
+        — one row, no scan (the literal-executer analog). Scalar
+        subqueries evaluate first (the q88 report shape: a row of
+        independent counts)."""
         from ydb_tpu.core import dtypes as dt
         from ydb_tpu.core.dictionary import Dictionary
         from ydb_tpu.query.binder import _try_fold
+
+        def eval_subs(e):
+            import dataclasses
+            if isinstance(e, ast.ScalarSubquery):
+                blk = self._run_select(e.query, snap)
+                if len(blk.schema.names) != 1:
+                    raise QueryError("scalar subquery must select one "
+                                     "column")
+                if blk.length > 1:
+                    raise QueryError("scalar subquery returned "
+                                     f"{blk.length} rows")
+                if blk.length == 0:
+                    return ast.Literal(None)     # SQL: empty → NULL
+                v = blk.to_pandas().iloc[0, 0]
+                if v is None or (isinstance(v, float) and np.isnan(v)):
+                    return ast.Literal(None)
+                if hasattr(v, "item"):
+                    v = v.item()   # numpy scalar → python
+                return ast.Literal(v)
+            if not hasattr(e, "__dataclass_fields__"):
+                return e
+
+            def rw(v):
+                if isinstance(v, tuple):
+                    return tuple(rw(x) for x in v)
+                if hasattr(v, "__dataclass_fields__"):
+                    return eval_subs(v)
+                return v
+            return dataclasses.replace(
+                e, **{fld: rw(getattr(e, fld))
+                      for fld in e.__dataclass_fields__})
+
         cols, arrays, valids, dicts = [], {}, {}, {}
         for i, item in enumerate(sel.items):
-            folded = _try_fold(item.expr)
+            expr2 = eval_subs(item.expr)
+            if isinstance(expr2, ast.Literal) and expr2.value is None:
+                name = item.alias or f"column{i}"
+                cols.append(Column(name, dt.DType(dt.Kind.INT64, True)))
+                arrays[name] = np.zeros(1, np.int64)
+                valids[name] = np.zeros(1, bool)
+                continue
+            folded = _try_fold(expr2)
             if folded is None:
                 raise QueryError(
                     "SELECT without FROM supports constant expressions only")
@@ -579,8 +621,12 @@ class QueryEngine:
         snap = snap or self.snapshot()
         tname = f"__tmp{self._tmp_n}"
         self._tmp_n += 1
+        # temps inherit the engine's block size: the default (1<<20) would
+        # jit-compile every downstream program at 1M-row capacity even for
+        # tiny CTE results
         t = self.catalog.create_table(tname, block.schema,
                                       [block.schema.names[0]], shards=1,
+                                      portion_rows=self.executor.block_rows,
                                       transient=True)
         t.dictionaries = {n: cd.dictionary
                           for n, cd in block.columns.items()

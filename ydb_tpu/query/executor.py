@@ -70,12 +70,16 @@ class Executor:
             sub = self.execute(subplan, snapshot)
             if sub.length > 1:
                 raise RuntimeError("scalar subquery produced more than one row")
-            if sub.length == 0 or (
-                    sub.columns[sub.schema.names[0]].valid is not None
-                    and not sub.columns[sub.schema.names[0]].valid[0]):
-                params[pname] = np.nan   # NULL scalar: comparisons are false
+            col = sub.columns[sub.schema.names[0]]
+            if sub.length == 0 or (col.valid is not None
+                                   and not col.valid[0]):
+                # NULL scalar: typed zero placeholder + validity companion
+                # (the binder wraps nullable params in if(valid, v, null))
+                params[pname] = np.zeros((), col.data.dtype)[()]
+                params[pname + "__valid"] = False
             else:
-                params[pname] = sub.columns[sub.schema.names[0]].data[0]
+                params[pname] = col.data[0]
+                params[pname + "__valid"] = True
 
         if self.mesh is not None and self.mesh.devices.size > 1:
             if self._can_distribute(plan):

@@ -255,10 +255,20 @@ class Planner:
             if q is not None:
                 kept.append(q)
         preds = kept
-        if sel.having is not None:
+        if sel.having is not None or any(
+                not isinstance(it.expr, ast.Star) and
+                self._has_scalar_sub(it.expr) for it in sel.items):
             sel = ast.Select(**{**sel.__dict__})
-            sel.having = self._rewrite_scalar_subqueries(
-                sel.having, rels, allow_correlated=False)
+            if sel.having is not None:
+                sel.having = self._rewrite_scalar_subqueries(
+                    sel.having, rels, allow_correlated=False)
+            # scalar subqueries in the SELECT list precompute to params
+            # (the KqpPhysicalTx TxResultBinding shape: q88-style reports)
+            sel.items = [
+                it if isinstance(it.expr, ast.Star) else ast.SelectItem(
+                    self._rewrite_scalar_subqueries(
+                        it.expr, rels, allow_correlated=False), it.alias)
+                for it in sel.items]
         edges: list = []           # (alias_a, col_a, alias_b, col_b)
         residuals: list = []
         for p in preds:
@@ -741,6 +751,24 @@ class Planner:
             return None
         return rewritten
 
+    def _has_scalar_sub(self, e) -> bool:
+        """Generic dataclass-field walk (matches the shapes the rewriter's
+        walk at `_rewrite_scalars` can reach). Exists/InSubquery bodies
+        are handled by the semi-join machinery, not the scalar rewrite —
+        don't descend into them."""
+        if isinstance(e, ast.ScalarSubquery):
+            return True
+        if isinstance(e, (ast.Exists, ast.InSubquery)) \
+                or not hasattr(e, "__dataclass_fields__"):
+            return False
+
+        def any_sub(v) -> bool:
+            if isinstance(v, tuple):
+                return any(any_sub(x) for x in v)
+            return hasattr(v, "__dataclass_fields__") \
+                and self._has_scalar_sub(v)
+        return any(any_sub(getattr(e, f)) for f in e.__dataclass_fields__)
+
     def _rewrite_scalar_subqueries(self, p, rels, allow_correlated):
         rewritten, correlated = self._rewrite_scalars(
             p, allow_correlated=allow_correlated)
@@ -800,6 +828,14 @@ class Planner:
             if isinstance(e, ast.Between):
                 return ast.Between(walk(e.arg), walk(e.lo), walk(e.hi),
                                    e.negated)
+            if isinstance(e, ast.InList):
+                return ast.InList(walk(e.arg),
+                                  tuple(walk(x) for x in e.items),
+                                  e.negated)
+            if isinstance(e, ast.IsNull):
+                return ast.IsNull(walk(e.arg), e.negated)
+            if isinstance(e, ast.Like):
+                return ast.Like(walk(e.arg), e.pattern, e.negated)
             if isinstance(e, ast.FuncCall):
                 return ast.FuncCall(e.name, tuple(walk(a) for a in e.args),
                                     e.distinct, e.star)
@@ -1088,14 +1124,19 @@ class Planner:
         # alias map for GROUP BY / ORDER BY references to select aliases
         alias_map = {item.alias: item.expr for item in sel.items if item.alias}
 
-        def deref(e, positional=False):
+        def deref(e, positional=False, prefer_alias=False):
             """Select-alias substitution; `positional` additionally resolves
             bare integers as 1-based select positions (ORDER BY 1 / GROUP
             BY 1) and must only be used at the top level of those clauses —
-            never recursively, or nested literals would be rewritten."""
+            never recursively, or nested literals would be rewritten.
+            `prefer_alias` (ORDER BY): a select alias shadows a source
+            column of the same name (PostgreSQL rule: `sum(x) as x ...
+            order by x` sorts the aggregate); GROUP BY keeps the source
+            column."""
             if isinstance(e, ast.Name) and len(e.parts) == 1 \
                     and e.parts[0] in alias_map \
-                    and self.scope.try_resolve(e.parts) is None:
+                    and (prefer_alias
+                         or self.scope.try_resolve(e.parts) is None):
                 return alias_map[e.parts[0]]
             if positional and isinstance(e, ast.Literal) \
                     and isinstance(e.value, int) and e.type_hint is None \
@@ -1474,7 +1515,8 @@ class Planner:
         sort_keys: list = []
         extra: list = []
         for j, o in enumerate(sel.order_by):
-            e = bind_fn(alias_deref(o.expr, positional=True))
+            e = bind_fn(alias_deref(o.expr, positional=True,
+                                    prefer_alias=True))
             if isinstance(e, ir.Col):
                 name = e.name
                 extra.append(name)     # keep through the output projection

@@ -1,0 +1,80 @@
+"""Native runtime loader.
+
+Builds `blobio.cpp` into a shared library with the system toolchain on
+first import (cached by source mtime) and exposes it through ctypes. The
+reference's storage runtime is native C++ (PDisk/LocalDB); here the
+native layer owns the blob/WAL IO floor while JAX/XLA owns the compute
+plane. Everything degrades gracefully: if no compiler is present (or
+``YDB_TPU_NATIVE=0``), callers fall back to the byte-identical numpy
+implementation in `ydb_tpu/storage/blobfile.py`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "blobio.cpp")
+_SO = os.path.join(_DIR, f"_blobio_py{sys.version_info[0]}{sys.version_info[1]}.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        if os.path.exists(_SO) and \
+                os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return True
+        tmp = f"{_SO}.{os.getpid()}.tmp.so"   # per-pid: concurrent builds
+        subprocess.run(                        # must not interleave writes
+            ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp,
+             _SRC],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except Exception:
+        return False
+
+
+def lib():
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("YDB_TPU_NATIVE", "1") == "0":
+        return None
+    if not _build():
+        return None
+    try:
+        L = ctypes.CDLL(_SO)
+        L.ydbt_abi_version.restype = ctypes.c_int
+        if L.ydbt_abi_version() != 2:
+            return None
+        L.ydbt_crc32.restype = ctypes.c_uint32
+        L.ydbt_crc32.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        L.ydbt_write_portion.restype = ctypes.c_int
+        L.ydbt_write_portion.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint64)]
+        L.ydbt_wal_append.restype = ctypes.c_int
+        L.ydbt_wal_append.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_int32]
+        L.ydbt_wal_scan.restype = ctypes.c_int64
+        L.ydbt_wal_scan.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.POINTER(ctypes.c_uint64),
+                                    ctypes.POINTER(ctypes.c_int32)]
+        _lib = L
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None

@@ -1,0 +1,217 @@
+// Native storage engine: CRC-framed blob + WAL primitives.
+//
+// The reference's persistence stack is native C++ end to end — PDisk owns
+// raw chunks with checksummed log framing (ydb/core/blobstorage/pdisk/
+// blobstorage_pdisk_impl.h:46), and LocalDB replays a redo log at boot
+// (ydb/core/tablet_flat/flat_boot_*.h). This library is the TPU build's
+// equivalent runtime floor: portion blobs and the write-ahead log go
+// through these routines when the toolchain is present; a byte-identical
+// pure-numpy fallback lives in ydb_tpu/storage/blobfile.py.
+//
+// Format invariants shared with the Python fallback:
+//   * CRC-32 (zlib polynomial 0xEDB88320) — matches python zlib.crc32, so
+//     files written by either implementation verify under the other.
+//   * Portion files:  "YDBP" | u32 version | u32 header_len | u32 header_crc
+//                     | header JSON | zero-pad to 64 | sections (64-aligned)
+//   * WAL records:    u32 payload_len | u32 payload_crc | payload
+//     (replay stops at the first short/corrupt frame = torn tail).
+//
+// Durability: section writes go through one buffered file, fsync before
+// the atomic rename (portions); WAL appends are O_APPEND + fdatasync.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <string>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// ---- CRC-32 (zlib polynomial), slice-by-8 ----------------------------
+
+uint32_t crc_tab[8][256];
+bool crc_init_done = false;
+
+void crc_init() {
+    if (crc_init_done) return;
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        crc_tab[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+        for (int t = 1; t < 8; t++)
+            crc_tab[t][i] =
+                (crc_tab[t - 1][i] >> 8) ^ crc_tab[0][crc_tab[t - 1][i] & 0xff];
+    crc_init_done = true;
+}
+
+uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t n) {
+    crc_init();
+    crc = ~crc;
+    while (n && (reinterpret_cast<uintptr_t>(p) & 7)) {
+        crc = crc_tab[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+        n--;
+    }
+    while (n >= 8) {
+        uint64_t w;
+        memcpy(&w, p, 8);
+        w ^= crc;                       // little-endian assumption (x86/ARM)
+        crc = crc_tab[7][w & 0xff] ^ crc_tab[6][(w >> 8) & 0xff] ^
+              crc_tab[5][(w >> 16) & 0xff] ^ crc_tab[4][(w >> 24) & 0xff] ^
+              crc_tab[3][(w >> 32) & 0xff] ^ crc_tab[2][(w >> 40) & 0xff] ^
+              crc_tab[1][(w >> 48) & 0xff] ^ crc_tab[0][(w >> 56) & 0xff];
+        p += 8;
+        n -= 8;
+    }
+    while (n--) crc = crc_tab[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+    return ~crc;
+}
+
+bool write_all(int fd, const uint8_t* p, size_t n) {
+    while (n) {
+        ssize_t w = ::write(fd, p, n);
+        if (w < 0) {
+            if (errno == EINTR) continue;
+            return false;
+        }
+        p += w;
+        n -= static_cast<size_t>(w);
+    }
+    return true;
+}
+
+const uint8_t ZEROS[64] = {0};
+
+}  // namespace
+
+extern "C" {
+
+// Library self-description (the loader asserts the ABI version).
+int ydbt_abi_version() { return 2; }
+
+uint32_t ydbt_crc32(const uint8_t* data, uint64_t len) {
+    return crc32_update(0, data, len);
+}
+
+// Write a portion blob atomically: header (already JSON-encoded by the
+// caller, CRC'd here) + `nsec` sections, each zero-padded to a 64-byte
+// boundary. tmp-file + fsync + rename, then fsync the directory so the
+// rename itself is durable.
+int ydbt_write_portion(const char* path, const uint8_t* header,
+                       uint64_t header_len, int32_t nsec,
+                       const uint8_t** sec_ptrs, const uint64_t* sec_lens) {
+    std::string tmp = std::string(path) + ".tmp";
+    int fd = ::open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) return -errno;
+
+    uint8_t head[16];
+    memcpy(head, "YDBP", 4);
+    uint32_t version = 1;
+    uint32_t hlen = static_cast<uint32_t>(header_len);
+    uint32_t hcrc = crc32_update(0, header, header_len);
+    memcpy(head + 4, &version, 4);
+    memcpy(head + 8, &hlen, 4);
+    memcpy(head + 12, &hcrc, 4);
+
+    bool ok = write_all(fd, head, 16) && write_all(fd, header, header_len);
+    uint64_t off = 16 + header_len;
+    if (ok && off % 64) {
+        ok = write_all(fd, ZEROS, 64 - off % 64);
+        off += 64 - off % 64;
+    }
+    for (int32_t i = 0; ok && i < nsec; i++) {
+        ok = write_all(fd, sec_ptrs[i], sec_lens[i]);
+        off += sec_lens[i];
+        if (ok && off % 64) {
+            ok = write_all(fd, ZEROS, 64 - off % 64);
+            off += 64 - off % 64;
+        }
+    }
+    if (ok) ok = ::fsync(fd) == 0;
+    int saved = errno;
+    ::close(fd);
+    if (!ok) {
+        ::unlink(tmp.c_str());
+        return saved ? -saved : -EIO;
+    }
+    if (::rename(tmp.c_str(), path) != 0) {
+        saved = errno;
+        ::unlink(tmp.c_str());
+        return -saved;
+    }
+    // make the rename durable: fsync the parent directory
+    std::string dir(path);
+    size_t slash = dir.rfind('/');
+    dir = slash == std::string::npos ? "." : dir.substr(0, slash);
+    int dfd = ::open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+    if (dfd >= 0) {
+        ::fsync(dfd);
+        ::close(dfd);
+    }
+    return 0;
+}
+
+// Append one CRC-framed record to the WAL and fdatasync it.
+int ydbt_wal_append(const char* path, const uint8_t* payload, uint64_t len,
+                    int32_t do_sync) {
+    int fd = ::open(path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+    if (fd < 0) return -errno;
+    uint32_t n = static_cast<uint32_t>(len);
+    uint32_t crc = crc32_update(0, payload, len);
+    std::vector<uint8_t> frame(8 + len);
+    memcpy(frame.data(), &n, 4);
+    memcpy(frame.data() + 4, &crc, 4);
+    memcpy(frame.data() + 8, payload, len);
+    bool ok = write_all(fd, frame.data(), frame.size());
+    if (ok && do_sync) ok = ::fdatasync(fd) == 0;
+    int saved = errno;
+    ::close(fd);
+    return ok ? 0 : (saved ? -saved : -EIO);
+}
+
+// Scan an already-read WAL buffer, validating frames in order.
+// Returns the number of valid records; fills out_valid_bytes with the
+// byte length of the valid prefix and out_status with how the scan ended:
+//   0 = clean EOF
+//   1 = torn tail (an incomplete last frame — the expected crash shape;
+//       replay drops it silently, the PDisk log-tail rule)
+//   2 = corruption (a COMPLETE frame whose CRC fails, or an implausible
+//       length with its bytes present — acked records may follow, so the
+//       caller must fail loudly instead of silently truncating history)
+int64_t ydbt_wal_scan(const uint8_t* buf, uint64_t len,
+                      uint64_t* out_valid_bytes, int32_t* out_status) {
+    int64_t count = 0;
+    uint64_t off = 0;
+    *out_status = 0;
+    for (;;) {
+        if (off == len) break;                 // clean end
+        if (off + 8 > len) { *out_status = 1; break; }
+        uint32_t n, crc;
+        memcpy(&n, buf + off, 4);
+        memcpy(&crc, buf + off + 4, 4);
+        if (off + 8 + n > len) {
+            // payload extends past EOF: torn tail unless the length is
+            // absurd AND most of the file remains (scrambled header)
+            *out_status = (n > (1u << 30) && len - off > (1u << 20)) ? 2 : 1;
+            break;
+        }
+        if (n > (1u << 30) ||
+            crc32_update(0, buf + off + 8, n) != crc) {
+            *out_status = 2;                   // complete frame, bad bytes
+            break;
+        }
+        off += 8 + n;
+        count++;
+    }
+    *out_valid_bytes = off;
+    return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,252 @@
+"""Portion blob + WAL file formats (native C++ fast path, numpy fallback).
+
+ONE on-disk format, two implementations. The native library
+(`ydb_tpu/native/blobio.cpp`) owns the IO when a toolchain is present —
+CRC-32 framing, fsync discipline, atomic renames — mirroring how the
+reference's persistence floor is native (PDisk chunk/log framing,
+`ydb/core/blobstorage/pdisk/`). The fallback here produces byte-identical
+files with numpy + zlib.crc32 (same polynomial), so either side can read
+the other's output; `tests/test_native_blobio.py` pins that equivalence.
+
+Portion file (.ydbp):
+    "YDBP" | u32 version=1 | u32 header_len | u32 header_crc
+    | header JSON | zero-pad to 64 | per-column sections (64-aligned):
+    data bytes, then validity bytes (u8/row) for nullable columns.
+Header JSON: {"rows": N, "cols": [{"name", "dtype" (numpy str),
+    "off", "len", "crc", ["voff", "vlen", "vcrc"]} ...]}
+
+WAL file (wal.bin): records framed as u32 len | u32 crc | payload
+(payload = UTF-8 JSON, opaque to the framing layer). Replay stops at the
+first torn/corrupt frame — the PDisk log-tail rule.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import zlib
+
+import numpy as np
+
+from ydb_tpu.core.block import ColumnData, HostBlock
+from ydb_tpu.core.schema import Schema
+from ydb_tpu.native import lib as _native_lib
+
+_ALIGN = 64
+
+
+def _pad(off: int) -> int:
+    return (off + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+def _layout(block: HostBlock):
+    """Header dict + ordered section arrays (shared by both writers)."""
+    cols = []
+    sections = []
+    off = 0  # relative to section base (end of padded header)
+    for name, cd in block.columns.items():
+        data = np.ascontiguousarray(cd.data)
+        ent = {"name": name, "dtype": data.dtype.str,
+               "off": off, "len": int(data.nbytes), "crc": None}
+        sections.append(data)
+        off = _pad(off + data.nbytes)
+        if cd.valid is not None:
+            v = np.ascontiguousarray(cd.valid.astype(np.uint8))
+            ent["voff"], ent["vlen"] = off, int(v.nbytes)
+            sections.append(v)
+            off = _pad(off + v.nbytes)
+        cols.append(ent)
+    # CRCs in one pass (native when possible)
+    si = 0
+    for ent in cols:
+        ent["crc"] = _crc(sections[si]); si += 1
+        if "voff" in ent:
+            ent["vcrc"] = _crc(sections[si]); si += 1
+    header = {"rows": block.length, "cols": cols}
+    return header, sections
+
+
+def _crc(arr: np.ndarray) -> int:
+    L = _native_lib()
+    buf = arr.tobytes() if not arr.flags["C_CONTIGUOUS"] else arr
+    if L is not None:
+        p = buf if isinstance(buf, bytes) else buf.ctypes.data_as(
+            ctypes.c_char_p)
+        n = len(buf) if isinstance(buf, bytes) else buf.nbytes
+        return int(L.ydbt_crc32(p, n))
+    return zlib.crc32(buf if isinstance(buf, bytes) else buf.tobytes())
+
+
+def write_portion(path: str, block: HostBlock) -> None:
+    header, sections = _layout(block)
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    L = _native_lib()
+    if L is not None:
+        ptrs = (ctypes.c_void_p * len(sections))(
+            *[s.ctypes.data_as(ctypes.c_void_p).value for s in sections])
+        lens = (ctypes.c_uint64 * len(sections))(
+            *[s.nbytes for s in sections])
+        rc = L.ydbt_write_portion(path.encode(), hjson, len(hjson),
+                                  len(sections), ptrs, lens)
+        if rc != 0:
+            raise OSError(-rc, f"native portion write failed: {path}")
+        return
+    # numpy fallback — byte-identical layout AND durability discipline
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        head = b"YDBP" + np.uint32(1).tobytes() \
+            + np.uint32(len(hjson)).tobytes() \
+            + np.uint32(zlib.crc32(hjson)).tobytes()
+        f.write(head)
+        f.write(hjson)
+        off = 16 + len(hjson)
+        if off % _ALIGN:
+            f.write(b"\0" * (_ALIGN - off % _ALIGN))
+        for s in sections:
+            f.write(s.tobytes())
+            n = s.nbytes
+            if n % _ALIGN:
+                f.write(b"\0" * (_ALIGN - n % _ALIGN))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path) or ".")
+
+
+def _fsync_dir(dirpath: str) -> None:
+    """Make a rename durable (the native writer does the same)."""
+    try:
+        fd = os.open(dirpath, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def read_portion(path: str, schema: Schema, dicts: dict) -> HostBlock:
+    """Read + CRC-verify a portion (single file read; CRC runs native
+    when the library is loaded)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:4] != b"YDBP":
+        raise ValueError(f"{path}: bad magic")
+    hlen = int(np.frombuffer(raw, np.uint32, 1, 8)[0])
+    hcrc = int(np.frombuffer(raw, np.uint32, 1, 12)[0])
+    hjson = raw[16:16 + hlen]
+    if zlib.crc32(hjson) != hcrc:
+        raise ValueError(f"{path}: header checksum mismatch")
+    header = json.loads(hjson)
+    base = _pad(16 + hlen)
+    by_name = {}
+    for ent in header["cols"]:
+        d0 = base + ent["off"]
+        data = np.frombuffer(raw, np.dtype(ent["dtype"]),
+                             count=ent["len"] // np.dtype(ent["dtype"]).itemsize,
+                             offset=d0)
+        if _crc(data) != ent["crc"]:
+            raise ValueError(f"{path}: column {ent['name']} corrupt")
+        valid = None
+        if "voff" in ent:
+            v = np.frombuffer(raw, np.uint8, count=ent["vlen"],
+                              offset=base + ent["voff"])
+            if _crc(v) != ent["vcrc"]:
+                raise ValueError(
+                    f"{path}: column {ent['name']} validity corrupt")
+            valid = v.astype(bool)
+        by_name[ent["name"]] = (data, valid)
+    cols = {}
+    for c in schema:
+        data, valid = by_name[c.name]
+        cols[c.name] = ColumnData(np.array(data), valid,
+                                  dicts.get(c.name))
+    return HostBlock(schema, cols, header["rows"])
+
+
+# -- WAL -------------------------------------------------------------------
+
+
+def wal_append(path: str, rec: dict, sync: bool = True) -> None:
+    payload = json.dumps(rec, separators=(",", ":")).encode()
+    L = _native_lib()
+    if L is not None:
+        rc = L.ydbt_wal_append(path.encode(), payload, len(payload),
+                               1 if sync else 0)
+        if rc != 0:
+            raise OSError(-rc, f"native wal append failed: {path}")
+        return
+    frame = np.uint32(len(payload)).tobytes() \
+        + np.uint32(zlib.crc32(payload)).tobytes() + payload
+    with open(path, "ab") as f:
+        f.write(frame)
+        f.flush()
+        if sync:
+            os.fsync(f.fileno())
+
+
+def wal_replay(path: str) -> list:
+    """Valid records up to a torn tail (an incomplete LAST frame — the
+    expected crash shape, dropped silently). A complete frame with a bad
+    CRC means real corruption with possibly-acked records behind it:
+    that fails loudly instead of silently truncating history."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "rb") as f:
+        raw = f.read()
+    L = _native_lib()
+    if L is not None:
+        good = ctypes.c_uint64()
+        status = ctypes.c_int32()
+        L.ydbt_wal_scan(raw, len(raw), ctypes.byref(good),
+                        ctypes.byref(status))
+        valid, st = good.value, status.value
+    else:
+        valid, st = _scan_frames(raw)
+    if st == 2:
+        raise ValueError(
+            f"{path}: WAL corrupt at byte {valid} (complete frame with "
+            "bad checksum) — refusing to silently drop records after it")
+    recs = []
+    off = 0
+    while off < valid:
+        ln = int(np.frombuffer(raw, np.uint32, 1, off)[0])
+        recs.append(json.loads(raw[off + 8:off + 8 + ln]))
+        off += 8 + ln
+    return recs
+
+
+def _scan_frames(raw: bytes):
+    """(valid_prefix_bytes, status) — mirror of the native ydbt_wal_scan:
+    status 0 = clean, 1 = torn tail, 2 = mid-log corruption."""
+    off = 0
+    n = len(raw)
+    while True:
+        if off == n:
+            return off, 0
+        if off + 8 > n:
+            return off, 1
+        ln = int(np.frombuffer(raw, np.uint32, 1, off)[0])
+        crc = int(np.frombuffer(raw, np.uint32, 1, off + 4)[0])
+        if off + 8 + ln > n:
+            return off, 2 if (ln > (1 << 30)
+                              and n - off > (1 << 20)) else 1
+        if ln > (1 << 30) or zlib.crc32(raw[off + 8:off + 8 + ln]) != crc:
+            return off, 2
+        off += 8 + ln
+
+
+def wal_rewrite(path: str, recs: list) -> None:
+    """Atomically replace the WAL contents (post-indexation truncate)."""
+    tmp = path + ".tmp"
+    if os.path.exists(tmp):
+        os.unlink(tmp)
+    for r in recs:
+        wal_append(tmp, r, sync=False)
+    if not os.path.exists(tmp):
+        open(tmp, "wb").close()
+    with open(tmp, "rb+") as f:
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

@@ -13,9 +13,9 @@ erasure/replication layer is a separate concern):
       <table>/
         dicts.json                   per-column string dictionaries
         shard_<i>/
-          wal.jsonl                  insert log: write / commit records
-          wal_<wid>.npz              staged insert block (columnar)
-          portion_<id>.npz           immutable indexed portion
+          wal.bin                    insert log: CRC-framed write/commit
+          wal_<wid>.ydbp             staged insert block (columnar)
+          portion_<id>.ydbp          immutable indexed portion
           manifest.json              live portions + wal high-water mark
 
 Crash consistency: json files go through write-tmp + atomic rename; the
@@ -35,9 +35,7 @@ import json
 import os
 from typing import Optional
 
-import numpy as np
-
-from ydb_tpu.core.block import ColumnData, HostBlock
+from ydb_tpu.core.block import HostBlock
 from ydb_tpu.core.dictionary import Dictionary
 from ydb_tpu.core.dtypes import DType, Kind
 from ydb_tpu.core.schema import Column, Schema
@@ -60,27 +58,10 @@ def _read_json(path: str, default=None):
         return json.load(f)
 
 
-def _save_block_npz(path: str, block: HostBlock) -> None:
-    arrays = {}
-    for name, cd in block.columns.items():
-        arrays[f"d_{name}"] = cd.data
-        if cd.valid is not None:
-            arrays[f"v_{name}"] = cd.valid
-    tmp = path + ".tmp.npz"
-    np.savez(tmp, **arrays)
-    os.replace(tmp, path)
-
-
-def _load_block_npz(path: str, schema: Schema, dicts: dict) -> HostBlock:
-    with np.load(path) as z:
-        cols = {}
-        length = 0
-        for c in schema:
-            d = z[f"d_{c.name}"]
-            v = z[f"v_{c.name}"] if f"v_{c.name}" in z.files else None
-            cols[c.name] = ColumnData(d, v, dicts.get(c.name))
-            length = len(d)
-    return HostBlock(schema, cols, length)
+# blob + WAL IO: CRC-framed single format, native C++ fast path with a
+# byte-identical numpy fallback (ydb_tpu/storage/blobfile.py,
+# ydb_tpu/native/blobio.cpp)
+from ydb_tpu.storage import blobfile as B
 
 
 class Store:
@@ -155,15 +136,12 @@ class Store:
         rec = {"plan_step": version.plan_step, "tx_id": version.tx_id,
                "ops": [[kind, {c: native(v) for c, v in vals.items()}]
                        for (kind, vals) in ops]}
-        with open(os.path.join(self._tdir(table), "rowwal.jsonl"), "a") as f:
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        B.wal_append(os.path.join(self._tdir(table), "rowwal.bin"), rec)
 
     def wal_write(self, table: str, shard: int, wid: int,
                   block: HostBlock, tx=None) -> None:
         sdir = self._sdir(table, shard)
-        _save_block_npz(os.path.join(sdir, f"wal_{wid}.npz"), block)
+        B.write_portion(os.path.join(sdir, f"wal_{wid}.ydbp"), block)
         rec = {"op": "write", "wid": wid}
         if tx is not None:
             rec["tx"] = tx     # boot discards writes of txs that died open
@@ -181,10 +159,7 @@ class Store:
                          {"op": "abort", "wids": wids})
 
     def _wal_append(self, sdir: str, rec: dict) -> None:
-        with open(os.path.join(sdir, "wal.jsonl"), "a") as f:
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        B.wal_append(os.path.join(sdir, "wal.bin"), rec)
 
     # -- portions ----------------------------------------------------------
 
@@ -194,9 +169,9 @@ class Store:
         sdir = self._sdir(table.name, shard.shard_id)
         live = []
         for p in shard.portions:
-            path = os.path.join(sdir, f"portion_{p.id}.npz")
+            path = os.path.join(sdir, f"portion_{p.id}.ydbp")
             if not os.path.exists(path):
-                _save_block_npz(path, p.block)
+                B.write_portion(path, p.block)
             live.append({"id": p.id, "rows": p.num_rows,
                          "plan_step": p.version.plan_step,
                          "tx_id": p.version.tx_id})
@@ -209,17 +184,16 @@ class Store:
                       "pending_wids": [e.write_id for e in shard.inserts],
                       "max_wid": shard._next_write_id - 1})
         # drop orphaned portion files (compaction) and consumed wal blocks
-        keep = {f"portion_{e['id']}.npz" for e in live}
-        still = {f"wal_{e.write_id}.npz" for e in shard.inserts}
+        keep = {f"portion_{e['id']}.ydbp" for e in live}
+        still = {f"wal_{e.write_id}.ydbp" for e in shard.inserts}
         for fn in os.listdir(sdir):
-            if fn.startswith("portion_") and fn.endswith(".npz") \
+            if fn.startswith("portion_") and fn.endswith(".ydbp") \
                     and fn not in keep:
                 os.unlink(os.path.join(sdir, fn))
-            if fn.startswith("wal_") and fn.endswith(".npz") \
+            if fn.startswith("wal_") and fn.endswith(".ydbp") \
                     and fn not in still:
                 os.unlink(os.path.join(sdir, fn))
         # rewrite the WAL with only still-pending entries
-        wal = os.path.join(sdir, "wal.jsonl")
         recs = []
         for e in shard.inserts:
             recs.append({"op": "write", "wid": e.write_id})
@@ -227,13 +201,7 @@ class Store:
                 recs.append({"op": "commit", "wids": [e.write_id],
                              "plan_step": e.committed_version.plan_step,
                              "tx_id": e.committed_version.tx_id})
-        tmp = wal + ".tmp"
-        with open(tmp, "w") as f:
-            for r in recs:
-                f.write(json.dumps(r) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, wal)
+        B.wal_rewrite(os.path.join(sdir, "wal.bin"), recs)
 
     # -- recovery ----------------------------------------------------------
 
@@ -245,6 +213,17 @@ class Store:
         from ydb_tpu.storage.shard import InsertEntry
 
         catalog = Catalog(store=None)      # attach after load (no re-writes)
+        # refuse stores written by the pre-binary-format layout: replaying
+        # wal.bin over a tree that only has *.jsonl/*.npz would silently
+        # come up empty (acked writes lost)
+        for dirpath, _dirs, files in os.walk(self.root):
+            legacy = [f for f in files
+                      if f in ("wal.jsonl", "rowwal.jsonl")
+                      or f.endswith(".npz")]
+            if legacy:
+                raise RuntimeError(
+                    f"{dirpath} holds legacy-format files {legacy}; this "
+                    "build reads the CRC-framed wal.bin/.ydbp layout only")
         # last_plan_step must cover every version replayed from disk:
         # state.json can lag a crash that landed between the fsynced
         # wal_commit and save_state (committed data would be invisible and
@@ -271,20 +250,12 @@ class Store:
                     t.dictionaries[c.name] = Dictionary()
 
             if tm.get("store_kind", "column") == "row":
-                wal = os.path.join(self._tdir(name), "rowwal.jsonl")
-                if os.path.exists(wal):
-                    with open(wal) as f:
-                        for line in f:
-                            line = line.strip()
-                            if not line:
-                                continue
-                            rec = json.loads(line)
-                            ver = WriteVersion(rec["plan_step"],
-                                               rec["tx_id"])
-                            ops = [(kind, vals)
-                                   for (kind, vals) in rec["ops"]]
-                            t.apply(ops, ver, durable=False)
-                            seen_step = max(seen_step, ver.plan_step)
+                wal = os.path.join(self._tdir(name), "rowwal.bin")
+                for rec in B.wal_replay(wal):
+                    ver = WriteVersion(rec["plan_step"], rec["tx_id"])
+                    ops = [(kind, vals) for (kind, vals) in rec["ops"]]
+                    t.apply(ops, ver, durable=False)
+                    seen_step = max(seen_step, ver.plan_step)
                 t.store = self
                 continue
 
@@ -294,11 +265,11 @@ class Store:
                                  {"portions": [], "pending_wids": None,
                                   "max_wid": 0})
                 for e in man["portions"]:
-                    block = _load_block_npz(
-                        os.path.join(sdir, f"portion_{e['id']}.npz"),
+                    block = B.read_portion(
+                        os.path.join(sdir, f"portion_{e['id']}.ydbp"),
                         schema, t.dictionaries)
                     # restore the persisted id: a fresh one would alias a
-                    # different portion_<id>.npz on the next indexation
+                    # different portion_<id>.ydbp on the next indexation
                     p = Portion.from_block(
                         block, WriteVersion(e["plan_step"], e["tx_id"]),
                         id=e["id"])
@@ -308,9 +279,9 @@ class Store:
                 # crash leftovers (portion written, manifest not) must not
                 # be aliased by future ids either
                 for fn in os.listdir(sdir):
-                    if fn.startswith("portion_") and fn.endswith(".npz"):
+                    if fn.startswith("portion_") and fn.endswith(".ydbp"):
                         _portion_ids.ensure_above(
-                            int(fn[len("portion_"):-len(".npz")]))
+                            int(fn[len("portion_"):-len(".ydbp")]))
                 pending = man["pending_wids"]
                 max_wid = man["max_wid"]
 
@@ -320,12 +291,7 @@ class Store:
                     return wid in pending or wid > max_wid
 
                 staged: dict[int, InsertEntry] = {}
-                wal = os.path.join(sdir, "wal.jsonl")
-                recs = []
-                if os.path.exists(wal):
-                    with open(wal) as f:
-                        recs = [json.loads(line) for line in f
-                                if line.strip()]
+                recs = B.wal_replay(os.path.join(sdir, "wal.bin"))
                 committed_wids = {wid for r in recs if r["op"] == "commit"
                                   for wid in r["wids"]}
                 for rec in recs:
@@ -338,8 +304,8 @@ class Store:
                             # staged by a tx that died open: its commit
                             # can never arrive — implicit rollback at boot
                             continue
-                        block = _load_block_npz(
-                            os.path.join(sdir, f"wal_{wid}.npz"),
+                        block = B.read_portion(
+                            os.path.join(sdir, f"wal_{wid}.ydbp"),
                             schema, t.dictionaries)
                         staged[wid] = InsertEntry(block, wid)
                     elif rec["op"] == "commit":

@@ -110,3 +110,30 @@ def test_overflow_fallback_rerun(mesh, rng):
         mask = (k == row.k) & m
         np.testing.assert_allclose(row.s, v[mask].sum(), rtol=1e-9)
         assert row.n == (k == row.k).sum()
+
+
+def test_distributed_topk_hidden_sort_key():
+    """Map-distributed sort-limit keeps ORDER BY columns/exprs that are
+    not in the SELECT list through the per-device top-k (DqCnMerge)."""
+    import pandas as pd
+
+    from ydb_tpu.parallel import make_mesh
+    from ydb_tpu.query import QueryEngine
+    eng = QueryEngine(block_rows=1 << 10, mesh=make_mesh(8))
+    eng.execute("create table tk (k Int64 not null, v Double, "
+                "primary key (k)) with (partition_count = 4)")
+    eng.execute("insert into tk (k, v) values "
+                + ",".join(f"({i}, {(i * 37) % 1000}.5)"
+                           for i in range(4000)))
+    df = eng.query("select v from tk order by k desc limit 5")
+    assert eng.executor.last_path == "distributed-map"
+    assert list(df.v) == [((i * 37) % 1000) + 0.5
+                          for i in (3999, 3998, 3997, 3996, 3995)]
+    df = eng.query("select k from tk where v > 100 "
+                   "order by v * -1, k limit 4 offset 2")
+    oracle = pd.DataFrame({"k": range(4000),
+                           "v": [((i * 37) % 1000) + 0.5
+                                 for i in range(4000)]})
+    o = oracle[oracle.v > 100].sort_values(
+        ["v", "k"], ascending=[False, True]).k.iloc[2:6]
+    assert list(df.k) == list(o)

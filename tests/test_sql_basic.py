@@ -400,3 +400,49 @@ def test_string_case_shared_dictionary(eng):
     e2.execute("insert into two (id, a, b) values (1, 'x', 'y')")
     with pytest.raises(QueryError):
         e2.query("select if(id = 1, a, b) as s from two")
+
+
+def test_string_key_join_across_dictionaries():
+    """Each table owns its own dictionary; joining on a Utf8 key must
+    remap codes (raw code equality across dictionaries is meaningless)."""
+    e = QueryEngine(block_rows=1 << 10)
+    e.execute("create table a (id Int64 not null, k Utf8, v Int64, "
+              "primary key (id))")
+    e.execute("create table b (id Int64 not null, k Utf8, w Int64, "
+              "primary key (id))")
+    # insert in DIFFERENT orders so the two dictionaries assign
+    # different codes to the same strings
+    e.execute("insert into a (id, k, v) values "
+              "(1, 'x', 10), (2, 'y', 20), (3, 'z', 30)")
+    e.execute("insert into b (id, k, w) values "
+              "(1, 'z', 300), (2, 'q', 400), (3, 'x', 100)")
+    df = e.query("select a.k, a.v, b.w from a join b on a.k = b.k "
+                 "order by a.k")
+    assert list(df.k) == ["x", "z"]
+    assert list(df.v) == [10, 30]
+    assert list(df.w) == [100, 300]
+    # semi/anti shapes too
+    df = e.query("select a.k from a where a.k in (select b.k from b) "
+                 "order by a.k")
+    assert list(df.k) == ["x", "z"]
+    df = e.query("select a.k from a where a.k not in (select b.k from b) "
+                 "order by a.k")
+    assert list(df.k) == ["y"]
+
+
+def test_composite_string_key_join_across_dictionaries():
+    """Multi-column ON joins hash remapped codes per string key column."""
+    e = QueryEngine(block_rows=1 << 10)
+    e.execute("create table a (id Int64 not null, k Utf8, g Int64, "
+              "v Int64, primary key (id))")
+    e.execute("create table b (id Int64 not null, k Utf8, g Int64, "
+              "w Int64, primary key (id))")
+    # reversed insert orders → different codes for the same strings
+    e.execute("insert into a (id, k, g, v) values "
+              "(1, 'x', 1, 10), (2, 'y', 1, 20), (3, 'y', 2, 30)")
+    e.execute("insert into b (id, k, g, w) values "
+              "(1, 'y', 1, 200), (2, 'x', 1, 100), (3, 'z', 2, 300)")
+    df = e.query("select a.k, a.v, b.w from a join b "
+                 "on a.k = b.k and a.g = b.g order by a.k")
+    assert df.to_dict("list") == {"k": ["x", "y"], "v": [10, 20],
+                                  "w": [100, 200]}

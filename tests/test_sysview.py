@@ -1,0 +1,72 @@
+"""System views (`.sys/...`) through the normal query path.
+
+Mirrors `ydb/core/kqp/ut/olap/sys_view_ut.cpp` + `sys_view/ut_kqp`: the
+views are real relational sources — filters, aggregation and joins over
+them must compose like any table (`sys_view/scan.cpp` serves them through
+the standard scan protocol for exactly that reason).
+"""
+
+import pytest
+
+from ydb_tpu.query import QueryEngine
+from ydb_tpu.query.engine import QueryError
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = QueryEngine(block_rows=1 << 10)
+    e.execute("create table t (id Int64 not null, v Double, "
+              "primary key (id)) with (partition_count = 2)")
+    e.execute("insert into t (id, v) values "
+              + ",".join(f"({i}, {i * 0.5})" for i in range(100)))
+    e.execute("create table r (k Int64 not null, x Int64, "
+              "primary key (k)) with (store = row)")
+    e.query("select sum(v) as s from t")
+    return e
+
+
+def test_sys_tables(eng):
+    df = eng.query("select * from `.sys/tables` order by table_name")
+    assert list(df.table_name) == ["r", "t"]
+    assert list(df.store) == ["row", "column"]
+    assert int(df[df.table_name == "t"].rows.iloc[0]) == 100
+
+
+def test_sys_partition_stats(eng):
+    df = eng.query("select * from `.sys/partition_stats` "
+                   "where table_name = 't' order by shard_id")
+    assert list(df.shard_id) == [0, 1]
+    assert df.rows.sum() == 100          # split across both shards
+
+
+def test_sys_counters_filterable(eng):
+    df = eng.query("select counter, value from `.sys/counters` "
+                   "where counter like 'engine%' order by counter")
+    assert "engine/queries" in set(df.counter)
+    assert (df.value >= 0).all()
+
+
+def test_sys_query_metrics_aggregate(eng):
+    df = eng.query("select kind, count(*) as n from `.sys/query_metrics` "
+                   "group by kind order by kind")
+    assert "select" in set(df.kind)
+    top = eng.query("select sql, total_ms from "
+                    "`.sys/top_queries_by_duration` limit 5")
+    assert len(top) >= 1
+    assert (top.total_ms.diff().dropna() <= 1e-9).all()  # sorted desc
+
+
+def test_sys_join_with_user_table(eng):
+    # joining a sysview against itself/user data composes
+    df = eng.query(
+        "select p.table_name, p.rows, t.shards from "
+        "`.sys/partition_stats` p join `.sys/tables` t "
+        "on p.table_name = t.table_name "
+        "where t.table_name = 't' order by p.shard_id")
+    assert len(df) == 2
+    assert list(df.shards) == [2, 2]
+
+
+def test_sys_unknown_view(eng):
+    with pytest.raises(QueryError, match="unknown system view"):
+        eng.query("select * from `.sys/nope`")

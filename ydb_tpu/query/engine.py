@@ -69,6 +69,10 @@ class QueryEngine:
         self._plan_cache: dict = {}
         self.plan_cache_hits = 0
         self._tmp_n = 0
+        # per-statement stats ring — the `.sys/query_metrics` /
+        # top-queries source (query_metrics_one_minute analog)
+        from collections import deque
+        self.query_history = deque(maxlen=256)
 
     # -- versions (coordinator time, ydb_tpu/tx/coordinator.py) ------------
 
@@ -173,6 +177,32 @@ class QueryEngine:
                     return _unit_block()
                 self.catalog.drop_table(stmt.name)
                 return _unit_block()
+            if isinstance(stmt, ast.AlterTable):
+                if tx is not None:
+                    raise QueryError("DDL inside a transaction is not "
+                                     "supported")
+                return self._alter_table(stmt)
+            if isinstance(stmt, (ast.CreateIndex, ast.DropIndex)):
+                if tx is not None:
+                    raise QueryError("DDL inside a transaction is not "
+                                     "supported")
+                if not self.catalog.has(stmt.table):
+                    raise QueryError(f"unknown table {stmt.table!r}")
+                t = self.catalog.table(stmt.table)
+                if getattr(t, "store_kind", "column") != "row":
+                    raise QueryError(
+                        "secondary indexes are row-store only (column "
+                        "tables index via per-portion min/max stats)")
+                try:
+                    if isinstance(stmt, ast.CreateIndex):
+                        t.create_index(stmt.name, stmt.column)
+                    else:
+                        t.drop_index(stmt.name)
+                except ValueError as e:
+                    raise QueryError(str(e)) from e
+                if self.catalog.store is not None:
+                    self.catalog.store.save_catalog(self.catalog)
+                return _unit_block()
             if isinstance(stmt, ast.Insert):
                 return self._insert(stmt, snap, tx)
             if isinstance(stmt, ast.Update):
@@ -268,6 +298,7 @@ class QueryEngine:
         stats.distributed = self.executor.last_path == "distributed"
         GLOBAL.inc("engine/rows_out", block.length)
         GLOBAL.inc("engine/queries")
+        self.query_history.append(stats)
 
     def counters(self) -> dict:
         """Live counter snapshot (the /counters endpoint payload)."""
@@ -469,6 +500,9 @@ class QueryEngine:
             return True
         if sel.ctes:
             return True
+        from ydb_tpu.scheme import sysview as SV
+        if any(SV.is_sysview(n) for n in self._referenced_tables(sel)):
+            return True               # `.sys/...` materializes at plan time
 
         def rel_has(r):
             if isinstance(r, ast.SubqueryRef):
@@ -540,6 +574,14 @@ class QueryEngine:
                 t = cte_map.get(r.name)
                 if t is not None:
                     return ast.TableRef(t, r.alias or r.name)
+                from ydb_tpu.scheme import sysview as SV
+                if SV.is_sysview(r.name):
+                    try:
+                        blk = SV.sysview_block(self, r.name)
+                    except KeyError as e:
+                        raise QueryError(str(e.args[0])) from e
+                    tname = self._register_temp(blk, temps, snap)
+                    return ast.TableRef(tname, r.alias or "sys")
                 return r
             if isinstance(r, ast.Join):
                 return ast.Join(r.kind, rewrite_rel(r.left),
@@ -654,6 +696,45 @@ class QueryEngine:
         self.catalog.create_table(stmt.name, Schema(cols), pk,
                                   shards=max(1, stmt.partition_count),
                                   store_kind=stmt.store)
+        return _unit_block()
+
+    def _alter_table(self, stmt: ast.AlterTable) -> HostBlock:
+        """ADD/DROP COLUMN (the schemeshard alter-table suboperation
+        analog): schema evolves in place, old portions serve nulls for
+        added columns, the plan cache invalidates via data_version."""
+        if not self.catalog.has(stmt.name):
+            raise QueryError(f"unknown table {stmt.name!r}")
+        t = self.catalog.table(stmt.name)
+        if stmt.action == "add":
+            if t.schema.has(stmt.column):
+                raise QueryError(
+                    f"column {stmt.column!r} already exists")
+            if stmt.not_null and (
+                    t.num_rows > 0
+                    or getattr(t, "store_kind", "column") == "row"):
+                # existing rows have no value for it; row tables replay
+                # their full mutation log at boot, so even an empty one
+                # cannot prove future replays satisfy NOT NULL
+                raise QueryError(
+                    "ADD COLUMN NOT NULL needs an empty column table "
+                    "(no default-value backfill yet)")
+            col = Column(stmt.column,
+                         sql_type_to_dtype(stmt.col_type, stmt.not_null))
+            t.add_column(col)
+        else:
+            if not t.schema.has(stmt.column):
+                raise QueryError(f"unknown column {stmt.column!r}")
+            if stmt.column in t.key_columns \
+                    or stmt.column in (t.partition_by or []):
+                raise QueryError(
+                    f"cannot drop key/partition column {stmt.column!r}")
+            try:
+                t.drop_column(stmt.column)
+            except ValueError as e:     # e.g. column still indexed
+                raise QueryError(str(e)) from e
+        if self.catalog.store is not None:
+            self.catalog.store.save_catalog(self.catalog)
+            self.catalog.store.save_dictionaries(t)
         return _unit_block()
 
     def _insert(self, stmt: ast.Insert, snap=None, tx=None) -> HostBlock:

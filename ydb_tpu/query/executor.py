@@ -127,8 +127,7 @@ class Executor:
         # the expensive part and must not run for plans that always take
         # the portioned path
         join_steps = [step for kind, step in pipe.steps if kind == "join"]
-        builds = [self._prepare_join(step, params, snapshot)
-                  for step in join_steps]
+        builds = self._prepare_builds(pipe, params, snapshot)
         for step, bt in zip(join_steps, builds):
             if isinstance(bt, J.PartitionedBuild) or bt.lut is None or (
                     not bt.unique and step.kind in ("inner", "left", "mark")):
@@ -336,8 +335,7 @@ class Executor:
         # no point replicating builds onto devices that get no blocks
         devs = list(self.mesh.devices.flat)[:max(2, min(
             self.mesh.devices.size, nsrc))]
-        builds = [self._prepare_join(step, params, snapshot)
-                  for kind, step in plan.pipeline.steps if kind == "join"]
+        builds = self._prepare_builds(plan.pipeline, params, snapshot)
         builds_by_dev = [[J.place(b, d) for b in builds] for d in devs]
         # dispatch every device's pipeline first; transfers afterwards —
         # to_host blocks, and fetching inside the loop would serialize the
@@ -346,6 +344,29 @@ class Executor:
                                    builds_by_dev[di], params)
                    for di, dblock in self._scan_device_blocks(
                        plan.pipeline, snapshot, devices=devs)]
+        lim = None if plan.limit is None else plan.limit + (plan.offset or 0)
+        if plan.sort and lim is not None and lim <= (1 << 17):
+            # sort-limit queries: per-device partial top-k BEFORE the
+            # union, so only ≤lim rows per device cross the link — the
+            # DqCnMerge (sorted-merge connection) analog. The offset
+            # applies only at the merge (each device must keep its full
+            # top-(limit+offset) prefix).
+            import dataclasses
+            # sort keys must survive the per-device projection or the
+            # merge pass cannot re-sort (ORDER BY a column/expr outside
+            # the SELECT list); execute()'s final _project_output trims
+            # the extras
+            out_names = {n for (n, _lbl) in plan.output}
+            extra = [(sk.name, sk.name) for sk in plan.sort
+                     if sk.name not in out_names]
+            plan_local = dataclasses.replace(
+                plan, offset=None, limit=lim, output=plan.output + extra)
+            outs = [self._finalize(plan_local, [d], params)
+                    for d in pending]
+            union = HostBlock.concat(outs) if len(outs) > 1 else outs[0]
+            plan_merge = dataclasses.replace(
+                plan, final_program=None, output=plan.output + extra)
+            return self._finalize(plan_merge, [to_device(union)], params)
         outs = [to_host(d) for d in pending]
         union = HostBlock.concat(outs) if len(outs) > 1 else outs[0]
         return self._finalize(plan, [to_device(union)], params)
@@ -364,8 +385,7 @@ class Executor:
         pipe = plan.pipeline
         devs = list(self.mesh.devices.flat)
         ndev = len(devs)
-        builds = [self._prepare_join(step, params, snapshot)
-                  for kind, step in pipe.steps if kind == "join"]
+        builds = self._prepare_builds(pipe, params, snapshot)
         builds_by_dev = [[J.place(b, d) for b in builds] for d in devs]
 
         per_dev = [[] for _ in range(ndev)]
@@ -408,8 +428,7 @@ class Executor:
         programs once so global aggregates emit their row). `builds`:
         BuildTables already prepared by a declined fused attempt."""
         if builds is None:
-            builds = [self._prepare_join(step, params, snapshot)
-                      for kind, step in pipe.steps if kind == "join"]
+            builds = self._prepare_builds(pipe, params, snapshot)
         out = []
         for d in self._scan_device_blocks(pipe, snapshot):
             out.extend(self._run_block_multi(pipe, d, builds, params))
@@ -485,14 +504,56 @@ class Executor:
         part = splitmix64(jnp, enc) % jnp.uint64(nparts)
         return compress_block(d, part == jnp.uint64(p))
 
+    def _prepare_builds(self, pipe: Pipeline, params: dict,
+                        snapshot: Snapshot) -> list:
+        """Prepare every join build of a pipeline in order, threading the
+        probe side's string dictionaries so cross-dictionary string keys
+        remap to probe codes (each table/temp owns its own dictionary —
+        raw code equality across two of them is meaningless)."""
+        probe_dicts = dict(self.catalog.table(pipe.scan.table).dictionaries)
+        # scan columns are renamed storage→internal in the env
+        for (storage, internal) in pipe.scan.columns:
+            if storage in probe_dicts:
+                probe_dicts[internal] = probe_dicts[storage]
+        builds = []
+        for kind, step in pipe.steps:
+            if kind != "join":
+                continue
+            bt = self._prepare_join(step, params, snapshot,
+                                    probe_dict=probe_dicts.get(
+                                        step.probe_key))
+            builds.append(bt)
+            # payload columns join the probe namespace for later steps
+            probe_dicts.update(getattr(bt, "dictionaries", None) or {})
+        return builds
+
     def _prepare_join(self, step: JoinStep, params: dict,
-                      snapshot: Snapshot) -> J.BuildTable:
+                      snapshot: Snapshot, probe_dict=None) -> J.BuildTable:
         if isinstance(step.build, QueryPlan):
             built = self.execute(step.build, snapshot)
         else:
             built = HostBlock.concat(
                 [to_host(d) for d in
                  self._run_pipeline(step.build, params, snapshot)])
+        kcd = built.columns.get(step.build_key)
+        if kcd is not None and kcd.dictionary is not None \
+                and probe_dict is not None \
+                and kcd.dictionary is not probe_dict:
+            # translate build key codes into the probe dictionary
+            # (host-side O(distinct) LUT; unmatched values → -2 never-match)
+            src = kcd.dictionary.values_array()
+            lut = np.full(max(len(src), 1), -2, dtype=np.int32)
+            for i, v in enumerate(src):
+                lut[i] = probe_dict.encode_existing(v)
+            codes = kcd.data
+            remapped = np.where(codes >= 0, lut[np.clip(codes, 0, None)],
+                                codes).astype(codes.dtype)
+            built = HostBlock(
+                built.schema,
+                {**built.columns,
+                 step.build_key: ColumnData(remapped, kcd.valid,
+                                            probe_dict)},
+                built.length)
         if step.build_hash_keys:
             built = _add_hash_column(built, step.build_hash_keys,
                                      step.build_key)

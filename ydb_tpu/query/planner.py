@@ -542,17 +542,41 @@ class Planner:
                 pre.assign(jk, _hash_key_expr([b.internal for b in probe_bs]))
                 bjk = f"{jk}b"
                 sub_partial = ir.Program()
-                sub_partial.assign(bjk, _hash_key_expr(
-                    [b.internal for b in build_bs]))
-                sub_partial.project(sub.out_names + [bjk])
+                # string key columns from a DIFFERENT dictionary than the
+                # probe side's must remap codes before hashing/verifying —
+                # raw code equality across dictionaries is meaningless
+                hash_cols, remap_names = [], []
+                verify = ir.Program()
+                for i, (pb, bb) in enumerate(zip(probe_bs, build_bs)):
+                    if pb.dtype.is_string and pb.dictionary is not None \
+                            and bb.dictionary is not None \
+                            and bb.dictionary is not pb.dictionary:
+                        src = bb.dictionary.values_array()
+                        lut = np.full(max(len(src), 1), -2, dtype=np.int32)
+                        for ci, v in enumerate(src):
+                            lut[ci] = pb.dictionary.encode_existing(v)
+                        p = self.pool.add(
+                            lut, dt.DType(dt.Kind.STRING, False),
+                            is_array=True)
+                        self.pool.param_dicts[p.name] = pb.dictionary
+                        rname = f"{jk}r{i}"
+                        sub_partial.assign(
+                            rname, ir.call("take_lut",
+                                           ir.Col(bb.internal), p))
+                        remap_names.append(rname)
+                        hash_cols.append(rname)
+                        verify.filter(ir.call("eq", ir.Col(pb.internal),
+                                              ir.Col(rname)))
+                    else:
+                        hash_cols.append(bb.internal)
+                        verify.filter(ir.call("eq", ir.Col(pb.internal),
+                                              ir.Col(bb.internal)))
+                sub_partial.assign(bjk, _hash_key_expr(hash_cols))
+                sub_partial.project(sub.out_names + remap_names + [bjk])
                 sub.partial = sub_partial
                 payload = list(dict.fromkeys(
                     [c for c in sub.out_names if c in needed]
-                    + [b.internal for b in build_bs]))
-                verify = ir.Program()
-                for pb, bb in zip(probe_bs, build_bs):
-                    verify.filter(ir.call("eq", ir.Col(pb.internal),
-                                          ir.Col(bb.internal)))
+                    + [b.internal for b in build_bs] + remap_names))
                 join_steps.append((JoinStep(sub, bjk, jk, "inner", payload),
                                    verify))
 
@@ -982,6 +1006,8 @@ class Planner:
                 pipeline.steps.append(("join", js))
             else:
                 # composite: hash-key mark join + per-key verification
+                self._guard_composite_string_keys(
+                    [o for (o, _lbl) in spec["keys"]])
                 probe_key = f"__s{n}p"
                 hashed = [ir.call("hash64", e) for e in bound]
                 pre.assign(probe_key,
@@ -1022,6 +1048,19 @@ class Planner:
                 prog.filter(binder.bind(p))
             pipeline.steps.append(("program", prog))
 
+    def _guard_composite_string_keys(self, outer_exprs) -> None:
+        """Composite correlated keys hash raw per-table dictionary codes;
+        a string key from another dictionary would silently mismatch —
+        refuse loudly until remapping reaches these join shapes (the
+        single-key and edge-join paths DO remap)."""
+        for e in outer_exprs:
+            if isinstance(e, ast.Name):
+                b = self.scope.try_resolve(e.parts)
+                if b is not None and b.dtype.is_string:
+                    raise PlanError(
+                        "multi-key correlated subqueries with string key "
+                        "columns are not supported yet")
+
     def _attach_neq_spec(self, pipeline, spec, bound, binder, pre):
         """EXISTS / NOT EXISTS with a `col <> outer.col` correlation: mark
         join against the per-key min/max aggregate, then verify
@@ -1044,6 +1083,8 @@ class Planner:
             pipeline.steps.append(("join", js))
             matched = ir.Col(mark)
         else:
+            self._guard_composite_string_keys(
+                [o for (o, _lbl) in spec["keys"]])
             probe_key = f"__s{n}p"
             hashed = [ir.call("hash64", e) for e in bound]
             pre.assign(probe_key, hashed[0] if len(hashed) == 1
@@ -1083,6 +1124,8 @@ class Planner:
         pipeline.steps.append(("program", snap))
 
         corr_bound = bound[1:]
+        self._guard_composite_string_keys(
+            [o for (o, _lbl) in spec["keys2"]])
         corr_labels = [lbl for (_o, lbl) in spec["keys2"]]
         probe2 = f"__s{n}p2"
         h2 = [ir.call("hash64", e) for e in corr_bound]

@@ -1,0 +1,96 @@
+"""System views: virtual `.sys/...` tables served through the scan path.
+
+The reference exposes cluster/runtime state as virtual tables under
+`.sys` (`ydb/core/sys_view/common/schema.h`: partition_stats,
+query_metrics_one_minute, top_queries_by_duration_*, …), deliberately
+served through the SAME scan protocol as user tables
+(`sys_view/scan.cpp`) so every SQL feature composes with them. Same
+stance here: a sysview materializes to a transient column table at plan
+time and the normal engine executes the query over it — joins, filters,
+aggregates and EXPLAIN all work on `.sys` views for free.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from ydb_tpu.core.block import HostBlock
+
+PREFIX = ".sys/"
+
+VIEWS = ("tables", "partition_stats", "counters", "query_metrics",
+         "top_queries_by_duration")
+
+
+def is_sysview(name: str) -> bool:
+    return name.startswith(PREFIX)
+
+
+def sysview_block(engine, name: str) -> HostBlock:
+    view = name[len(PREFIX):]
+    if view == "tables":
+        rows = [{
+            "table_name": n,
+            "store": getattr(t, "store_kind", "column"),
+            "shards": len(getattr(t, "shards", [])) or 1,
+            "rows": int(t.num_rows),
+            "data_version": int(getattr(t, "data_version", 0)),
+        } for n, t in sorted(engine.catalog.tables.items())
+            if not getattr(t, "transient", False)]
+        return _block(rows, [("table_name", str), ("store", str),
+                             ("shards", "int64"), ("rows", "int64"),
+                             ("data_version", "int64")])
+    if view == "partition_stats":
+        rows = []
+        for n, t in sorted(engine.catalog.tables.items()):
+            if getattr(t, "transient", False) \
+                    or getattr(t, "store_kind", "column") == "row":
+                continue
+            for s in t.shards:
+                rows.append({
+                    "table_name": n, "shard_id": s.shard_id,
+                    "portions": len(s.portions),
+                    "rows": int(sum(p.num_rows for p in s.portions)),
+                    "staged_inserts": len(s.inserts),
+                })
+        return _block(rows, [("table_name", str), ("shard_id", "int64"),
+                             ("portions", "int64"), ("rows", "int64"),
+                             ("staged_inserts", "int64")])
+    if view == "counters":
+        snap = engine.counters()
+        rows = [{"counter": k, "value": float(v)}
+                for k, v in snap.items()]
+        return _block(rows, [("counter", str), ("value", "float64")])
+    if view in ("query_metrics", "top_queries_by_duration"):
+        hist = list(engine.query_history)
+        if view == "top_queries_by_duration":
+            hist = sorted(hist, key=lambda s: -s.total_ms)[:32]
+        rows = [{
+            "sql": st.sql, "kind": st.kind,
+            "total_ms": st.total_ms, "parse_ms": st.parse_ms,
+            "plan_ms": st.plan_ms, "execute_ms": st.execute_ms,
+            "rows_out": int(st.rows_out),
+            "path": ("distributed" if st.distributed
+                     else "fused" if st.fused else "portioned"),
+            "cache_hit": bool(st.plan_cache_hit),
+        } for st in hist]
+        return _block(rows, [("sql", str), ("kind", str),
+                             ("total_ms", "float64"),
+                             ("parse_ms", "float64"),
+                             ("plan_ms", "float64"),
+                             ("execute_ms", "float64"),
+                             ("rows_out", "int64"), ("path", str),
+                             ("cache_hit", "bool")])
+    raise KeyError(f"unknown system view {name!r} "
+                   f"(have: {', '.join(PREFIX + v for v in VIEWS)})")
+
+
+def _block(rows: list, spec: list) -> HostBlock:
+    """Typed block even when empty (object-dtype inference would fail)."""
+    df = pd.DataFrame(rows, columns=[n for (n, _) in spec])
+    for n, dtype in spec:
+        if dtype is str:
+            df[n] = df[n].astype(object).where(df[n].notna(), "")
+        else:
+            df[n] = df[n].fillna(0).astype(dtype)
+    return HostBlock.from_pandas(df)

@@ -225,6 +225,30 @@ class DropTable:
 
 
 @dataclass
+class CreateIndex:
+    name: str
+    table: str
+    column: str
+
+
+@dataclass
+class DropIndex:
+    name: str
+    table: str
+
+
+@dataclass
+class AlterTable:
+    """ADD COLUMN / DROP COLUMN (schemeshard__operation_alter_table
+    analog — the v0 of the reference's ~120 suboperation state machines)."""
+    name: str
+    action: str                       # "add" | "drop"
+    column: str = ""
+    col_type: str = ""                # for add
+    not_null: bool = False            # for add (empty tables only)
+
+
+@dataclass
 class Insert:
     table: str
     columns: list                     # list[str] (may be empty = all)

@@ -27,7 +27,7 @@ KEYWORDS = {
     "replace", "into", "values", "delete", "update", "set", "if", "with",
     "union", "all", "escape", "substring", "for", "partition", "store",
     "extract", "begin", "commit", "rollback", "transaction", "explain",
-    "analyze", "over",
+    "analyze", "over", "alter",
 }
 
 _OPS = ["<>", "!=", ">=", "<=", "||", "(", ")", ",", "+", "-", "*", "/", "%",

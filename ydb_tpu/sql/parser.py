@@ -73,9 +73,17 @@ class Parser:
         if self.at_kw("with") or self.at_kw("select"):
             stmt = self.parse_select()
         elif self.at_kw("create"):
-            stmt = self.parse_create_table()
+            if self.peek(1).value.lower() == "index":
+                stmt = self.parse_create_index()
+            else:
+                stmt = self.parse_create_table()
         elif self.at_kw("drop"):
-            stmt = self.parse_drop_table()
+            if self.peek(1).value.lower() == "index":
+                stmt = self.parse_drop_index()
+            else:
+                stmt = self.parse_drop_table()
+        elif self.at_kw("alter"):
+            stmt = self.parse_alter_table()
         elif self.at_kw("insert", "upsert", "replace"):
             stmt = self.parse_insert()
         elif self.at_kw("delete"):
@@ -603,6 +611,46 @@ class Parser:
             self.expect_kw("exists")
             if_exists = True
         return ast.DropTable(self.ident(), if_exists)
+
+    def parse_create_index(self) -> ast.CreateIndex:
+        self.expect_kw("create")
+        self.next()                       # "index" (contextual ident)
+        iname = self.ident()
+        self.expect_kw("on")
+        table = self.ident()
+        self.expect_op("(")
+        col = self.ident()
+        self.expect_op(")")
+        return ast.CreateIndex(iname, table, col)
+
+    def parse_drop_index(self) -> ast.DropIndex:
+        self.expect_kw("drop")
+        self.next()                       # "index"
+        iname = self.ident()
+        self.expect_kw("on")
+        return ast.DropIndex(iname, self.ident())
+
+    def parse_alter_table(self) -> ast.AlterTable:
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        name = self.ident()
+        word = self.next().value.lower()   # add (ident) | drop (keyword)
+        if word == "add":
+            if self.peek().value.lower() == "column":
+                self.next()
+            col = self.ident()
+            ty = self.type_name()
+            not_null = False
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                not_null = True
+            return ast.AlterTable(name, "add", col, ty, not_null)
+        if word == "drop":
+            if self.peek().value.lower() == "column":
+                self.next()
+            return ast.AlterTable(name, "drop", self.ident())
+        raise SqlError(f"ALTER TABLE supports ADD/DROP COLUMN, got "
+                       f"{word!r}")
 
     def parse_insert(self) -> ast.Insert:
         mode = self.next().value   # insert | upsert | replace

@@ -159,6 +159,18 @@ def read_portion(path: str, schema: Schema, dicts: dict) -> HostBlock:
         by_name[ent["name"]] = (data, valid)
     cols = {}
     for c in schema:
+        if c.name not in by_name:
+            # the portion predates this column (ALTER TABLE ADD COLUMN):
+            # synthesize nulls — per-portion schema versioning
+            if not c.dtype.nullable:
+                raise ValueError(
+                    f"{path}: missing NOT NULL column {c.name}")
+            fill = -1 if c.dtype.is_string else 0   # -1 = null string code
+            cols[c.name] = ColumnData(
+                np.full(header["rows"], fill, dtype=c.dtype.np),
+                np.zeros(header["rows"], dtype=bool),
+                dicts.get(c.name))
+            continue
         data, valid = by_name[c.name]
         cols[c.name] = ColumnData(np.array(data), valid,
                                   dicts.get(c.name))

@@ -45,7 +45,18 @@ class _RowScanAdapter:
 
     def scan_sources(self, snapshot: Snapshot = MAX_SNAPSHOT,
                      prune_predicates=None):
-        return [], self.table.snapshot_entries(snapshot)
+        # equality prune over an indexed column (or the pk) serves a
+        # candidates-only block instead of the full table
+        t = self.table
+        eq = None
+        for (col, op, val) in (prune_predicates or ()):
+            if op == "eq" and (
+                    col in t._index_data
+                    or (len(t.key_columns) == 1 and col == t.key_columns[0]
+                        and not t.schema.dtype(col).is_string)):
+                eq = (col, val)
+                break
+        return [], t.snapshot_entries(snapshot, eq=eq)
 
     def scan(self, columns: list[str], snapshot: Snapshot = MAX_SNAPSHOT,
              prune_predicates=None,
@@ -97,6 +108,12 @@ class RowTable:
         self.shards = [_RowScanAdapter(self)]
         self._snap_cache: dict = {}    # (data_version, snap) -> entries
         self._tx_touched: dict = {}    # open tx id -> set of touched pks
+        # secondary indexes (schemeshard build-index analog, v0):
+        # name -> column; per-column candidate map value -> {pk}. The map
+        # over-approximates (no removal on delete/update) — reads verify
+        # visibility + current value, so stale candidates are harmless.
+        self.indexes: dict[str, str] = {}
+        self._index_data: dict[str, dict] = {}
 
     # -- write path -------------------------------------------------------
 
@@ -112,8 +129,69 @@ class RowTable:
         return dt.np(v).item() if not isinstance(v, (int, float, bool)) \
             else v
 
+    # -- schema evolution (ALTER TABLE) ------------------------------------
+
+    def add_column(self, col) -> None:
+        """ADD COLUMN (nullable only): stored value tuples are positional
+        by schema order — every version chain gains a None slot."""
+        self.schema = self.schema.extend([col])
+        if col.dtype.is_string:
+            self.dictionaries[col.name] = Dictionary()
+        for pk, chain in self.rows.items():
+            self.rows[pk] = [
+                (v, (vals + (None,)) if vals is not None else None, etx)
+                for (v, vals, etx) in chain]
+        self.data_version += 1
+        self._snap_cache.clear()
+
+    def create_index(self, iname: str, col: str) -> None:
+        if not self.schema.has(col):
+            raise ValueError(f"unknown column {col!r}")
+        if iname in self.indexes:
+            raise ValueError(f"index {iname!r} already exists")
+        self.indexes[iname] = col
+        if col not in self._index_data:
+            ix = self.schema.names.index(col)
+            data: dict = {}
+            for pk, chain in self.rows.items():
+                for (_v, vals, _tx) in chain:
+                    if vals is not None:
+                        data.setdefault(vals[ix], set()).add(pk)
+            self._index_data[col] = data
+
+    def drop_index(self, iname: str) -> None:
+        col = self.indexes.pop(iname, None)
+        if col is None:
+            raise ValueError(f"unknown index {iname!r}")
+        if col not in self.indexes.values():
+            self._index_data.pop(col, None)
+
+    def drop_column(self, name: str) -> None:
+        for iname, col in list(self.indexes.items()):
+            if col == name:
+                raise ValueError(
+                    f"column {name!r} is indexed by {iname!r}; drop the "
+                    "index first")
+        ix = self.schema.names.index(name)
+        self.schema = Schema([c for c in self.schema.columns
+                              if c.name != name])
+        self.dictionaries.pop(name, None)
+        for pk, chain in self.rows.items():
+            self.rows[pk] = [
+                (v, (vals[:ix] + vals[ix + 1:]) if vals is not None
+                 else None, etx)
+                for (v, vals, etx) in chain]
+        self.data_version += 1
+        self._snap_cache.clear()
+        if self.store is not None:
+            # the mutation log still carries pre-DROP values: compact it
+            # to the surviving state or a later re-ADD of the same name
+            # would resurrect them at replay
+            self.store.rewrite_row_wal(self)
+
     def apply(self, ops: list, version: Optional[WriteVersion],
-              durable: bool = True, tx: Optional[int] = None) -> int:
+              durable: bool = True, tx: Optional[int] = None,
+              strict: bool = True) -> int:
         """Apply a batch of mutations.
 
         ops: [("upsert"|"insert"|"replace", {col: value}) | ("delete",
@@ -131,7 +209,9 @@ class RowTable:
         appends: list[tuple[tuple, object]] = []   # (pk, values | None)
         overlay: dict[tuple, object] = {}          # batch-local live view
         for kind, vals in ops:
-            enc = {c: self._encode_value(c, v) for c, v in vals.items()}
+            # non-strict = WAL replay: mutations may predate a DROP COLUMN
+            enc = {c: self._encode_value(c, v) for c, v in vals.items()
+                   if strict or self.schema.has(c)}
             pk = self._pk_of(enc)
             if pk in overlay:
                 live = overlay[pk]
@@ -160,8 +240,13 @@ class RowTable:
             appends.append((pk, values))
             overlay[pk] = values
         # validation passed — mutate
+        idx_cols = [(col, self.schema.names.index(col), data)
+                    for col, data in self._index_data.items()]
         for pk, values in appends:
             self.rows.setdefault(pk, []).append((version, values, tx))
+            if values is not None:
+                for _col, cix, data in idx_cols:
+                    data.setdefault(values[cix], set()).add(pk)
         if tx is not None:
             self._tx_touched.setdefault(tx, set()).update(
                 pk for pk, _v in appends)
@@ -226,19 +311,39 @@ class RowTable:
             return None
         return self._visible(chain, snapshot)
 
-    def snapshot_entries(self, snapshot: Snapshot = MAX_SNAPSHOT) -> list:
+    def snapshot_entries(self, snapshot: Snapshot = MAX_SNAPSHOT,
+                         eq=None) -> list:
+        """Visible rows as one columnar block. `eq=(col, value)`: serve
+        from a secondary index (or the pk map) — candidate pks only,
+        verified against visibility + current value (the index-lookup
+        read of `datashard__read_iterator`)."""
         key = (self.data_version, snapshot.plan_step, snapshot.tx_id,
-               snapshot.tx_view)
+               snapshot.tx_view, eq)
         hit = self._snap_cache.get(key)
         if hit is not None:
             return hit
         names = self.schema.names
+        if eq is not None:
+            col, want = eq
+            ix = names.index(col)
+            if col in self._index_data:
+                cands = sorted(self._index_data[col].get(want, ()))
+            else:                       # single-column pk point lookup
+                cands = [(want,)] if (want,) in self.rows else []
+            pks = (pk for pk in cands)
+        else:
+            pks = iter(sorted(self.rows))  # key-ordered, like DataShard
         cols: dict[str, list] = {c: [] for c in names}
         length = 0
-        for pk in sorted(self.rows):           # key-ordered, like DataShard
-            vals = self._visible(self.rows[pk], snapshot)
+        for pk in pks:
+            chain = self.rows.get(pk)
+            if chain is None:
+                continue
+            vals = self._visible(chain, snapshot)
             if vals is None:
                 continue
+            if eq is not None and vals[ix] != want:
+                continue               # stale index candidate
             for c, v in zip(names, vals):
                 cols[c].append(v)
             length += 1

@@ -139,6 +139,62 @@ class ColumnTable:
                 self.store.save_indexation(self, s)
         return merged
 
+    # -- schema evolution (ALTER TABLE) ------------------------------------
+
+    def add_column(self, col) -> None:
+        """ADD COLUMN: existing portions/staged blocks gain an all-null
+        column in memory; on-disk portion files stay untouched (the blob
+        reader synthesizes nulls for columns a portion predates — the
+        per-portion schema-versioning stance of the reference's
+        ColumnShard)."""
+        from ydb_tpu.core.block import ColumnData
+        self.schema = self.schema.extend([col])
+        if col.dtype.is_string:
+            self.dictionaries[col.name] = Dictionary()
+
+        def patch(block: HostBlock) -> HostBlock:
+            # string nulls are code -1 (0 would index an empty dictionary)
+            fill = -1 if col.dtype.is_string else 0
+            data = np.full(block.length, fill, dtype=col.dtype.np)
+            cd = ColumnData(data, np.zeros(block.length, bool),
+                            self.dictionaries.get(col.name))
+            return HostBlock(block.schema.extend([col]),
+                             {**block.columns, col.name: cd}, block.length)
+
+        for s in self.shards:
+            s.schema = self.schema
+            for p in s.portions:
+                p.block = patch(p.block)
+            for e in s.inserts:
+                e.block = patch(e.block)
+        self.data_version += 1
+
+    def drop_column(self, name: str) -> None:
+        """DROP COLUMN: stripped from memory AND from on-disk blobs (a
+        later re-ADD of the same name must see nulls, not stale bytes)."""
+        self.schema = Schema([c for c in self.schema.columns
+                              if c.name != name])
+        self.dictionaries.pop(name, None)
+
+        def strip(block: HostBlock) -> HostBlock:
+            if name not in block.columns:
+                return block
+            cols = {n: cd for n, cd in block.columns.items() if n != name}
+            return HostBlock(
+                Schema([c for c in block.schema.columns if c.name != name]),
+                cols, block.length)
+
+        for s in self.shards:
+            s.schema = self.schema
+            for p in s.portions:
+                p.block = strip(p.block)
+                p.stats.pop(name, None)
+            for e in s.inserts:
+                e.block = strip(e.block)
+            if self.store is not None:
+                self.store.rewrite_shard_blobs(self, s)
+        self.data_version += 1
+
     def bulk_upsert(self, df, version: WriteVersion) -> int:
         """Ingest a pandas DataFrame (BulkUpsert analog): write+commit+indexate."""
         block = HostBlock.from_pandas(df, schema=self.schema,

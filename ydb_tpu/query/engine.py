@@ -10,6 +10,7 @@ concurrent sessions over the shared engine.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import numpy as np
@@ -31,8 +32,8 @@ class QueryError(Exception):
 
 class QueryEngine:
     def __init__(self, catalog: Optional[Catalog] = None,
-                 block_rows: int = 1 << 20, mesh=None,
-                 data_dir: Optional[str] = None):
+                 block_rows: Optional[int] = None, mesh=None,
+                 data_dir: Optional[str] = None, config=None):
         """`mesh`: a jax.sharding.Mesh for distributed execution — scans are
         row-partitioned across its devices and aggregation boundaries become
         ICI hash shuffles (`ydb_tpu.parallel.make_mesh(n)` builds one).
@@ -40,11 +41,19 @@ class QueryEngine:
         `data_dir`: durable root. An existing catalog there is recovered
         (portions + WAL replay, `storage/persist.py`); otherwise a fresh
         durable catalog is created. MVCC plan steps resume past the last
-        committed step so recovered versions stay ordered."""
+        committed step so recovered versions stay ordered.
+
+        `config`: a `ydb_tpu.utils.config.Config` (YAML-loadable, with
+        selector overrides + feature flags); explicit arguments win over
+        it."""
+        from ydb_tpu.utils.config import Config
+        self.config = config or Config.load()
+        block_rows = block_rows if block_rows is not None \
+            else self.config.block_rows
+        data_dir = data_dir if data_dir is not None \
+            else self.config.data_dir
         restored_step = 0
         if data_dir is not None and catalog is None:
-            import os
-
             from ydb_tpu.storage.persist import Store
             store = Store(data_dir)
             if os.path.exists(os.path.join(data_dir, "catalog.json")):
@@ -55,6 +64,12 @@ class QueryEngine:
         self.catalog = catalog or Catalog()
         self.planner = Planner(self.catalog)
         self.executor = Executor(self.catalog, block_rows, mesh=mesh)
+        self.executor.enable_fused = self.config.flag("enable_fused")
+        # budget priority: explicit env var > config (file or object) >
+        # built-in default (the executor ctor already consumed the env)
+        if "YDB_TPU_GRACE_BUDGET" not in os.environ:
+            self.executor.grace_budget_bytes = \
+                self.config.grace_budget_bytes
         from ydb_tpu.tx import Coordinator, Session
         self.coordinator = Coordinator(start_step=max(1, restored_step))
         # the engine's own statements run through a default session
@@ -73,6 +88,12 @@ class QueryEngine:
         # top-queries source (query_metrics_one_minute analog)
         from collections import deque
         self.query_history = deque(maxlen=256)
+        # topics + changefeeds (PersQueue / change_exchange analogs,
+        # ydb_tpu/storage/topic.py); durable under <root>/__topics
+        self.topics: dict = {}
+        self._changefeeds: dict = {}    # table -> topic name
+        if self.catalog.store is not None:
+            self._load_topics()
 
     # -- versions (coordinator time, ydb_tpu/tx/coordinator.py) ------------
 
@@ -90,6 +111,86 @@ class QueryEngine:
         """Open an interactive session (BEGIN/COMMIT/ROLLBACK scope)."""
         from ydb_tpu.tx import Session
         return Session(self)
+
+    # -- topics / changefeeds (PersQueue + change_exchange analogs) --------
+
+    def create_topic(self, name: str, partitions: int = 1):
+        import re as _re
+        from ydb_tpu.storage.topic import Topic
+        if not _re.fullmatch(r"[A-Za-z0-9_][A-Za-z0-9_.-]*", name):
+            # the name becomes a directory under <root>/__topics — '/'
+            # or '..' would escape it
+            raise QueryError(f"invalid topic name {name!r}")
+        if partitions < 1:
+            raise QueryError("a topic needs at least one partition")
+        if name in self.topics:
+            raise QueryError(f"topic {name!r} already exists")
+        self.topics[name] = Topic(name, partitions, self._topic_root(name))
+        self._save_topics()
+        return self.topics[name]
+
+    def topic(self, name: str):
+        t = self.topics.get(name)
+        if t is None:
+            raise QueryError(f"unknown topic {name!r}")
+        return t
+
+    def drop_topic(self, name: str) -> None:
+        self.topic(name)
+        if name in self._changefeeds.values():
+            raise QueryError(f"topic {name!r} feeds a changefeed")
+        del self.topics[name]
+        root = self._topic_root(name)
+        if root is not None and os.path.isdir(root):
+            import shutil
+            shutil.rmtree(root)
+        self._save_topics()
+
+    def enable_changefeed(self, table_name: str, topic_name: str) -> None:
+        """Publish the row table's committed mutations into the topic
+        (CDC; per-pk partition ordering)."""
+        from ydb_tpu.storage.topic import ChangefeedSink
+        if not self.catalog.has(table_name):
+            raise QueryError(f"unknown table {table_name!r}")
+        t = self.catalog.table(table_name)
+        if getattr(t, "store_kind", "column") != "row":
+            raise QueryError("changefeeds are row-store only for now")
+        t.changefeed = ChangefeedSink(self.topic(topic_name), table_name,
+                                      t.key_columns)
+        self._changefeeds[table_name] = topic_name
+        self._save_topics()
+
+    def _topic_root(self, name: str):
+        if self.catalog.store is None:
+            return None
+        return os.path.join(self.catalog.store.root, "__topics", name)
+
+    def _save_topics(self) -> None:
+        if self.catalog.store is None:
+            return
+        from ydb_tpu.storage.persist import _atomic_json
+        _atomic_json(
+            os.path.join(self.catalog.store.root, "topics.json"),
+            {"topics": {n: len(t.partitions)
+                        for n, t in self.topics.items()},
+             "changefeeds": dict(self._changefeeds)})
+
+    def _load_topics(self) -> None:
+        import json as _json
+        from ydb_tpu.storage.topic import ChangefeedSink, Topic
+        path = os.path.join(self.catalog.store.root, "topics.json")
+        if not os.path.exists(path):
+            return
+        with open(path) as f:
+            meta = _json.load(f)
+        for n, parts in meta.get("topics", {}).items():
+            self.topics[n] = Topic(n, parts, self._topic_root(n))
+        for table_name, topic_name in meta.get("changefeeds", {}).items():
+            if self.catalog.has(table_name) and topic_name in self.topics:
+                t = self.catalog.table(table_name)
+                t.changefeed = ChangefeedSink(
+                    self.topics[topic_name], table_name, t.key_columns)
+                self._changefeeds[table_name] = topic_name
 
     # -- entry -------------------------------------------------------------
 
@@ -150,7 +251,8 @@ class QueryEngine:
                     self._finish_stats(stats, t, block)
                     return block
                 fp = self._table_fingerprint(stmt)
-                cached = self._plan_cache.get(sql)
+                cached = self._plan_cache.get(sql) \
+                    if self.config.flag("enable_plan_cache") else None
                 if cached is not None and cached[0] == fp:
                     plan = cached[1]
                     self.plan_cache_hits += 1
@@ -158,7 +260,8 @@ class QueryEngine:
                     GLOBAL.inc("engine/plan_cache_hits")
                 else:
                     plan = self.planner.plan_select(stmt)
-                    self._plan_cache[sql] = (fp, plan)
+                    if self.config.flag("enable_plan_cache"):
+                        self._plan_cache[sql] = (fp, plan)
                     GLOBAL.inc("engine/plan_cache_misses")
                 stats.plan_ms = t.lap()
                 block = self.executor.execute(plan, snap)
@@ -176,6 +279,8 @@ class QueryEngine:
                 if stmt.if_exists and not self.catalog.has(stmt.name):
                     return _unit_block()
                 self.catalog.drop_table(stmt.name)
+                if self._changefeeds.pop(stmt.name, None) is not None:
+                    self._save_topics()   # else the topic stays pinned
                 return _unit_block()
             if isinstance(stmt, ast.AlterTable):
                 if tx is not None:
@@ -799,7 +904,8 @@ class QueryEngine:
             return _unit_block()
         writes = table.write(block)
         table.commit(writes, self._next_version())
-        table.indexate(self.coordinator.safe_watermark())
+        table.indexate(self.coordinator.safe_watermark(),
+                       compact=self.config.flag("enable_auto_compaction"))
         return _unit_block()
 
     def _apply_row_ops(self, table, ops, tx) -> None:
@@ -925,7 +1031,8 @@ class QueryEngine:
             return 0
         from ydb_tpu.storage.portion import Portion
         # inserts → portions first: the WAL must
-        table.indexate(self.coordinator.safe_watermark())
+        table.indexate(self.coordinator.safe_watermark(),
+                       compact=self.config.flag("enable_auto_compaction"))
         #                           never resurrect rewritten rows
         removed = 0
         for shard in table.shards:

@@ -51,6 +51,9 @@ class Executor:
         # all_to_all hash shuffle.
         self.mesh = mesh
         self._dist_aggs: dict = {}
+        # feature flag (utils/config.py): the whole-query single-dispatch
+        # path; off = always the portioned streaming path (debug lever)
+        self.enable_fused = True
         # which path the last execute() took:
         # fused | portioned | distributed | distributed-map | literal
         self.last_path = ""
@@ -92,7 +95,8 @@ class Executor:
                                                        snapshot)
                 return self._project_output(merged, plan.output)
 
-        fused = self._try_execute_fused(plan, params, snapshot)
+        fused = self._try_execute_fused(plan, params, snapshot) \
+            if self.enable_fused else None
         if isinstance(fused, HostBlock):
             self.last_path = "fused"
             return self._project_output(fused, plan.output)

@@ -66,6 +66,10 @@ def _result_payload(block, stats) -> dict:
 
 MAX_SESSIONS = 256
 
+import time as _time  # noqa: E402
+
+_STARTED = _time.monotonic()
+
 
 class QueryServicer:
     def __init__(self, engine, max_sessions: int = MAX_SESSIONS):
@@ -119,6 +123,35 @@ class QueryServicer:
     def ping(self, request, context):
         return {"ok": True}
 
+    def health(self, request, context):
+        """Aggregated health (the health_check.cpp analog): engine
+        liveness, storage mode, device platform, and basic capacity.
+        Deliberately LOCK-FREE — a liveness probe must answer while a
+        long query holds the execution lock, and reading approximate
+        counts needs no consistency."""
+        import time
+
+        import jax
+        eng = self.engine
+        tables = [n for n, t in list(eng.catalog.tables.items())
+                  if not getattr(t, "transient", False)]
+        issues = []
+        try:
+            devs = jax.devices()
+            platform = devs[0].platform if devs else "none"
+        except Exception as e:               # noqa: BLE001
+            platform, issues = "unavailable", [f"device: {e}"]
+        return {
+            "status": "GOOD" if not issues else "DEGRADED",
+            "issues": issues,
+            "tables": len(tables),
+            "topics": len(eng.topics),
+            "durable": eng.catalog.store is not None,
+            "platform": platform,
+            "sessions": len(self._sessions),
+            "uptime_s": round(time.monotonic() - _STARTED, 1),
+        }
+
 
 def serve(engine, port: int = 2136, max_workers: int = 8):
     """Start the gRPC server; returns (server, bound_port)."""
@@ -137,6 +170,9 @@ def serve(engine, port: int = 2136, max_workers: int = 8):
             response_serializer=_ser),
         "CloseSession": grpc.unary_unary_rpc_method_handler(
             servicer.close_session, request_deserializer=_deser,
+            response_serializer=_ser),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            servicer.health, request_deserializer=_deser,
             response_serializer=_ser),
     }
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
@@ -163,6 +199,9 @@ class Client:
         self._ping = self._channel.unary_unary(
             f"/{SERVICE}/Ping", request_serializer=_ser,
             response_deserializer=_deser)
+        self._health = self._channel.unary_unary(
+            f"/{SERVICE}/Health", request_serializer=_ser,
+            response_deserializer=_deser)
         self.session_id = session_id
 
     def execute(self, sql: str) -> dict:
@@ -183,6 +222,9 @@ class Client:
 
     def ping(self) -> bool:
         return bool(self._ping({}).get("ok"))
+
+    def health(self) -> dict:
+        return self._health({})
 
     def close(self) -> None:
         if self.session_id:

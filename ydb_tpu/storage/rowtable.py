@@ -114,6 +114,9 @@ class RowTable:
         # visibility + current value, so stale candidates are harmless.
         self.indexes: dict[str, str] = {}
         self._index_data: dict[str, dict] = {}
+        # CDC sink (storage/topic.ChangefeedSink) — committed mutations
+        # publish to a topic in commit order (change_exchange analog)
+        self.changefeed = None
 
     # -- write path -------------------------------------------------------
 
@@ -256,6 +259,9 @@ class RowTable:
             self.store.row_wal_append(self.name, ops, version)
             self.store.save_dictionaries(self)
             self.store.save_state(version.plan_step)
+        if self.changefeed is not None and tx is None \
+                and version is not None and durable:
+            self.changefeed.emit(ops, version)
         return len(appends)
 
     def stamp_tx(self, tx: int, version: WriteVersion,
@@ -275,6 +281,8 @@ class RowTable:
             self.store.row_wal_append(self.name, ops_for_wal, version)
             self.store.save_dictionaries(self)
             self.store.save_state(version.plan_step)
+        if self.changefeed is not None and ops_for_wal:
+            self.changefeed.emit(ops_for_wal, version)
 
     def rollback_tx(self, tx: int) -> None:
         for pk in self._tx_touched.pop(tx, ()):

@@ -114,7 +114,8 @@ class ColumnTable:
             self.store.save_dictionaries(self)
             self.store.save_state(version.plan_step)
 
-    def indexate(self, watermark: Optional[int] = None) -> int:
+    def indexate(self, watermark: Optional[int] = None,
+                 compact: bool = True) -> int:
         """Background indexation across shards (persists portion sets),
         followed by the compaction policy check — the background-controller
         analog (`columnshard_impl.h` background changes): steady small
@@ -123,7 +124,7 @@ class ColumnTable:
         made = 0
         for s in self.shards:
             n = s.indexate()
-            merged = s.compact(watermark)
+            merged = s.compact(watermark) if compact else 0
             made += n
             if self.store is not None and (n or merged):
                 self.store.save_indexation(self, s)

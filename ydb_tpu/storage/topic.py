@@ -1,0 +1,143 @@
+"""Topics: durable partitioned append logs + consumer offsets (+ CDC).
+
+The reference's PersQueue is a partitioned persistent log tablet built on
+the KeyValue tablet (`ydb/core/persqueue/pq_impl.h:32` TPersQueue :
+NKeyValue::TKeyValueFlat, partition actors `partition.cpp`, consumer
+read-offset state per partition) with exactly-once producer dedup by
+(producer id, seq no). Change Data Capture emits DataShard row mutations
+into such topics (`ydb/core/change_exchange/`).
+
+This build keeps the same contracts on the storage substrate it already
+has: a partition IS a CRC-framed WAL (`storage/blobfile.py` — the native
+C++ framing layer), offsets are a JSON manifest, and CDC hooks the row
+table's commit points so only COMMITTED mutations are published, in
+commit order, tagged with their write version — the reference's
+"changefeed sees the transaction's effects atomically" rule.
+
+Messages are dicts (JSON-serializable); producers may pass `seq_no` for
+exactly-once dedup per (producer, partition).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ydb_tpu.storage import blobfile as B
+
+
+class TopicPartition:
+    def __init__(self, path: Optional[str]):
+        self.path = path               # None = volatile (no store)
+        self.records: list = []        # [{offset, data, producer?, seq?}]
+        self._producer_seq: dict = {}  # producer id -> last seq_no
+        if path is not None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            for rec in B.wal_replay(path):
+                self.records.append(rec)
+                p, s = rec.get("producer"), rec.get("seq")
+                if p is not None and s is not None:
+                    self._producer_seq[p] = max(
+                        self._producer_seq.get(p, -1), s)
+
+    @property
+    def end_offset(self) -> int:
+        return len(self.records)
+
+    def append(self, data, producer: Optional[str] = None,
+               seq_no: Optional[int] = None) -> Optional[int]:
+        """Returns the assigned offset, or None when deduplicated
+        (exactly-once: seq_no at or below the producer's high-water)."""
+        if producer is not None and seq_no is not None:
+            if seq_no <= self._producer_seq.get(producer, -1):
+                return None
+            self._producer_seq[producer] = seq_no
+        rec = {"offset": len(self.records), "data": data}
+        if producer is not None and seq_no is not None:
+            rec["producer"] = producer
+            rec["seq"] = seq_no
+        self.records.append(rec)
+        if self.path is not None:
+            B.wal_append(self.path, rec)
+        return rec["offset"]
+
+    def read(self, offset: int, limit: int = 100) -> list:
+        return self.records[offset:offset + limit]
+
+
+class Topic:
+    def __init__(self, name: str, partitions: int,
+                 root: Optional[str] = None):
+        self.name = name
+        self.root = root
+        self.partitions = [
+            TopicPartition(None if root is None
+                           else os.path.join(root, f"part_{i}", "log.bin"))
+            for i in range(partitions)]
+        # committed read offsets: consumer -> [offset per partition]
+        self.offsets: dict[str, list] = {}
+        self._offsets_path = None if root is None \
+            else os.path.join(root, "offsets.json")
+        if self._offsets_path and os.path.exists(self._offsets_path):
+            import json
+            with open(self._offsets_path) as f:
+                self.offsets = {c: list(v)
+                                for c, v in json.load(f).items()}
+
+    def _route(self, key) -> int:
+        if isinstance(key, int):
+            return key % len(self.partitions)
+        import zlib
+        return zlib.crc32(str(key).encode()) % len(self.partitions)
+
+    def write(self, data, partition: Optional[int] = None, key=None,
+              producer: Optional[str] = None,
+              seq_no: Optional[int] = None) -> tuple:
+        """Append one message; returns (partition, offset | None-if-dedup)."""
+        if partition is None:
+            partition = self._route(key) if key is not None else 0
+        off = self.partitions[partition].append(data, producer, seq_no)
+        return partition, off
+
+    def read(self, consumer: str, partition: int, limit: int = 100,
+             offset: Optional[int] = None) -> list:
+        """Read from the consumer's committed offset (or an explicit one)."""
+        start = offset if offset is not None \
+            else self.committed_offset(consumer, partition)
+        return self.partitions[partition].read(start, limit)
+
+    def committed_offset(self, consumer: str, partition: int) -> int:
+        return self.offsets.get(consumer,
+                                [0] * len(self.partitions))[partition]
+
+    def commit_offset(self, consumer: str, partition: int,
+                      offset: int) -> None:
+        offs = self.offsets.setdefault(consumer,
+                                       [0] * len(self.partitions))
+        offs[partition] = offset
+        if self._offsets_path is not None:
+            from ydb_tpu.storage.persist import _atomic_json
+            _atomic_json(self._offsets_path, self.offsets)
+
+
+class ChangefeedSink:
+    """CDC: publishes committed row-table mutations into a topic,
+    partitioned by primary key (per-key ordering, like the reference's
+    changefeed partitioning by key hash)."""
+
+    def __init__(self, topic: Topic, table_name: str,
+                 key_columns: list):
+        self.topic = topic
+        self.table_name = table_name
+        self.key_columns = list(key_columns)
+
+    def emit(self, ops: list, version) -> None:
+        def plain(v):
+            return v.item() if hasattr(v, "item") else v
+        for (kind, vals) in ops:
+            row = {c: plain(v) for c, v in vals.items()}
+            key = tuple(row.get(k) for k in self.key_columns)
+            self.topic.write(
+                {"table": self.table_name, "op": kind, "row": row,
+                 "plan_step": version.plan_step, "tx_id": version.tx_id},
+                key=str(key))

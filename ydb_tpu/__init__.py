@@ -23,4 +23,13 @@ import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
+# pandas 3 defaults str columns/indexes to pyarrow-backed storage, and
+# ArrowStringArray._from_sequence intermittently SEGFAULTS when a
+# DataFrame is constructed on a non-main thread in this image (observed
+# from the pgwire/gRPC server threads). numpy-backed str storage keeps
+# the same dtype semantics without pyarrow on the construction path.
+import pandas as _pd
+
+_pd.set_option("mode.string_storage", "python")
+
 __version__ = "0.1.0"

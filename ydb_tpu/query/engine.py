@@ -46,8 +46,13 @@ class QueryEngine:
         `config`: a `ydb_tpu.utils.config.Config` (YAML-loadable, with
         selector overrides + feature flags); explicit arguments win over
         it."""
+        import threading
         from ydb_tpu.utils.config import Config
         self.config = config or Config.load()
+        # ONE execution lock for every network front (gRPC, pgwire):
+        # engine structures (plan cache, dictionaries, last_stats) are not
+        # thread-safe, and per-front locks would not exclude each other
+        self.lock = threading.Lock()
         block_rows = block_rows if block_rows is not None \
             else self.config.block_rows
         data_dir = data_dir if data_dir is not None \
@@ -152,7 +157,7 @@ class QueryEngine:
         from ydb_tpu.storage.topic import ChangefeedSink
         if not self.catalog.has(table_name):
             raise QueryError(f"unknown table {table_name!r}")
-        t = self.catalog.table(table_name)
+        t = self._table(table_name)
         if getattr(t, "store_kind", "column") != "row":
             raise QueryError("changefeeds are row-store only for now")
         t.changefeed = ChangefeedSink(self.topic(topic_name), table_name,
@@ -202,6 +207,7 @@ class QueryEngine:
         stmt = parse(sql)
         stats.parse_ms = t.lap()
         stats.kind = type(stmt).__name__.lower()
+        self.last_rows_affected = 0
         GLOBAL.inc("engine/statements")
         self.last_stats = stats
         tx = session.tx
@@ -293,7 +299,7 @@ class QueryEngine:
                                      "supported")
                 if not self.catalog.has(stmt.table):
                     raise QueryError(f"unknown table {stmt.table!r}")
-                t = self.catalog.table(stmt.table)
+                t = self._table(stmt.table)
                 if getattr(t, "store_kind", "column") != "row":
                     raise QueryError(
                         "secondary indexes are row-store only (column "
@@ -803,13 +809,20 @@ class QueryEngine:
                                   store_kind=stmt.store)
         return _unit_block()
 
+    def _table(self, name: str):
+        """Catalog lookup with a user-facing error (not a raw KeyError)."""
+        try:
+            return self.catalog.table(name)
+        except KeyError as e:
+            raise QueryError(str(e.args[0])) from e
+
     def _alter_table(self, stmt: ast.AlterTable) -> HostBlock:
         """ADD/DROP COLUMN (the schemeshard alter-table suboperation
         analog): schema evolves in place, old portions serve nulls for
         added columns, the plan cache invalidates via data_version."""
         if not self.catalog.has(stmt.name):
             raise QueryError(f"unknown table {stmt.name!r}")
-        t = self.catalog.table(stmt.name)
+        t = self._table(stmt.name)
         if stmt.action == "add":
             if t.schema.has(stmt.column):
                 raise QueryError(
@@ -843,7 +856,7 @@ class QueryEngine:
         return _unit_block()
 
     def _insert(self, stmt: ast.Insert, snap=None, tx=None) -> HostBlock:
-        table = self.catalog.table(stmt.table)
+        table = self._table(stmt.table)
         if tx is not None:
             tx.lock(table)
         if stmt.query is not None:
@@ -869,6 +882,7 @@ class QueryEngine:
                 ops.append((stmt.mode, {n: data[n][i] for n in names}))
             try:
                 self._apply_row_ops(table, ops, tx)
+                self.last_rows_affected = len(ops)
             except ValueError as e:
                 raise QueryError(str(e)) from e
             return _unit_block()
@@ -901,9 +915,11 @@ class QueryEngine:
             writes = table.write(block, tx=tx.tx_id)
             tx.col_writes.append((table, writes))
             tx.note_self_bump(table)   # staged write bumps data_version
+            self.last_rows_affected = block.length
             return _unit_block()
         writes = table.write(block)
         table.commit(writes, self._next_version())
+        self.last_rows_affected = block.length
         table.indexate(self.coordinator.safe_watermark(),
                        compact=self.config.flag("enable_auto_compaction"))
         return _unit_block()
@@ -934,7 +950,7 @@ class QueryEngine:
     # post-delete state (the distributed-tx layer can tighten this later).
 
     def _update(self, stmt: ast.Update, snap=None, tx=None) -> HostBlock:
-        table = self.catalog.table(stmt.table)
+        table = self._table(stmt.table)
         if tx is not None:
             tx.lock(table)
             if getattr(table, "store_kind", "column") != "row":
@@ -978,6 +994,7 @@ class QueryEngine:
                              for (c, _e) in computed})
                 ops.append(("upsert", vals))
             self._apply_row_ops(table, ops, tx)
+            self.last_rows_affected = len(ops)
             return _unit_block()
         # column table: select full updated rows, drop originals, re-insert
         items = [ast.SelectItem(ast.Name((c,)), c)
@@ -994,10 +1011,11 @@ class QueryEngine:
         if len(df):
             table.bulk_upsert(df[list(table.schema.names)],
                               self._next_version())
+        self.last_rows_affected = len(df)
         return _unit_block()
 
     def _delete(self, stmt: ast.Delete, snap=None, tx=None) -> HostBlock:
-        table = self.catalog.table(stmt.table)
+        table = self._table(stmt.table)
         if tx is not None:
             tx.lock(table)
             if getattr(table, "store_kind", "column") != "row":
@@ -1014,8 +1032,9 @@ class QueryEngine:
                                for k in table.key_columns})
                    for row in df.to_dict("records")]
             self._apply_row_ops(table, ops, tx)
+            self.last_rows_affected = len(ops)
             return _unit_block()
-        self._column_delete(table, stmt.where)
+        self.last_rows_affected = self._column_delete(table, stmt.where)
         return _unit_block()
 
     def _column_delete(self, table, where) -> int:
@@ -1063,6 +1082,7 @@ class QueryEngine:
                        tx=None) -> HostBlock:
         block = self._run_select(stmt.query, snap)
         df = block.to_pandas()
+        self.last_rows_affected = len(df)
         names = stmt.columns or table.schema.names
         if len(df.columns) != len(names):
             raise QueryError("INSERT ... SELECT arity mismatch")

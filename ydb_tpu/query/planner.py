@@ -195,7 +195,10 @@ class Planner:
             if alias in rels or any(s["alias"] == alias
                                     for s in self._left_specs):
                 raise PlanError(f"duplicate alias {alias}")
-            table = self.catalog.table(tref.name)
+            try:
+                table = self.catalog.table(tref.name)
+            except KeyError as e:
+                raise PlanError(str(e.args[0])) from e
             for col in table.schema:
                 scope.add(alias, col.name, B.ColumnBinding(
                     f"{alias}.{col.name}", col.dtype.with_nullable(True),
@@ -388,7 +391,10 @@ class Planner:
             alias = t.alias or t.name
             if alias in rels:
                 raise PlanError(f"duplicate alias {alias}")
-            rels[alias] = _Rel(alias, self.catalog.table(t.name))
+            try:
+                rels[alias] = _Rel(alias, self.catalog.table(t.name))
+            except KeyError as e:
+                raise PlanError(str(e.args[0])) from e
 
         def walk(r):
             if isinstance(r, ast.TableRef):

@@ -1,0 +1,249 @@
+"""PostgreSQL wire-protocol front (v3, simple query flow).
+
+The reference serves the PG wire protocol next to gRPC
+(`ydb/core/local_pgwire/`, `ydb/apps/pgwire` — startup/auth handshake,
+simple `Q` queries, text-format result rows), so any psql-compatible
+client can talk to it. Same here: a threaded TCP server translating the
+v3 message flow onto the embedded engine.
+
+Supported flow:
+  * SSLRequest → 'N' (plaintext), StartupMessage → AuthenticationOk +
+    ParameterStatus + BackendKeyData + ReadyForQuery
+  * 'Q' (simple query) → RowDescription / DataRow* / CommandComplete /
+    ReadyForQuery — text format, one statement per message
+  * BEGIN/COMMIT/ROLLBACK ride the per-connection session, and the
+    ReadyForQuery status byte tracks it ('I' idle / 'T' in tx)
+  * 'X' terminate; errors → ErrorResponse (severity/code/message)
+Extended-protocol messages (Parse/Bind/Execute) answer with a clear
+ErrorResponse — clients in simple-query mode (psql) work.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+
+_SSL_REQUEST = 80877103
+_CANCEL_REQUEST = 80877102
+_PROTO_V3 = 196608
+
+# dtype kind -> (type oid, text encoder)
+_PG_TEXT = "25"
+
+
+def _date_str(days: int) -> str:
+    import datetime
+    return (datetime.date(1970, 1, 1)
+            + datetime.timedelta(days=int(days))).isoformat()
+
+
+def _oid_and_enc(kind: str):
+    from ydb_tpu.core.dtypes import Kind
+    k = Kind(kind)
+    if k in (Kind.INT64, Kind.UINT64):
+        return 20, str
+    if k is Kind.INT32:
+        return 23, str
+    if k is Kind.FLOAT64:
+        return 701, repr
+    if k is Kind.BOOL:
+        return 16, (lambda v: "t" if v else "f")
+    if k is Kind.DATE32:
+        return 1082, _date_str
+    return 25, str                    # STRING and anything else: text
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\0"
+
+
+def _error(message: str, code: str = "XX000") -> bytes:
+    payload = b"S" + _cstr("ERROR") + b"C" + _cstr(code) \
+        + b"M" + _cstr(message) + b"\0"
+    return _msg(b"E", payload)
+
+
+def _ready(status: bytes) -> bytes:
+    return _msg(b"Z", status)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # noqa: C901 — one protocol loop
+        sock: socket.socket = self.request
+        srv: "PgServer" = self.server.owner   # type: ignore[attr-defined]
+        f = sock.makefile("rb")
+
+        def read_exact(n):
+            data = f.read(n)
+            if data is None or len(data) < n:
+                raise ConnectionError
+            return data
+
+        try:
+            # startup (possibly preceded by an SSLRequest)
+            while True:
+                (length,) = struct.unpack("!I", read_exact(4))
+                body = read_exact(length - 4)
+                (proto,) = struct.unpack("!I", body[:4])
+                if proto == _SSL_REQUEST:
+                    sock.sendall(b"N")
+                    continue
+                if proto == _CANCEL_REQUEST:
+                    return
+                if proto != _PROTO_V3:
+                    sock.sendall(_error(f"unsupported protocol {proto}"))
+                    return
+                break
+            out = _msg(b"R", struct.pack("!I", 0))          # AuthenticationOk
+            for k, v in (("server_version", "15.0 (ydb-tpu)"),
+                         ("server_encoding", "UTF8"),
+                         ("client_encoding", "UTF8"),
+                         ("integer_datetimes", "on")):
+                out += _msg(b"S", _cstr(k) + _cstr(v))
+            out += _msg(b"K", struct.pack("!II", 0, 0))     # BackendKeyData
+            out += _ready(b"I")
+            sock.sendall(out)
+
+            session = srv.engine.session()
+            self._aborted = False      # PG aborted-transaction state
+            while True:
+                tag = f.read(1)
+                if not tag or tag == b"X":
+                    return
+                (length,) = struct.unpack("!I", read_exact(4))
+                payload = read_exact(length - 4)
+                if tag == b"Q":
+                    sql = payload.rstrip(b"\0").decode()
+                    sock.sendall(self._run(srv, session, sql))
+                else:
+                    sock.sendall(_error(
+                        f"message {tag.decode(errors='replace')!r} not "
+                        "supported (simple query protocol only)")
+                        + _ready(self._status(session)))
+        except (ConnectionError, BrokenPipeError, struct.error):
+            pass
+        finally:
+            sock.close()
+
+    def _status(self, session) -> bytes:
+        if session.tx is None:
+            return b"I"
+        return b"E" if self._aborted else b"T"
+
+    _DDL_TAGS = {"createtable": "CREATE TABLE", "droptable": "DROP TABLE",
+                 "altertable": "ALTER TABLE", "createindex": "CREATE INDEX",
+                 "dropindex": "DROP INDEX"}
+
+    def _run(self, srv, session, sql: str) -> bytes:
+        if not sql.strip():
+            return _msg(b"I", b"") + _ready(self._status(session))
+        # PG aborted-transaction rule: after an error inside an explicit
+        # tx, everything except ROLLBACK is rejected, and COMMIT rolls
+        # back (answering ROLLBACK) — partial data must not persist
+        first = sql.strip().split(None, 1)[0].lower().rstrip(";")
+        if self._aborted:
+            if first in ("rollback", "commit"):
+                with srv.lock:
+                    try:
+                        srv.engine.execute("rollback", session=session)
+                    except Exception:            # noqa: BLE001
+                        pass
+                self._aborted = False
+                return _msg(b"C", _cstr("ROLLBACK")) \
+                    + _ready(self._status(session))
+            return _error("current transaction is aborted, commands "
+                          "ignored until end of transaction block",
+                          code="25P02") + _ready(self._status(session))
+        # result building (block decode) stays under the same lock as
+        # execution: the engine's structures are not thread-safe
+        with srv.lock:
+            try:
+                block = srv.engine.execute(sql, session=session)
+                kind = srv.engine.last_stats.kind
+                if kind in ("select", "setop", "explain"):
+                    return self._rows(block) \
+                        + _ready(self._status(session))
+                n = getattr(srv.engine, "last_rows_affected", 0)
+            except Exception as e:               # noqa: BLE001 — wire boundary
+                if session.tx is not None:
+                    self._aborted = True
+                return _error(f"{type(e).__name__}: {e}") \
+                    + _ready(self._status(session))
+        tag = {"insert": f"INSERT 0 {n}",
+               "update": f"UPDATE {n}",
+               "delete": f"DELETE {n}",
+               "begin": "BEGIN", "commit": "COMMIT",
+               "rollback": "ROLLBACK",
+               **self._DDL_TAGS}.get(kind, kind.upper())
+        return _msg(b"C", _cstr(tag)) + _ready(self._status(session))
+
+    @staticmethod
+    def _rows(block) -> bytes:
+        """Serialize a result block straight from its column arrays —
+        no pandas on this thread (pyarrow-backed DataFrame construction
+        is not safe off the main thread in this image)."""
+        cols, encs, series = [], [], []
+        for c in block.schema.columns:
+            oid, enc = _oid_and_enc(c.dtype.kind.value)
+            cols.append((c.name, oid))
+            encs.append(enc)
+            cd = block.columns[c.name]
+            if c.dtype.is_string and cd.dictionary is not None:
+                vals = cd.dictionary.decode(cd.data)
+            else:
+                vals = cd.data
+            series.append((vals, cd.valid))
+        desc = struct.pack("!H", len(cols))
+        for (name, oid) in cols:
+            desc += _cstr(name) + struct.pack("!IHIhih", 0, 0, oid, -1,
+                                              -1, 0)
+        chunks = [_msg(b"T", desc)]      # list + join: linear, not O(n^2)
+        ncols_hdr = struct.pack("!H", len(cols))
+        null_cell = struct.pack("!i", -1)
+        for i in range(block.length):
+            body = [ncols_hdr]
+            for (vals, valid), enc in zip(series, encs):
+                v = vals[i]
+                if v is None or (valid is not None and not valid[i]) \
+                        or (isinstance(v, float) and v != v):
+                    body.append(null_cell)
+                else:
+                    if hasattr(v, "item"):
+                        v = v.item()
+                    text = enc(v).encode()
+                    body.append(struct.pack("!I", len(text)) + text)
+            chunks.append(_msg(b"D", b"".join(body)))
+        chunks.append(_msg(b"C", _cstr(f"SELECT {block.length}")))
+        return b"".join(chunks)
+
+
+class PgServer:
+    """Threaded pgwire listener over an embedded engine."""
+
+    def __init__(self, engine, port: int = 0, host: str = "127.0.0.1"):
+        self.engine = engine
+        self.lock = engine.lock   # shared with the gRPC front
+
+        class _TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+        self._tcp = _TCP((host, port), _Handler)
+        self._tcp.owner = self            # type: ignore[attr-defined]
+        self.port = self._tcp.server_address[1]
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+
+def serve_pg(engine, port: int = 0) -> PgServer:
+    return PgServer(engine, port)

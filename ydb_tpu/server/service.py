@@ -75,7 +75,9 @@ class QueryServicer:
     def __init__(self, engine, max_sessions: int = MAX_SESSIONS):
         from collections import OrderedDict
         self.engine = engine
-        self._lock = threading.Lock()
+        # the ENGINE's lock, shared with every other front (pgwire):
+        # per-front locks would not exclude each other
+        self._lock = engine.lock
         self._sessions: "OrderedDict" = OrderedDict()
         self._max_sessions = max_sessions
 

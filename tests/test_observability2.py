@@ -1,0 +1,100 @@
+"""Tracing spans, audit log, and rate limiting.
+
+Reference analogs: Wilson spans + OTLP uploader
+(`ydb/library/actors/wilson/`), the audit sink (`ydb/core/audit`), and
+the Kesus-backed quoter (`ydb/core/quoter/quoter_service.cpp`).
+"""
+
+import pytest
+
+from ydb_tpu.query import QueryEngine
+from ydb_tpu.query.engine import QueryError
+from ydb_tpu.storage import blobfile as B
+from ydb_tpu.utils.quota import Quoter, TokenBucket
+
+
+@pytest.fixture()
+def eng():
+    e = QueryEngine(block_rows=1 << 10)
+    e.execute("create table t (id Int64 not null, v Double, "
+              "primary key (id))")
+    e.execute("insert into t (id, v) values (1, 1.0), (2, 2.0)")
+    return e
+
+
+def test_span_tree_phases(eng):
+    eng.query("select sum(v) as s from t")
+    names = [s.name for s in eng.last_trace]
+    assert names[0] == "statement"
+    assert {"parse", "plan", "execute"} <= set(names)
+    root = eng.last_trace[0]
+    by_id = {s.span_id: s for s in eng.last_trace}
+    for s in eng.last_trace[1:]:
+        assert s.trace_id == root.trace_id
+        assert s.parent_id in by_id          # a connected tree
+    ex = next(s for s in eng.last_trace if s.name == "execute")
+    kids = [s for s in eng.last_trace if s.parent_id == ex.span_id]
+    assert kids, "executor sub-spans attach under execute"
+
+
+def test_explain_analyze_includes_trace(eng):
+    df = eng.query("explain analyze select count(*) as c from t")
+    text = "\n".join(df["plan"])
+    assert "-- trace:" in text and "device-dispatch" in text
+
+
+def test_trace_export_to_topic(eng):
+    eng.create_topic("traces")
+    eng.trace_to_topic("traces")
+    eng.query("select count(*) as c from t")
+    msgs = eng.topic("traces").read("c", 0, limit=10)
+    assert msgs
+    spans = msgs[-1]["data"]["spans"]
+    assert spans[0]["name"] == "statement"
+    assert all(sp["trace_id"] == spans[0]["trace_id"] for sp in spans)
+
+
+def test_audit_log(tmp_path):
+    root = str(tmp_path / "s")
+    eng = QueryEngine(block_rows=1 << 10, data_dir=root)
+    eng.execute("create table a (k Int64 not null, primary key (k))")
+    eng.execute("insert into a (k) values (1), (2)")
+    eng.query("select * from a")              # SELECTs are not audited
+    with pytest.raises(QueryError):
+        eng.execute("insert into a (k) values (null)")
+    recs = B.wal_replay(str(tmp_path / "s" / "audit.bin"))
+    kinds = [(r["kind"], r["status"]) for r in recs]
+    assert ("createtable", "ok") in kinds
+    assert ("insert", "ok") in kinds
+    assert ("insert", "error") in kinds
+    assert all(r["kind"] != "select" for r in recs)
+    ok_insert = next(r for r in recs
+                     if r["kind"] == "insert" and r["status"] == "ok")
+    assert ok_insert["rows"] == 2
+
+
+def test_token_bucket_and_quoter():
+    now = [0.0]
+    b = TokenBucket(rate=2, burst=4, clock=lambda: now[0])
+    assert all(b.try_acquire() for _ in range(4))   # burst drains
+    assert not b.try_acquire()
+    now[0] += 1.0                                   # +2 tokens
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()
+    q = Quoter(clock=lambda: now[0])
+    assert q.acquire("anything")                    # unmetered = unlimited
+    q.set_quota("queries", rate=1, burst=1)
+    assert q.acquire("queries")
+    assert not q.acquire("queries")
+    q.drop_quota("queries")
+    assert q.acquire("queries")
+
+
+def test_engine_admission_throttle(eng):
+    eng.quoter.set_quota("queries", rate=0.001, burst=2)
+    eng.query("select 1 as x")
+    eng.query("select 2 as x")
+    with pytest.raises(QueryError, match="rate limit"):
+        eng.query("select 3 as x")
+    eng.quoter.drop_quota("queries")
+    eng.query("select 4 as x")                      # recovered

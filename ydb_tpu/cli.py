@@ -31,10 +31,17 @@ def cmd_server(args) -> int:
     server, port = serve(eng, port=args.port)
     print(f"ydb_tpu server listening on 127.0.0.1:{port} "
           f"(data_dir={args.data_dir})", flush=True)
+    pg = None
+    if args.pg_port is not None:
+        from ydb_tpu.server.pgwire import serve_pg
+        pg = serve_pg(eng, port=args.pg_port)
+        print(f"pgwire listening on 127.0.0.1:{pg.port}", flush=True)
     try:
         server.wait_for_termination()
     except KeyboardInterrupt:
         server.stop(grace=1)
+        if pg is not None:
+            pg.stop()
     return 0
 
 
@@ -149,6 +156,8 @@ def main(argv=None) -> int:
 
     ps = sub.add_parser("server", help="run the gRPC query service")
     ps.add_argument("--port", type=int, default=2136)
+    ps.add_argument("--pg-port", type=int, default=None,
+                    help="also serve the PostgreSQL wire protocol")
     ps.add_argument("--data-dir", default=None)
     ps.set_defaults(fn=cmd_server)
 

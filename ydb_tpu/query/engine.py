@@ -99,6 +99,17 @@ class QueryEngine:
         self._changefeeds: dict = {}    # table -> topic name
         if self.catalog.store is not None:
             self._load_topics()
+        # tracing (Wilson analog, utils/tracing.py): span tree per
+        # statement, rendered by EXPLAIN ANALYZE; `trace_to_topic()`
+        # wires the OTLP-uploader seat
+        from ydb_tpu.utils.tracing import Tracer
+        self.tracer = Tracer()
+        self.executor.tracer = self.tracer
+        self.last_trace = []
+        # admission rate limiting (Kesus/quoter analog): meter the
+        # "queries" resource via engine.quoter.set_quota(...)
+        from ydb_tpu.utils.quota import Quoter
+        self.quoter = Quoter()
 
     # -- versions (coordinator time, ydb_tpu/tx/coordinator.py) ------------
 
@@ -199,14 +210,72 @@ class QueryEngine:
 
     # -- entry -------------------------------------------------------------
 
-    def execute(self, sql: str, session=None) -> HostBlock:
+    _AUDITED_KINDS = frozenset((
+        "createtable", "droptable", "altertable", "createindex",
+        "dropindex", "insert", "update", "delete", "begin", "commit",
+        "rollback"))
+
+    def execute(self, sql: str, session=None,
+                _internal: bool = False) -> HostBlock:
+        """`_internal`: a re-entrant call from inside another statement
+        (EXPLAIN ANALYZE, forced rollback) — already admitted and audited
+        by its enclosing statement, so the quoter and audit skip it."""
+        if not _internal and not self.quoter.acquire("queries"):
+            from ydb_tpu.utils.metrics import GLOBAL
+            GLOBAL.inc("engine/throttled")
+            raise QueryError("query rate limit exceeded (quoter: the "
+                             "'queries' resource bucket is empty)")
+        self.tracer.begin_trace()
+        kind_box: list = []
+        ok = False
+        try:
+            with self.tracer.span("statement", sql=sql[:60]):
+                block = self._execute_traced(sql, session, kind_box)
+            ok = True
+            return block
+        finally:
+            self.last_trace = self.tracer.end_trace()
+            if not _internal:
+                self._audit(sql, ok, kind_box[0] if kind_box else "")
+
+    def _audit(self, sql: str, ok: bool, kind: str) -> None:
+        """Audit trail for mutating statements (the ydb/core/audit sink):
+        CRC-framed records in <root>/audit.bin, replayable like any WAL.
+        SELECTs are not audited (matching the reference's default); the
+        kind comes from THIS statement's parse (not last_stats, which a
+        nested execute may have reassigned)."""
+        if kind not in self._AUDITED_KINDS or self.catalog.store is None:
+            return
+        import time as _time
+        from ydb_tpu.storage import blobfile as _B
+        try:
+            _B.wal_append(
+                os.path.join(self.catalog.store.root, "audit.bin"),
+                {"ts": _time.time(), "kind": kind, "sql": sql[:500],
+                 "status": "ok" if ok else "error",
+                 "rows": int(getattr(self, "last_rows_affected", 0))},
+                sync=False)
+        except OSError:
+            pass    # auditing must not fail the statement
+
+    def trace_to_topic(self, topic_name: str) -> None:
+        """Export finished traces into a topic (the OTLP uploader seat,
+        `wilson_uploader.cpp`): each trace is one message."""
+        t = self.topic(topic_name)
+        self.tracer.sink = lambda spans: t.write({"spans": spans})
+
+    def _execute_traced(self, sql: str, session=None,
+                        kind_box: Optional[list] = None) -> HostBlock:
         from ydb_tpu.utils.metrics import GLOBAL, QueryStats, Timer
         session = session or self._default_session
         t = Timer()
         stats = QueryStats(sql=sql)
-        stmt = parse(sql)
+        with self.tracer.span("parse"):
+            stmt = parse(sql)
         stats.parse_ms = t.lap()
         stats.kind = type(stmt).__name__.lower()
+        if kind_box is not None:
+            kind_box.append(stats.kind)
         self.last_rows_affected = 0
         GLOBAL.inc("engine/statements")
         self.last_stats = stats
@@ -265,12 +334,14 @@ class QueryEngine:
                     stats.plan_cache_hit = True
                     GLOBAL.inc("engine/plan_cache_hits")
                 else:
-                    plan = self.planner.plan_select(stmt)
+                    with self.tracer.span("plan"):
+                        plan = self.planner.plan_select(stmt)
                     if self.config.flag("enable_plan_cache"):
                         self._plan_cache[sql] = (fp, plan)
                     GLOBAL.inc("engine/plan_cache_misses")
                 stats.plan_ms = t.lap()
-                block = self.executor.execute(plan, snap)
+                with self.tracer.span("execute"):
+                    block = self.executor.execute(plan, snap)
                 self._finish_stats(stats, t, block)
                 return block
             if isinstance(stmt, ast.CreateTable):
@@ -447,8 +518,11 @@ class QueryEngine:
             except (BindError, PlanError, KeyError) as e:
                 raise QueryError(str(e)) from e
         if stmt.analyze:
-            block = self.execute(stmt.sql, session=session)
+            block = self.execute(stmt.sql, session=session, _internal=True)
             lines += self.last_stats.render().split("\n")
+            tr = self.tracer.render()
+            if tr:
+                lines += ["-- trace:"] + tr.split("\n")
         d = Dictionary()
         codes = d.encode(lines)
         schema = Schema([Column("plan", dt.DType(dt.Kind.STRING, False))])

@@ -54,6 +54,8 @@ class Executor:
         # feature flag (utils/config.py): the whole-query single-dispatch
         # path; off = always the portioned streaming path (debug lever)
         self.enable_fused = True
+        # engine-provided tracer (utils/tracing.Tracer) — None = no spans
+        self.tracer = None
         # which path the last execute() took:
         # fused | portioned | distributed | distributed-map | literal
         self.last_path = ""
@@ -62,6 +64,11 @@ class Executor:
         import os as _os
         self.grace_budget_bytes = int(
             _os.environ.get("YDB_TPU_GRACE_BUDGET", 1 << 29))
+
+    def _span(self, name: str, **attrs):
+        from contextlib import nullcontext
+        return self.tracer.span(name, **attrs) if self.tracer is not None \
+            else nullcontext()
 
     # -- entry -------------------------------------------------------------
 
@@ -95,8 +102,9 @@ class Executor:
                                                        snapshot)
                 return self._project_output(merged, plan.output)
 
-        fused = self._try_execute_fused(plan, params, snapshot) \
-            if self.enable_fused else None
+        with self._span("fused-attempt"):
+            fused = self._try_execute_fused(plan, params, snapshot) \
+                if self.enable_fused else None
         if isinstance(fused, HostBlock):
             self.last_path = "fused"
             return self._project_output(fused, plan.output)
@@ -131,7 +139,8 @@ class Executor:
         # the expensive part and must not run for plans that always take
         # the portioned path
         join_steps = [step for kind, step in pipe.steps if kind == "join"]
-        builds = self._prepare_builds(pipe, params, snapshot)
+        with self._span("join-builds", n=len(join_steps)):
+            builds = self._prepare_builds(pipe, params, snapshot)
         for step, bt in zip(join_steps, builds):
             if isinstance(bt, J.PartitionedBuild) or bt.lut is None or (
                     not bt.unique and step.kind in ("inner", "left", "mark")):
@@ -185,8 +194,10 @@ class Executor:
 
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
-        sb = self.device_cache.superblock(table, storage_names, rename,
-                                          snapshot, pipe.scan.prune or None)
+        with self._span("superblock-upload"):
+            sb = self.device_cache.superblock(table, storage_names, rename,
+                                              snapshot,
+                                              pipe.scan.prune or None)
         if sb is None:
             return builds or None          # empty scan → portioned path
         arrays, valids, lengths, K, CAP, sb_dicts = sb
@@ -217,8 +228,9 @@ class Executor:
         dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
                       for k, v in all_params.items()}
         build_inputs = [F.build_traced_inputs(bt) for bt in builds]
-        data_stacks, valid_stack, length = fn(arrays, valids, lengths,
-                                              build_inputs, dev_params)
+        with self._span("device-dispatch", k=K, cap=CAP):
+            data_stacks, valid_stack, length = fn(arrays, valids, lengths,
+                                                  build_inputs, dev_params)
 
         # ONE device→host transfer for the whole result (length included):
         # per-column fetches pay a full link round trip each. Large

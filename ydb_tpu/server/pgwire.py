@@ -151,7 +151,8 @@ class _Handler(socketserver.BaseRequestHandler):
             if first in ("rollback", "commit"):
                 with srv.lock:
                     try:
-                        srv.engine.execute("rollback", session=session)
+                        srv.engine.execute("rollback", session=session,
+                                           _internal=True)
                     except Exception:            # noqa: BLE001
                         pass
                 self._aborted = False

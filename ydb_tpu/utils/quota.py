@@ -1,0 +1,62 @@
+"""Rate limiting: named token buckets (the Kesus/quoter analog).
+
+The reference meters work through a DRR quoter service backed by
+Kesus-managed hierarchical token buckets
+(`ydb/core/quoter/quoter_service.cpp`, `ydb/core/kesus/` — named
+resources with rate/burst, consumers block or shed). Here: named
+buckets with (rate/s, burst) refilled on a monotonic clock; the engine
+consumes from the `queries` resource at statement admission and sheds
+with a throttle error when the bucket is dry — the overload-protection
+seat.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: float,
+                 clock: Optional[Callable[[], float]] = None):
+        if rate <= 0 or burst <= 0:
+            raise ValueError("rate and burst must be positive")
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock or time.monotonic
+        self._tokens = self.burst
+        self._last = self._clock()
+
+    def try_acquire(self, amount: float = 1.0) -> bool:
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+        if self._tokens >= amount:
+            self._tokens -= amount
+            return True
+        return False
+
+
+class Quoter:
+    """Named resource registry: `set_quota("queries", rate, burst)` +
+    `acquire("queries")` at admission points."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self._clock = clock
+        self._buckets: dict[str, TokenBucket] = {}
+
+    def set_quota(self, resource: str, rate: float,
+                  burst: Optional[float] = None) -> None:
+        self._buckets[resource] = TokenBucket(
+            rate, burst if burst is not None else rate,
+            clock=self._clock)
+
+    def drop_quota(self, resource: str) -> None:
+        self._buckets.pop(resource, None)
+
+    def acquire(self, resource: str, amount: float = 1.0) -> bool:
+        """True when admitted: unknown resources are unlimited (the
+        quoter only meters what an operator configured)."""
+        b = self._buckets.get(resource)
+        return True if b is None else b.try_acquire(amount)

@@ -1,0 +1,126 @@
+"""Distributed-tracing spans (the Wilson analog).
+
+The reference threads `NWilson::TTraceId` through actor events and wraps
+phases in `TSpan`s uploaded via OTLP (`ydb/library/actors/wilson/
+wilson_span.h`, `wilson_uploader.cpp`), with per-request sampling decided
+at admission (`ydb/core/jaeger_tracing/`). Here the span tree covers a
+statement's phases (parse → plan → execute, with executor sub-spans for
+build/upload/dispatch/readout); the engine keeps the last trace and can
+publish finished traces into a topic — the OTLP-uploader seat — so a
+consumer can drain them like any changefeed.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: int
+    span_id: int
+    parent_id: Optional[int]
+    start_ms: float
+    dur_ms: float = 0.0
+    attrs: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "trace_id": self.trace_id,
+                "span_id": self.span_id, "parent_id": self.parent_id,
+                "start_ms": round(self.start_ms, 3),
+                "dur_ms": round(self.dur_ms, 3), "attrs": self.attrs}
+
+
+class Tracer:
+    """Per-engine span recorder: a stack-scoped context-manager API.
+
+    One trace per statement (`begin_trace`); `span(name)` nests under the
+    innermost open span. Finished traces go to `sink` (a callable) when
+    set — the engine wires this to a topic for export.
+    """
+
+    def __init__(self):
+        self.spans: list[Span] = []
+        self._stack: list[Span] = []
+        self._trace_id = 0
+        self._depth = 0          # nested execute (EXPLAIN ANALYZE, DML
+        self._t0 = time.perf_counter()  # subflows) joins the outer trace
+        self.sink = None
+
+    def _now(self) -> float:
+        return (time.perf_counter() - self._t0) * 1000.0
+
+    def begin_trace(self) -> int:
+        self._depth += 1
+        if self._depth == 1:
+            self._trace_id = next(_ids)
+            self.spans = []
+            self._stack = []
+        return self._trace_id
+
+    def span(self, name: str, **attrs):
+        return _SpanCtx(self, name, attrs)
+
+    def end_trace(self) -> list[Span]:
+        self._depth = max(0, self._depth - 1)
+        if self._depth > 0:
+            return self.spans
+        out = self.spans
+        if self.sink is not None and out:
+            try:
+                self.sink([s.to_dict() for s in out])
+            except Exception:                    # noqa: BLE001 — export
+                pass                             # must never fail a query
+        return out
+
+    def render(self) -> str:
+        """Indented span tree (the EXPLAIN ANALYZE trace section)."""
+        children: dict = {}
+        roots = []
+        for s in self.spans:
+            if s.parent_id is None:
+                roots.append(s)
+            else:
+                children.setdefault(s.parent_id, []).append(s)
+        lines = []
+
+        def walk(s: Span, depth: int):
+            attrs = "".join(f" {k}={v}" for k, v in s.attrs.items())
+            # still-open spans (EXPLAIN ANALYZE renders mid-statement)
+            # show elapsed-so-far instead of a misleading 0.0
+            dur = s.dur_ms if s not in self._stack \
+                else self._now() - s.start_ms
+            lines.append(f"{'  ' * depth}- {s.name}: "
+                         f"{dur:.1f}ms{attrs}")
+            for c in children.get(s.span_id, []):
+                walk(c, depth + 1)
+        for r in roots:
+            walk(r, 0)
+        return "\n".join(lines)
+
+
+class _SpanCtx:
+    def __init__(self, tracer: Tracer, name: str, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self) -> Span:
+        t = self.tracer
+        parent = t._stack[-1].span_id if t._stack else None
+        self.s = Span(self.name, t._trace_id, next(_ids), parent,
+                      t._now(), attrs=dict(self.attrs))
+        t.spans.append(self.s)
+        t._stack.append(self.s)
+        return self.s
+
+    def __exit__(self, *exc):
+        self.s.dur_ms = self.tracer._now() - self.s.start_ms
+        self.tracer._stack.pop()
+        return False

@@ -122,3 +122,62 @@ def test_string_dictionary_shared_across_shards(rng):
     for i in range(2):
         for b in t.scan_shard(i, ["s"]):
             assert b.columns["s"].dictionary is d
+
+
+def test_ttl_eviction(tmp_path):
+    """Row TTL (the ttl.cpp background-change analog): expired rows evict
+    through the portion-rewrite delete path; config survives restart."""
+    import datetime
+
+    from ydb_tpu.query import QueryEngine
+    from ydb_tpu.query.engine import QueryError
+    import pytest as _pytest
+
+    root = str(tmp_path / "s")
+    eng = QueryEngine(block_rows=1 << 10, data_dir=root)
+    eng.execute("create table ev (id Int64 not null, d Date not null, "
+                "v Double, primary key (id)) "
+                "with (ttl_column = d, ttl_days = 30)")
+    day0 = datetime.date(2023, 6, 1)
+    rows = []
+    for i in range(100):
+        d = day0 + datetime.timedelta(days=i)   # 100 consecutive days
+        rows.append(f"({i}, date '{d.isoformat()}', {i * 1.0})")
+    eng.execute(f"insert into ev (id, d, v) values {','.join(rows)}")
+    # "now" = day 99 + epoch; ttl 30 days → rows older than day 69 evict
+    now = (day0 + datetime.timedelta(days=99)
+           - datetime.date(1970, 1, 1)).days * 86400
+    out = eng.run_ttl(now=now)
+    assert out["ev"] == 69                      # days 0..68 expired
+    df = eng.query("select count(*) as n, min(id) as mn from ev")
+    assert df.n[0] == 31 and df.mn[0] == 69
+    # idempotent at the same clock
+    assert eng.run_ttl(now=now)["ev"] == 0
+    # config survives restart
+    del eng
+    eng2 = QueryEngine(block_rows=1 << 10, data_dir=root)
+    assert eng2.catalog.table("ev").ttl == ("d", 30)
+    out = eng2.run_ttl(now=now + 40 * 86400)
+    assert out["ev"] == 31                      # everything expired now
+    # guards
+    with _pytest.raises(QueryError, match="TTL column"):
+        eng2.execute("create table bad (id Int64 not null, "
+                     "primary key (id)) with (ttl_column = nope, "
+                     "ttl_days = 5)")
+    with _pytest.raises(QueryError, match="positive"):
+        eng2.execute("create table bad (id Int64 not null, d Date not "
+                     "null, primary key (id)) with (ttl_column = d, "
+                     "ttl_days = 0)")
+
+
+def test_ttl_column_cannot_be_dropped():
+    from ydb_tpu.query import QueryEngine
+    from ydb_tpu.query.engine import QueryError
+    import pytest as _pytest
+
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table ev (id Int64 not null, d Date not null, "
+                "primary key (id)) with (ttl_column = d, ttl_days = 5)")
+    with _pytest.raises(QueryError, match="TTL column"):
+        eng.execute("alter table ev drop column d")
+    assert eng.catalog.table("ev").ttl == ("d", 5)

@@ -878,10 +878,55 @@ class QueryEngine:
         cols = [Column(name, sql_type_to_dtype(ty, not_null))
                 for (name, ty, not_null) in stmt.columns]
         pk = stmt.primary_key or [cols[0].name]
-        self.catalog.create_table(stmt.name, Schema(cols), pk,
-                                  shards=max(1, stmt.partition_count),
-                                  store_kind=stmt.store)
+        schema = Schema(cols)
+        if stmt.ttl_column:            # validate BEFORE creating anything
+            from ydb_tpu.core.dtypes import Kind as _K
+            if not schema.has(stmt.ttl_column):
+                raise QueryError(f"unknown TTL column {stmt.ttl_column!r}")
+            if schema.dtype(stmt.ttl_column).kind not in (_K.DATE32,
+                                                          _K.INT64):
+                raise QueryError("TTL column must be Date or Int64 "
+                                 "(unix seconds)")
+            if stmt.ttl_days <= 0:
+                raise QueryError("ttl_days must be positive")
+        t = self.catalog.create_table(stmt.name, schema, pk,
+                                      shards=max(1, stmt.partition_count),
+                                      store_kind=stmt.store)
+        if stmt.ttl_column:
+            t.ttl = (stmt.ttl_column, stmt.ttl_days)
+            if self.catalog.store is not None:
+                self.catalog.store.save_catalog(self.catalog)
         return _unit_block()
+
+    def run_ttl(self, now: Optional[float] = None) -> dict:
+        """Evict expired rows from every TTL-configured table (the
+        background `ttl.cpp` change in the reference — here an explicit
+        maintenance entry point, like `indexate`). `now`: unix seconds
+        (defaults to wall clock; tests pass a fixed value). Returns
+        {table: rows evicted}."""
+        import datetime as _dt
+        import time as _time
+        from ydb_tpu.core.dtypes import Kind as _K
+        now = _time.time() if now is None else now
+        out = {}
+        for name in list(self.catalog.tables):
+            t = self.catalog.table(name)
+            ttl = getattr(t, "ttl", None)
+            if not ttl or getattr(t, "transient", False):
+                continue
+            col, days = ttl
+            if t.schema.dtype(col).kind is _K.DATE32:
+                cutoff_days = int(now // 86400) - days
+                d = _dt.date(1970, 1, 1) + _dt.timedelta(days=cutoff_days)
+                pred = f"{col} < date '{d.isoformat()}'"
+            else:
+                pred = f"{col} < {int(now) - days * 86400}"
+            self.execute(f"delete from {name} where {pred}",
+                         _internal=True)
+            out[name] = self.last_rows_affected
+            from ydb_tpu.utils.metrics import GLOBAL
+            GLOBAL.inc("engine/ttl_evicted", self.last_rows_affected)
+        return out
 
     def _table(self, name: str):
         """Catalog lookup with a user-facing error (not a raw KeyError)."""
@@ -920,6 +965,10 @@ class QueryEngine:
                     or stmt.column in (t.partition_by or []):
                 raise QueryError(
                     f"cannot drop key/partition column {stmt.column!r}")
+            ttl = getattr(t, "ttl", None)
+            if ttl is not None and ttl[0] == stmt.column:
+                raise QueryError(
+                    f"column {stmt.column!r} is the TTL column")
             try:
                 t.drop_column(stmt.column)
             except ValueError as e:     # e.g. column still indexed

@@ -40,6 +40,7 @@ class Catalog:
         else:
             t = ColumnTable(name, schema, key_columns, shards, portion_rows,
                             partition_by)
+        t.transient = transient
         self.tables[name] = t
         if self.store is not None and not transient:
             t.store = self.store

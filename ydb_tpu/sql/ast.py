@@ -215,6 +215,8 @@ class CreateTable:
     primary_key: list                 # list[str]
     partition_count: int = 1
     store: str = "column"             # column | row
+    ttl_column: str = ""              # WITH (ttl_column=..., ttl_days=N)
+    ttl_days: int = 0
     if_not_exists: bool = False
 
 

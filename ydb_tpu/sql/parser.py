@@ -586,7 +586,9 @@ class Parser:
         self.expect_op(")")
         partitions = 1
         store = "column"
-        # WITH (STORE = COLUMN, PARTITION_COUNT = n) — YQL-flavored options
+        # WITH (STORE = COLUMN, PARTITION_COUNT = n, TTL_COLUMN = c,
+        # TTL_DAYS = n) — YQL-flavored options
+        ttl_column, ttl_days = "", 0
         if self.accept_kw("with"):
             self.expect_op("(")
             while True:
@@ -597,11 +599,16 @@ class Parser:
                     partitions = int(val)
                 elif opt == "store":
                     store = str(val).lower()
+                elif opt == "ttl_column":
+                    ttl_column = str(val)
+                elif opt == "ttl_days":
+                    ttl_days = int(val)
                 if not self.accept_op(","):
                     break
             self.expect_op(")")
         return ast.CreateTable(name, columns, pk, partitions, store,
-                               if_not_exists)
+                               ttl_column=ttl_column, ttl_days=ttl_days,
+                               if_not_exists=if_not_exists)
 
     def parse_drop_table(self) -> ast.DropTable:
         self.expect_kw("drop")

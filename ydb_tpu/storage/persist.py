@@ -93,6 +93,7 @@ class Store:
                 "portion_rows": t.shards[0].portion_rows,
                 "store_kind": getattr(t, "store_kind", "column"),
                 "indexes": dict(getattr(t, "indexes", {})),
+                "ttl": list(t.ttl) if getattr(t, "ttl", None) else None,
             }
         _atomic_json(os.path.join(self.root, "catalog.json"),
                      {"tables": metas})
@@ -284,6 +285,8 @@ class Store:
             for c in schema:
                 if c.dtype.is_string and c.name not in t.dictionaries:
                     t.dictionaries[c.name] = Dictionary()
+            if tm.get("ttl"):
+                t.ttl = (tm["ttl"][0], int(tm["ttl"][1]))
 
             if tm.get("store_kind", "column") == "row":
                 wal = os.path.join(self._tdir(name), "rowwal.bin")

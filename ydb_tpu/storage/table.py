@@ -48,6 +48,9 @@ class ColumnTable:
         self.data_version = 0
         # durability hook (ydb_tpu/storage/persist.Store); None = volatile
         self.store = None
+        # row TTL (ttl.cpp analog): (column, days) — expired rows evict
+        # through the portion-rewrite delete path (engine.run_ttl)
+        self.ttl = None
 
     @property
     def num_shards(self) -> int:

@@ -156,3 +156,60 @@ def test_row_drop_readd_survives_restart(tmp_path):
     assert list(df.k) == [1]               # the delete also survived
     assert _nulls(df.v) == [None]          # no resurrection
     assert list(df.tag) == ["keep"]        # other columns intact
+
+
+def test_serial_columns(tmp_path):
+    """SERIAL columns draw from a persisted per-table sequence
+    (sequenceshard analog) that heals past explicit inserts at boot."""
+    root = str(tmp_path / "s")
+    eng = QueryEngine(block_rows=1 << 10, data_dir=root)
+    eng.execute("create table s (id Serial, v Double, primary key (id))")
+    eng.execute("insert into s (v) values (1.0), (2.0)")
+    eng.execute("insert into s (v) values (3.0)")
+    df = eng.query("select id, v from s order by id")
+    assert list(df.id) == [1, 2, 3]
+    eng.execute("insert into s (id, v) values (100, 9.0)")  # explicit id
+    del eng
+    eng2 = QueryEngine(block_rows=1 << 10, data_dir=root)
+    eng2.execute("insert into s (v) values (5.0)")
+    df = eng2.query("select id from s order by id")
+    assert list(df.id) == [1, 2, 3, 100, 101]   # healed past the max
+    # row-store serial
+    eng2.execute("create table r (id Serial, x Int64, primary key (id)) "
+                 "with (store = row)")
+    eng2.execute("insert into r (x) values (7), (8)")
+    del eng2
+    eng3 = QueryEngine(block_rows=1 << 10, data_dir=root)
+    eng3.execute("insert into r (x) values (9)")
+    df = eng3.query("select id, x from r order by id")
+    assert list(df.id) == [1, 2, 3] and list(df.x) == [7, 8, 9]
+
+
+def test_serial_edge_cases(tmp_path):
+    root = str(tmp_path / "s")
+    eng = QueryEngine(block_rows=1 << 10, data_dir=root)
+    eng.execute("create table s (id Serial, v Double, primary key (id))")
+    # explicit values advance the counter in the SAME session
+    eng.execute("insert into s (id, v) values (2, 9.0)")
+    eng.execute("insert into s (v) values (1.0), (2.0)")
+    df = eng.query("select id from s order by id")
+    assert list(df.id) == [2, 3, 4]
+    # INSERT ... SELECT draws from the sequence too
+    eng.execute("insert into s (v) select v + 10 from s")
+    df = eng.query("select id from s order by id")
+    assert list(df.id) == [2, 3, 4, 5, 6, 7]
+    # dropping a serial column clears its counter; boot survives
+    eng.execute("create table r (k Int64 not null, sn Serial, "
+                "primary key (k)) with (store = row)")
+    eng.execute("insert into r (k) values (1)")
+    eng.execute("alter table r drop column sn")
+    eng.execute("insert into r (k) values (2)")
+    del eng
+    eng2 = QueryEngine(block_rows=1 << 10, data_dir=root)
+    assert list(eng2.query("select k from r order by k").k) == [1, 2]
+    # guards
+    with pytest.raises(QueryError, match="ttl_column"):
+        eng2.execute("create table bad (id Int64 not null, "
+                     "primary key (id)) with (ttl_days = 5)")
+    with pytest.raises(QueryError, match="Serial"):
+        eng2.execute("alter table r add column z Serial")

@@ -35,6 +35,7 @@ AGG_NAMES = {"sum", "count", "min", "max", "avg", "some"}
 
 _TYPE_MAP = {
     "int64": dt.Kind.INT64, "bigint": dt.Kind.INT64, "int": dt.Kind.INT32,
+    "serial": dt.Kind.INT64, "bigserial": dt.Kind.INT64,
     "int32": dt.Kind.INT32, "integer": dt.Kind.INT32, "int16": dt.Kind.INT16,
     "int8": dt.Kind.INT8, "uint64": dt.Kind.UINT64, "uint32": dt.Kind.UINT32,
     "uint16": dt.Kind.UINT16, "uint8": dt.Kind.UINT8,

@@ -879,6 +879,8 @@ class QueryEngine:
                 for (name, ty, not_null) in stmt.columns]
         pk = stmt.primary_key or [cols[0].name]
         schema = Schema(cols)
+        if stmt.ttl_days and not stmt.ttl_column:
+            raise QueryError("ttl_days needs ttl_column")
         if stmt.ttl_column:            # validate BEFORE creating anything
             from ydb_tpu.core.dtypes import Kind as _K
             if not schema.has(stmt.ttl_column):
@@ -892,10 +894,15 @@ class QueryEngine:
         t = self.catalog.create_table(stmt.name, schema, pk,
                                       shards=max(1, stmt.partition_count),
                                       store_kind=stmt.store)
+        serial_cols = [n for (n, ty, _nn) in stmt.columns
+                       if ty.lower() in ("serial", "bigserial")]
+        if serial_cols:
+            t.serial_next = {c: 1 for c in serial_cols}
         if stmt.ttl_column:
             t.ttl = (stmt.ttl_column, stmt.ttl_days)
-            if self.catalog.store is not None:
-                self.catalog.store.save_catalog(self.catalog)
+        if (serial_cols or stmt.ttl_column) \
+                and self.catalog.store is not None:
+            self.catalog.store.save_catalog(self.catalog)
         return _unit_block()
 
     def run_ttl(self, now: Optional[float] = None) -> dict:
@@ -955,6 +962,9 @@ class QueryEngine:
                 raise QueryError(
                     "ADD COLUMN NOT NULL needs an empty column table "
                     "(no default-value backfill yet)")
+            if stmt.col_type.lower() in ("serial", "bigserial"):
+                raise QueryError("ADD COLUMN Serial is not supported "
+                                 "(sequences initialize at CREATE TABLE)")
             col = Column(stmt.column,
                          sql_type_to_dtype(stmt.col_type, stmt.not_null))
             t.add_column(col)
@@ -969,6 +979,9 @@ class QueryEngine:
             if ttl is not None and ttl[0] == stmt.column:
                 raise QueryError(
                     f"column {stmt.column!r} is the TTL column")
+            serial = getattr(t, "serial_next", None)
+            if serial is not None:
+                serial.pop(stmt.column, None)
             try:
                 t.drop_column(stmt.column)
             except ValueError as e:     # e.g. column still indexed
@@ -998,6 +1011,30 @@ class QueryEngine:
                 if folded is None:
                     raise QueryError("VALUES must be constant expressions")
                 data[n].append(folded.value)
+
+        # SERIAL columns omitted from the column list draw from the
+        # table's sequence (the sequenceshard analog); counters persist
+        # via the catalog and heal from data maxima at recovery
+        serial = getattr(table, "serial_next", None)
+        if serial:
+            n_rows = len(stmt.rows)
+            changed = False
+            for c, nxt in list(serial.items()):
+                if c not in data:
+                    data[c] = list(range(nxt, nxt + n_rows))
+                    names = list(names) + [c]
+                    serial[c] = nxt + n_rows
+                    changed = True
+                else:
+                    # explicit values advance the counter past their max
+                    # (same-session duplicates, not just post-restart heal)
+                    mx = max((int(v) for v in data[c] if v is not None),
+                             default=0)
+                    if mx >= serial[c]:
+                        serial[c] = mx + 1
+                        changed = True
+            if changed and self.catalog.store is not None:
+                self.catalog.store.save_catalog(self.catalog)
 
         if getattr(table, "store_kind", "column") == "row":
             ops = []
@@ -1210,6 +1247,25 @@ class QueryEngine:
         if len(df.columns) != len(names):
             raise QueryError("INSERT ... SELECT arity mismatch")
         df.columns = names
+        # SERIAL columns draw from the sequence here too (the VALUES path
+        # does the same); explicit values advance the counter
+        serial = getattr(table, "serial_next", None)
+        if serial:
+            changed = False
+            for c, nxt in list(serial.items()):
+                if c not in df.columns:
+                    df[c] = range(nxt, nxt + len(df))
+                    names = list(names) + [c]
+                    serial[c] = nxt + len(df)
+                    changed = True
+                else:
+                    vals = [int(v) for v in df[c] if v is not None]
+                    mx = max(vals, default=0)
+                    if mx >= serial[c]:
+                        serial[c] = mx + 1
+                        changed = True
+            if changed and self.catalog.store is not None:
+                self.catalog.store.save_catalog(self.catalog)
         if getattr(table, "store_kind", "column") == "row":
             # ops carry only the named columns — "upsert" must keep the
             # unmentioned ones, so no null-filling here (apply() enforces

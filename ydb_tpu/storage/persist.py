@@ -94,6 +94,7 @@ class Store:
                 "store_kind": getattr(t, "store_kind", "column"),
                 "indexes": dict(getattr(t, "indexes", {})),
                 "ttl": list(t.ttl) if getattr(t, "ttl", None) else None,
+                "serial_next": dict(getattr(t, "serial_next", {}) or {}),
             }
         _atomic_json(os.path.join(self.root, "catalog.json"),
                      {"tables": metas})
@@ -287,6 +288,9 @@ class Store:
                     t.dictionaries[c.name] = Dictionary()
             if tm.get("ttl"):
                 t.ttl = (tm["ttl"][0], int(tm["ttl"][1]))
+            if tm.get("serial_next"):
+                t.serial_next = {c: int(n)
+                                 for c, n in tm["serial_next"].items()}
 
             if tm.get("store_kind", "column") == "row":
                 wal = os.path.join(self._tdir(name), "rowwal.bin")
@@ -365,5 +369,33 @@ class Store:
                 shard._next_write_id = max([max_wid] + list(staged)) + 1
             # re-arm durability: post-recovery writes must persist too
             t.store = self
+        # heal serial counters against data maxima: the catalog save can
+        # lag a crash that landed after the row data was made durable
+        for t in catalog.tables.values():
+            serial = getattr(t, "serial_next", None)
+            if not serial:
+                continue
+            for col in list(serial):
+                if not t.schema.has(col):
+                    serial.pop(col)   # column dropped after catalog save
+                    continue
+                mx = 0
+                if getattr(t, "store_kind", "column") == "row":
+                    ix = t.schema.names.index(col)
+                    for chain in t.rows.values():
+                        for (_v, vals, _tx) in chain:
+                            if vals is not None and vals[ix] is not None:
+                                mx = max(mx, int(vals[ix]))
+                else:
+                    for sh in t.shards:
+                        for p in sh.portions:
+                            st = p.stats.get(col)
+                            if st is not None and st.max is not None:
+                                mx = max(mx, int(st.max))
+                        for e in sh.inserts:
+                            d = e.block.columns[col].data
+                            if len(d):
+                                mx = max(mx, int(d.max()))
+                serial[col] = max(serial[col], mx + 1)
         catalog.store = self
         return catalog, max(self.load_state(), seen_step)

@@ -157,6 +157,65 @@ class Store:
                           "plan_step": version.plan_step,
                           "tx_id": version.tx_id})
 
+    def commit_table(self, table: str, shard_wids: dict,
+                     version: WriteVersion) -> None:
+        """Atomic multi-shard commit: an INTENT record covering every
+        shard's write ids lands (fsynced) BEFORE the per-shard commit
+        records, and a DONE record after. A crash between shard commits
+        is healed at boot by re-applying intents without a matching DONE
+        — the coordinator plan-step + readset-confirmation shape of the
+        reference, collapsed to one durable journal
+        (`ydb/core/tx/coordinator/coordinator__plan_step.cpp`)."""
+        if len(shard_wids) > 1:
+            self._intent_append(table, {
+                "op": "intent", "plan_step": version.plan_step,
+                "tx_id": version.tx_id,
+                "shards": {str(sid): wids
+                           for sid, wids in shard_wids.items()}})
+        for sid, wids in shard_wids.items():
+            self.wal_commit(table, sid, wids, version)
+        if len(shard_wids) > 1:
+            # losing the DONE is harmless (healing re-applies the commit
+            # idempotently) — skip the second fsync on the commit path
+            self._intent_append(table, {
+                "op": "done", "plan_step": version.plan_step,
+                "tx_id": version.tx_id}, sync=False)
+
+    def _intent_append(self, table: str, rec: dict,
+                       sync: bool = True) -> None:
+        B.wal_append(os.path.join(self._tdir(table), "commits.bin"), rec,
+                     sync=sync)
+
+    @staticmethod
+    def _open_intents(path: str) -> dict:
+        """commits.bin fold: {(plan_step, tx_id): intent rec} for every
+        intent without a matching DONE (shared by recovery healing and
+        compaction — they must never disagree on this)."""
+        out: dict = {}
+        for rec in B.wal_replay(path):
+            key = (rec["plan_step"], rec["tx_id"])
+            if rec["op"] == "intent":
+                out[key] = rec
+            else:
+                out.pop(key, None)
+        return out
+
+    def compact_intents(self, table) -> None:
+        """Drop intents whose write ids no longer exist anywhere (fully
+        indexed) — called from indexation so commits.bin stays bounded."""
+        path = os.path.join(self._tdir(table.name), "commits.bin")
+        if not os.path.exists(path):
+            return
+        pending = {(s.shard_id, e.write_id)
+                   for s in table.shards for e in s.inserts}
+        keep = []
+        for rec in self._open_intents(path).values():
+            if any((int(sid), wid) in pending
+                   for sid, wids in rec["shards"].items()
+                   for wid in wids):
+                keep.append(rec)
+        B.wal_rewrite(path, keep)
+
     def wal_abort(self, table: str, shard: int, wids: list) -> None:
         self._wal_append(self._sdir(table, shard),
                          {"op": "abort", "wids": wids})
@@ -304,6 +363,15 @@ class Store:
                 t.store = self
                 continue
 
+            # open intents first: a tx-tagged write whose own shard
+            # lacks the commit record may still be covered by a torn
+            # multi-shard commit — it must replay, not roll back
+            open_intents = self._open_intents(
+                os.path.join(self._tdir(name), "commits.bin"))
+            intent_wids: dict = {}
+            for rec in open_intents.values():
+                for sid, wids in rec["shards"].items():
+                    intent_wids.setdefault(int(sid), set()).update(wids)
             for shard in t.shards:
                 sdir = self._sdir(name, shard.shard_id)
                 man = _read_json(os.path.join(sdir, "manifest.json"),
@@ -345,7 +413,9 @@ class Store:
                         if not replayable(wid):
                             continue       # baked into portions already
                         if rec.get("tx") is not None \
-                                and wid not in committed_wids:
+                                and wid not in committed_wids \
+                                and wid not in intent_wids.get(
+                                    shard.shard_id, ()):
                             # staged by a tx that died open: its commit
                             # can never arrive — implicit rollback at boot
                             continue
@@ -367,6 +437,20 @@ class Store:
                     if staged[wid].committed_version:
                         shard.rows_written += staged[wid].block.length
                 shard._next_write_id = max([max_wid] + list(staged)) + 1
+            # heal torn multi-shard commits: an INTENT without its DONE
+            # means the crash hit between shard commit records — re-apply
+            # the commit to every shard it covers (idempotent)
+            for (ps, txid), rec in open_intents.items():
+                ver = WriteVersion(ps, txid)
+                seen_step = max(seen_step, ps)
+                for sid, wids in rec["shards"].items():
+                    sh = t.shards[int(sid)]
+                    for e in sh.inserts:
+                        if e.write_id in wids \
+                                and e.committed_version is None:
+                            e.committed_version = ver
+                            e.tx = None
+                            sh.rows_written += e.block.length
             # re-arm durability: post-recovery writes must persist too
             t.store = self
         # heal serial counters against data maxima: the catalog save can

@@ -112,8 +112,8 @@ class ColumnTable:
             self.shards[sid].commit(wids, version)
         self.data_version += 1
         if self.store is not None:
-            for sid, wids in by_shard.items():
-                self.store.wal_commit(self.name, sid, wids, version)
+            # atomic across shards: intent journal + per-shard records
+            self.store.commit_table(self.name, by_shard, version)
             self.store.save_dictionaries(self)
             self.store.save_state(version.plan_step)
 
@@ -131,6 +131,8 @@ class ColumnTable:
             made += n
             if self.store is not None and (n or merged):
                 self.store.save_indexation(self, s)
+        if self.store is not None and made:
+            self.store.compact_intents(self)
         return made
 
     def compact(self, watermark: Optional[int] = None) -> int:

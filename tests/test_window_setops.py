@@ -166,3 +166,52 @@ def test_tx_locks_cover_union_and_window(eng):
     eng.execute("delete from s where id = 0")     # conflicting commit
     with pytest.raises(QueryError, match="optimistic lock"):
         s1.execute("commit")
+
+
+def test_window_inside_expression():
+    """Window functions nested in expressions (the official TPC-DS q98
+    ratio shape) — extracted to hidden frame columns and evaluated in a
+    post pass over the computed frame."""
+    import numpy as np
+
+    from ydb_tpu.query import QueryEngine
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table w (k Int64 not null, g Int64, v Double, "
+                "primary key (k))")
+    eng.execute("insert into w (k, g, v) values "
+                + ",".join(f"({i}, {i % 2}, {float(i)})" for i in range(8)))
+    df = eng.query("select g, v, v * 100 / sum(v) over (partition by g) "
+                   "as ratio from w order by g, v limit 4")
+    assert np.allclose(df.ratio, [0.0, 100 * 2 / 12, 100 * 4 / 12,
+                                  100 * 6 / 12])
+    # mixed plain / pure-window / nested items in one select
+    df = eng.query("select g, v, rank() over (partition by g order by v "
+                   "desc) as r, v - max(v) over (partition by g) as gap "
+                   "from w order by g, v limit 3")
+    assert list(df.gap) == [-6.0, -4.0, -2.0]
+    assert list(df.r) == [4, 3, 2]
+
+
+def test_window_expression_nullable_and_aggregate():
+    """Nested-window regressions: NULL-bearing numeric frames keep their
+    dtype through the post pass, and plain aggregates inside a windowed
+    expression compute in the (grouped) inner select."""
+    import numpy as np
+    import pandas as pd
+
+    from ydb_tpu.query import QueryEngine
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table wn (k Int64 not null, v Double, "
+                "primary key (k))")
+    eng.execute("insert into wn (k, v) values (1, 1.0), (2, null), "
+                "(3, 3.0)")
+    df = eng.query("select v, v / sum(v) over () as r from wn order by v")
+    got = [x if pd.notna(x) else None for x in df.r]
+    assert got == [None, 0.25, 0.75]
+    eng.execute("create table w (k Int64 not null, g Int64, v Double, "
+                "primary key (k))")
+    eng.execute("insert into w (k, g, v) values "
+                + ",".join(f"({i}, {i % 2}, {float(i)})" for i in range(8)))
+    df = eng.query("select g, sum(v) * 100 / sum(sum(v)) over () as share "
+                   "from w group by g order by g")
+    assert np.allclose(df.share, [100 * 12 / 28, 100 * 16 / 28])

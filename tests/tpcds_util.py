@@ -201,8 +201,8 @@ join time_dim t on t.t_time_sk = ss.ss_sold_time_sk
 join store s on s.s_store_sk = ss.ss_store_sk
 where t.t_hour = 20 and t.t_minute >= 30 and hd.hd_dep_count = 7
   and s.s_store_name = 'store_2'""",
-    # q98: revenue share of each item within its class
-    # (windowed class total over an aggregated CTE)
+    # q98: revenue share of each item within its class (the official
+    # windowed-ratio form: the window sits inside the ratio expression)
     "ds98": """
 with rev as (
   select i.i_item_id as i_item_id, i.i_class as i_class,
@@ -213,14 +213,11 @@ with rev as (
   join date_dim d on d.d_date_sk = ss.ss_sold_date_sk
   where i.i_category in ('Sports', 'Books', 'Home') and d.d_year = 1999
     and d.d_moy >= 2 and d.d_moy <= 3
-  group by i.i_item_id, i.i_class, i.i_category),
-w2 as (
-  select i_item_id, i_class, i_category, itemrevenue,
-         sum(itemrevenue) over (partition by i_class) as classrevenue
-  from rev)
+  group by i.i_item_id, i.i_class, i.i_category)
 select i_item_id, i_class, i_category, itemrevenue,
-       itemrevenue * 100 / classrevenue as revenueratio
-from w2
+       itemrevenue * 100 / sum(itemrevenue)
+           over (partition by i_class) as revenueratio
+from rev
 order by i_category, i_class, i_item_id, itemrevenue, revenueratio
 limit 100""",
     # q73 family: frequent buyers via a HAVING derived table joined back

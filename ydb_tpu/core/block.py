@@ -74,7 +74,21 @@ class HostBlock:
             if schema is not None:
                 dtype = schema.dtype(name)
             elif s.dtype == object or str(s.dtype) in ("string", "str"):
-                dtype = dt.STRING
+                # object dtype is how pandas renders NULL-bearing NUMERIC
+                # columns too (to_pandas emits them that way) — classify
+                # by the non-null values, not the container dtype
+                nonnull = s.dropna()
+                if len(nonnull) and all(
+                        isinstance(v, (int, float, np.integer, np.floating))
+                        and not isinstance(v, bool)
+                        for v in nonnull.tolist()):
+                    if all(isinstance(v, (int, np.integer))
+                           for v in nonnull.tolist()):
+                        dtype = dt.DType(dt.Kind.INT64, True)
+                    else:
+                        dtype = dt.DType(dt.Kind.FLOAT64, True)
+                else:
+                    dtype = dt.STRING
             else:
                 dtype = dt.from_numpy(s.dtype)
             if dtype.is_string:

@@ -589,11 +589,39 @@ class QueryEngine:
         from ydb_tpu.query import window as W
         snap = snap or self.snapshot()
         try:
-            inner, outer = W.split_windowed(sel)
+            inner, outer, post = W.split_windowed(sel)
         except ValueError as e:
             raise QueryError(str(e)) from e
         inner_block = self._run_select(inner, snap)
         df = W.compute_windows(inner_block.to_pandas(), outer)
+        if post is not None:
+            # window results used INSIDE expressions: evaluate the
+            # rewritten items as a second pass over the computed frame.
+            # NULL-bearing numeric columns come back from to_pandas as
+            # object dtype — coerce them back, or from_pandas would
+            # classify them as STRING and the post arithmetic would run
+            # on dictionary codes
+            import pandas as pd
+            win_cols = {p["alias"] for k, p in outer if k == "win"}
+            for c in df.columns:
+                if df[c].dtype != object:
+                    continue
+                numeric = c in win_cols or (
+                    inner_block.schema.has(c)
+                    and not inner_block.schema.dtype(c).is_string)
+                if numeric:
+                    df[c] = pd.to_numeric(df[c])
+            temps: list = []
+            try:
+                tname = self._register_temp(HostBlock.from_pandas(df),
+                                            temps, snap)
+                final = ast.Select(items=post,
+                                   relation=ast.TableRef(tname))
+                df = self._run_select(final, snap).to_pandas()
+            finally:
+                for tn in temps:
+                    if self.catalog.has(tn):
+                        self.catalog.drop_table(tn)
         if sel.distinct:
             df = df.drop_duplicates(ignore_index=True)
         try:

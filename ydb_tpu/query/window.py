@@ -25,45 +25,128 @@ WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "min", "max",
                 "count", "avg"}
 
 
+def _contains_window(e) -> bool:
+    if isinstance(e, ast.WindowFunc):
+        return True
+    if not hasattr(e, "__dataclass_fields__"):
+        return False
+
+    def any_in(v):
+        if isinstance(v, tuple):
+            return any(any_in(x) for x in v)
+        return hasattr(v, "__dataclass_fields__") and _contains_window(v)
+    return any(any_in(getattr(e, f)) for f in e.__dataclass_fields__)
+
+
 def has_window(sel: ast.Select) -> bool:
-    return any(isinstance(i.expr, ast.WindowFunc) for i in sel.items)
+    return any(_contains_window(i.expr) for i in sel.items
+               if not isinstance(i.expr, ast.Star))
 
 
 def split_windowed(sel: ast.Select):
-    """Split a windowed select into (inner select, outer plan).
+    """Split a windowed select into (inner select, outer plan, post).
 
     inner: every non-window item plus synthesized aliases for each window
     function's args / partition keys / order keys.
     outer: ordered [(kind, payload)] describing how to assemble the final
     frame — ("col", alias) or ("win", spec dict).
+    post: None, or final SelectItems to evaluate over the computed frame
+    when a window function appears INSIDE an expression (e.g. the q98
+    ratio `rev * 100 / sum(rev) over (partition by class)`) — those
+    expressions run as a second engine pass over the frame.
     """
     inner_items: list = []
     outer: list = []
+    post_items: list = []
+    any_nested = False
+
+    def win_spec(e: ast.WindowFunc, alias: str, tag: str) -> dict:
+        if e.func not in WINDOW_FUNCS:
+            raise ValueError(f"unsupported window function {e.func}")
+        if e.distinct:
+            raise ValueError(
+                "DISTINCT inside a window function is not supported")
+        spec = {"func": e.func, "args": [], "part": [], "order": [],
+                "asc": [], "alias": alias}
+        for j, a in enumerate(e.args):
+            al = f"__{tag}a{j}"
+            inner_items.append(ast.SelectItem(a, al))
+            spec["args"].append(al)
+        for j, p in enumerate(e.partition_by):
+            al = f"__{tag}p{j}"
+            inner_items.append(ast.SelectItem(p, al))
+            spec["part"].append(al)
+        for j, o in enumerate(e.order_by):
+            al = f"__{tag}o{j}"
+            inner_items.append(ast.SelectItem(o.expr, al))
+            spec["order"].append(al)
+            spec["asc"].append(o.ascending)
+        return spec
+
+    name_map: dict = {}
+    agg_map: dict = {}
+    wx_count = [0]
+
+    def rewrite(e):
+        """Replace nested WindowFuncs with frame-column refs, plain
+        AGGREGATES with inner-select aliases (the inner select carries
+        the GROUP BY — `sum(v) * 100 / sum(sum(v)) over ()` needs sum(v)
+        computed there, not over the frame), and source Names with
+        passthrough aliases (the frame is a temp table; the original
+        scope is gone by the time the post pass runs)."""
+        import dataclasses
+        from ydb_tpu.query.binder import AGG_NAMES
+        if isinstance(e, ast.WindowFunc):
+            alias = f"__wx{wx_count[0]}"
+            wx_count[0] += 1
+            outer.append(("win", win_spec(e, alias, alias.strip("_"))))
+            return ast.Name((alias,))
+        if isinstance(e, ast.FuncCall) and e.name in AGG_NAMES \
+                and not _contains_window(e):
+            key = repr(e)
+            al = agg_map.get(key)
+            if al is None:
+                al = f"__wg{len(agg_map)}"
+                agg_map[key] = al
+                inner_items.append(ast.SelectItem(e, al))
+                outer.append(("col", al))
+            return ast.Name((al,))
+        if isinstance(e, ast.Name):
+            al = name_map.get(e.parts)
+            if al is None:
+                al = f"__wc{len(name_map)}"
+                name_map[e.parts] = al
+                inner_items.append(ast.SelectItem(e, al))
+                outer.append(("col", al))
+            return ast.Name((al,))
+        if not hasattr(e, "__dataclass_fields__"):
+            return e
+
+        def rw(v):
+            if isinstance(v, tuple):
+                return tuple(rw(x) for x in v)
+            if hasattr(v, "__dataclass_fields__"):
+                return rewrite(v)
+            return v
+        return dataclasses.replace(
+            e, **{f: rw(getattr(e, f)) for f in e.__dataclass_fields__})
+
+    nested = [not isinstance(i.expr, ast.WindowFunc)
+              and _contains_window(i.expr) for i in sel.items]
+    any_nested = any(nested)
+
     for idx, item in enumerate(sel.items):
         e = item.expr
+        if nested[idx]:
+            alias = item.alias or f"column{idx}"
+            post_items.append(ast.SelectItem(rewrite(e), alias))
+            continue
         if isinstance(e, ast.WindowFunc):
-            if e.func not in WINDOW_FUNCS:
-                raise ValueError(f"unsupported window function {e.func}")
-            if e.distinct:
-                raise ValueError(
-                    "DISTINCT inside a window function is not supported")
-            spec = {"func": e.func, "args": [], "part": [], "order": [],
-                    "asc": [],
-                    "alias": item.alias or f"column{idx}"}
-            for j, a in enumerate(e.args):
-                al = f"__w{idx}a{j}"
-                inner_items.append(ast.SelectItem(a, al))
-                spec["args"].append(al)
-            for j, p in enumerate(e.partition_by):
-                al = f"__w{idx}p{j}"
-                inner_items.append(ast.SelectItem(p, al))
-                spec["part"].append(al)
-            for j, o in enumerate(e.order_by):
-                al = f"__w{idx}o{j}"
-                inner_items.append(ast.SelectItem(o.expr, al))
-                spec["order"].append(al)
-                spec["asc"].append(o.ascending)
-            outer.append(("win", spec))
+            alias = item.alias or f"column{idx}"
+            outer.append(("win", win_spec(e, alias, f"w{idx}")))
+            if any_nested:
+                post_items.append(ast.SelectItem(ast.Name((alias,)),
+                                                 alias))
         else:
             alias = item.alias
             if alias is None and isinstance(e, ast.Name):
@@ -71,13 +154,16 @@ def split_windowed(sel: ast.Select):
             alias = alias or f"column{idx}"
             inner_items.append(ast.SelectItem(e, alias))
             outer.append(("col", alias))
+            if any_nested:
+                post_items.append(ast.SelectItem(ast.Name((alias,)),
+                                                 alias))
     # SQL applies DISTINCT to the FINAL output, after window evaluation —
     # the engine dedups the computed frame, never the inner query
     inner = ast.Select(items=inner_items, relation=sel.relation,
                        where=sel.where, group_by=list(sel.group_by),
                        having=sel.having, distinct=False)
     inner.ctes = list(sel.ctes)
-    return inner, outer
+    return inner, outer, (post_items if any_nested else None)
 
 
 def compute_windows(df: pd.DataFrame, outer: list) -> pd.DataFrame:

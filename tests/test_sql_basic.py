@@ -205,6 +205,50 @@ def test_not_in_null_probe():
     ).c[0] == 3
 
 
+def test_not_in_null_in_build():
+    # x NOT IN (set containing NULL) is never TRUE (NULL or FALSE for
+    # every x) → the whole filter yields zero rows
+    e = QueryEngine()
+    e.execute("create table nba (id Int32 not null, x Int32 not null, "
+              "primary key (id))")
+    e.execute("create table nbb (id Int32 not null, y Int32, "
+              "primary key (id))")
+    e.execute("insert into nba (id, x) values (1, 10), (2, 20)")
+    e.execute("insert into nbb (id, y) values (1, 10), (2, null)")
+    assert e.query(
+        "select count(*) as c from nba where x not in (select y from nbb)"
+    ).c[0] == 0
+    df = e.query("select id from nba where x not in (select y from nbb)")
+    assert len(df) == 0
+
+
+def test_host_lane_guard_refuses_large_frames():
+    # windows / set-op combine run host-side; above host_lane_max_rows
+    # they refuse loudly instead of silently going single-core
+    from ydb_tpu.utils.config import Config
+    from ydb_tpu.utils.metrics import GLOBAL
+    cfg = Config(host_lane_max_rows=4)
+    e = QueryEngine(config=cfg)
+    e.execute("create table hg (id Int32 not null, v Int32 not null, "
+              "primary key (id))")
+    e.execute("insert into hg (id, v) values "
+              + ",".join(f"({i}, {i})" for i in range(10)))
+    before = GLOBAL.snapshot().get("engine/host_lane/window_rows", 0)
+    with pytest.raises(QueryError, match="host-fallback lane refused"):
+        e.query("select id, sum(v) over (order by id) as r from hg")
+    assert GLOBAL.snapshot()["engine/host_lane/window_rows"] == before + 10
+    with pytest.raises(QueryError, match="host-fallback lane refused"):
+        e.query("select id from hg union select v from hg")
+    # under the limit both lanes still work
+    cfg2 = Config(host_lane_max_rows=1 << 20)
+    e2 = QueryEngine(config=cfg2)
+    e2.execute("create table hg2 (id Int32 not null, v Int32 not null, "
+               "primary key (id))")
+    e2.execute("insert into hg2 (id, v) values (1, 5), (2, 6)")
+    df = e2.query("select id, sum(v) over (order by id) as r from hg2")
+    assert list(df.r) == [5, 11]
+
+
 def test_qualified_star_join():
     e = QueryEngine()
     e.execute("create table qa (id Int32 not null, x Int32, primary key (id))")

@@ -183,6 +183,7 @@ def build_traced_inputs(bt) -> dict:
         "lut": bt.lut,
         "lut_base": jnp.int64(bt.lut_base),
         "n": jnp.int32(bt.n),
+        "has_null": jnp.bool_(bt.anti_has_null),
         "payload": dict(bt.payload),
         "pvalid": dict(bt.payload_valid),
     }

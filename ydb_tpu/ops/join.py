@@ -72,6 +72,10 @@ class BuildTable:
     unique: bool
     lut: object = None             # jnp int32 (span,) or None
     lut_base: int = 0              # key value of lut[0]
+    # NOT IN: the build side contained a NULL key — x NOT IN S is then
+    # never TRUE for any x (NULL or FALSE), so a not_in anti probe must
+    # select nothing. Set by the executor's anti-null check.
+    anti_has_null: bool = False
 
 
 def build(block: HostBlock, key: str, payload_names: list[str]) -> BuildTable:
@@ -130,7 +134,8 @@ def place(table: BuildTable, device) -> BuildTable:
         {k: put(v) for k, v in table.payload.items()},
         {k: put(v) for k, v in table.payload_valid.items()},
         table.schema, table.dictionaries, table.unique,
-        None if table.lut is None else put(table.lut), table.lut_base)
+        None if table.lut is None else put(table.lut), table.lut_base,
+        table.anti_has_null)
 
 
 @dataclass
@@ -206,6 +211,10 @@ def probe_lut_traced(env: dict, sel, bt_arrays: dict, meta: dict):
     out_sel, gathered, gathered_valid = _select_and_gather(
         found, safe, active, v, bt_arrays["n"], kind, meta["not_in"],
         bt_arrays["payload"], bt_arrays["pvalid"], meta["src_names"])
+
+    if kind == "left_anti" and meta["not_in"]:
+        # a NULL in the build set makes NOT IN never-true for every row
+        out_sel = out_sel & ~bt_arrays["has_null"]
 
     env2 = dict(env)
     for src, out in zip(meta["src_names"], meta["payload_names"]):
@@ -298,6 +307,10 @@ def probe(dblock: DeviceBlock, table: BuildTable, probe_key: str,
         dblock.arrays, dblock.valids, dblock.length, sel, jnp.int32(table.n),
         table.keys_sorted, table.payload, table.payload_valid,
         probe_key, kind, names, not_in)
+    if kind == "left_anti" and not_in and table.anti_has_null:
+        # NULL in the build set: NOT IN is never TRUE (host-static — the
+        # flag is known at build time, no traced input needed here)
+        out_sel = jnp.zeros_like(out_sel)
 
     arrays = dict(dblock.arrays)
     valids = dict(dblock.valids)

@@ -576,10 +576,28 @@ class QueryEngine:
                 raise QueryError("UNION arms have different arity")
             right.columns = left.columns
             out = pd.concat([left, right], ignore_index=True)
+            # the combined frame is the actual host job — guard it too
+            # (N arms each under the limit can still concat over it)
+            self._host_lane_guard(len(out), "setop")
             if node.op == "union":
                 out = out.drop_duplicates(ignore_index=True)
             return out
-        return self._run_select(node, snap).to_pandas()
+        arm = self._run_select(node, snap)
+        self._host_lane_guard(arm.length, "setop")
+        return arm.to_pandas()
+
+    def _host_lane_guard(self, rows: int, lane: str) -> None:
+        """Host pandas lanes (windows, set-op combine) degrade loudly: a
+        counter records the rows crossing to host, and frames above the
+        configured limit refuse instead of silently becoming single-core
+        pandas jobs."""
+        from ydb_tpu.utils.metrics import GLOBAL
+        GLOBAL.inc(f"engine/host_lane/{lane}_rows", rows)
+        if rows > self.config.host_lane_max_rows:
+            raise QueryError(
+                f"{lane} host-fallback lane refused a {rows}-row frame "
+                f"(host_lane_max_rows={self.config.host_lane_max_rows}; "
+                f"raise it in config to accept the single-core cost)")
 
     def _execute_windowed(self, sel: ast.Select,
                           snap: Optional[Snapshot] = None) -> HostBlock:
@@ -593,6 +611,7 @@ class QueryEngine:
         except ValueError as e:
             raise QueryError(str(e)) from e
         inner_block = self._run_select(inner, snap)
+        self._host_lane_guard(inner_block.length, "window")
         df = W.compute_windows(inner_block.to_pandas(), outer)
         if post is not None:
             # window results used INSIDE expressions: evaluate the

@@ -573,12 +573,20 @@ class Executor:
         if step.build_hash_keys:
             built = _add_hash_column(built, step.build_hash_keys,
                                      step.build_key)
+        anti_has_null = False
         if step.anti_null_check:
             cd = built.columns[step.anti_null_col or step.build_key]
             if cd.valid is not None and not cd.valid.all():
-                raise NotImplementedError(
-                    "NOT IN over a subquery producing NULLs (SQL: always "
-                    "empty) is not supported yet")
+                if step.kind == "left_anti":
+                    # x NOT IN (set with NULL) is never TRUE → the anti
+                    # probe selects nothing (SQL three-valued logic)
+                    anti_has_null = True
+                else:
+                    # composite correlated NOT IN: a NULL poisons only its
+                    # per-correlation-key set — needs per-key tracking
+                    raise NotImplementedError(
+                        "correlated NOT IN over a subquery producing NULLs "
+                        "is not supported yet")
         # GraceJoin spill: a build side above the device budget hash-
         # partitions into host DRAM (single-device path only — the mesh
         # path replicates builds per device and would need partition
@@ -591,7 +599,9 @@ class Executor:
                 return J.build_partitioned(built, step.build_key,
                                            list(step.payload),
                                            self.grace_budget_bytes)
-        return J.build(built, step.build_key, list(step.payload))
+        bt = J.build(built, step.build_key, list(step.payload))
+        bt.anti_has_null = anti_has_null
+        return bt
 
     def _scan_device_blocks(self, pipe: Pipeline, snapshot: Snapshot,
                             devices=None):

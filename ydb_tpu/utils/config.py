@@ -42,6 +42,10 @@ class Config:
     grace_budget_bytes: int = 1 << 29
     data_dir: Optional[str] = None
     server_port: int = 2136
+    # host fallback lanes (window functions, set-op combine) refuse frames
+    # above this many rows — a silent single-core pandas job over a huge
+    # frame is a perf trap; raise the limit explicitly to accept it
+    host_lane_max_rows: int = 8 << 20
     feature_flags: dict = field(default_factory=lambda: dict(DEFAULT_FLAGS))
 
     def flag(self, name: str) -> bool:
@@ -71,7 +75,7 @@ class Config:
         if unknown:
             raise ValueError(f"unknown feature flags: {sorted(unknown)}")
         known = {"block_rows", "grace_budget_bytes", "data_dir",
-                 "server_port"}
+                 "server_port", "host_lane_max_rows"}
         bad = set(merged) - known
         if bad:
             raise ValueError(f"unknown config keys: {sorted(bad)}")

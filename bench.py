@@ -1,22 +1,28 @@
-"""Benchmark: TPC-H Q1 scan+aggregate throughput on the device.
+"""Benchmark: TPC-H on the device — Q1 headline + full 22-query suites.
 
-Runs the full SQL path (parse → plan → pushdown → ONE fused device
-program per query) over a generated TPC-H lineitem at BENCH_SF, and an
-independent CPU baseline (pandas) over the same data — the measured analog
-of the reference's `ydb workload tpch run` (no published numbers exist
-in-repo; see BASELINE.md).
+Runs the full SQL path (parse → plan → pushdown → fused/tiled device
+programs) over generated TPC-H data — the measured analog of the
+reference's `ydb workload tpch run` (no published numbers exist in-repo;
+see BASELINE.md):
 
-Each timed iteration is a complete query: SQL text in, verified pandas
-DataFrame out (device dispatch + device→host result readout included).
+  * headline: Q1 at BENCH_SF (default 1) — scan+agg rows/s vs a pandas
+    CPU baseline over the same data (continuity with earlier rounds);
+  * suites: all 22 queries at each scale factor in BENCH_SUITE_SFS
+    (default "1,10"), best-of-2 per query, geomean reported. At SF ≤ 1
+    every query is correctness-gated against the pandas oracle; above
+    that a fast subset gates (full-oracle joins at SF10 cost minutes of
+    single-core pandas each — the suite stays within BENCH_BUDGET_S).
 
 Prints a per-phase breakdown to stderr and ONE JSON line to stdout:
   {"metric": "tpch_q1_rows_per_sec", "value": N, "unit": "rows/s",
-   "vs_baseline": device_throughput / pandas_cpu_throughput}
+   "vs_baseline": ratio, "suites": {"sf1": {...}, "sf10": {...}}}
 """
 
 from __future__ import annotations
 
+import gc
 import json
+import math
 import os
 import sys
 import time
@@ -25,47 +31,59 @@ import numpy as np
 
 SF = float(os.environ.get("BENCH_SF", "1"))
 REPEATS = int(os.environ.get("BENCH_REPEATS", "5"))
+SUITE_SFS = [float(s) for s in
+             os.environ.get("BENCH_SUITE_SFS", "1,10").split(",") if s]
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+SUITE_REPEATS = int(os.environ.get("BENCH_SUITE_REPEATS", "2"))
+# oracle-gated queries at SF > 1 (fast single-table oracles)
+GATE_BIG = ("q1", "q6", "q12", "q14")
+
+_T0 = time.perf_counter()
 
 
 def log(msg: str) -> None:
-    print(msg, file=sys.stderr, flush=True)
+    print(f"[bench {time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    t0 = time.perf_counter()
+def geomean(xs):
+    return math.exp(sum(math.log(x) for x in xs) / len(xs)) if xs else 0.0
+
+
+def run_headline():
     from ydb_tpu.bench.tpch_gen import load_tpch
     from ydb_tpu.query import QueryEngine
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from tests.tpch_util import QUERIES, oracle
 
+    t0 = time.perf_counter()
     eng = QueryEngine(block_rows=1 << 20)
     data = load_tpch(eng.catalog, sf=SF)
     n_rows = eng.catalog.table("lineitem").num_rows
-    log(f"[bench] generate+load sf={SF} ({n_rows} lineitem rows): "
+    log(f"generate+load sf={SF} ({n_rows} lineitem rows): "
+        f"{time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    warm = eng.prewarm()
+    log(f"prewarm: {warm / 1e9:.2f}GB in HBM, "
         f"{time.perf_counter() - t0:.1f}s")
 
     q1 = QUERIES["q1"]
     t0 = time.perf_counter()
-    eng.query(q1)          # warm-up: compile + superblock upload
-    log(f"[bench] first run (compile + HBM upload): "
+    eng.query(q1)          # warm-up: compile + HBM upload
+    log(f"q1 first run (compile + HBM upload): "
         f"{time.perf_counter() - t0:.1f}s")
-
     times = []
     for _ in range(REPEATS):
         t0 = time.perf_counter()
         got = eng.query(q1)
         times.append(time.perf_counter() - t0)
     device_t = min(times)
-    log(f"[bench] q1 per-iteration ms: "
-        f"{[round(t * 1000, 1) for t in times]} "
-        f"(fused plans: {len(eng.executor._fused_cache)}, "
-        f"plan-cache hits: {eng.plan_cache_hits})")
+    log(f"q1 per-iteration ms: {[round(t * 1000, 1) for t in times]} "
+        f"(path: {eng.executor.last_path})")
 
     t0 = time.perf_counter()
     want = oracle("q1", data)
     cpu_t = time.perf_counter() - t0
-    log(f"[bench] pandas oracle: {cpu_t:.2f}s "
-        f"({n_rows / cpu_t / 1e6:.2f} Mrows/s)")
+    log(f"pandas q1 oracle: {cpu_t:.2f}s ({n_rows / cpu_t / 1e6:.2f} Mrows/s)")
 
     # correctness gate: a fast wrong answer scores zero
     want_sorted = want.sort_values(["l_returnflag", "l_linestatus"])
@@ -77,13 +95,112 @@ def main() -> None:
         want_sorted["count_order"].to_numpy(dtype=np.int64))
 
     value = n_rows / device_t
-    log(f"[bench] q1: {device_t * 1000:.1f}ms best "
-        f"({value / 1e6:.2f} Mrows/s, {value / (n_rows / cpu_t):.1f}x pandas)")
+    log(f"q1: {device_t * 1000:.1f}ms best ({value / 1e6:.2f} Mrows/s, "
+        f"{value / (n_rows / cpu_t):.1f}x pandas)")
+    return eng, data, value, value / (n_rows / cpu_t)
+
+
+def run_suite(sf: float, eng=None, data=None) -> dict:
+    from ydb_tpu.bench.tpch_gen import load_tpch
+    from ydb_tpu.query import QueryEngine
+    from tests.tpch_util import (
+        QUERIES, assert_frames_match, frames, oracle,
+    )
+
+    if eng is None:
+        t0 = time.perf_counter()
+        eng = QueryEngine(block_rows=1 << 20)
+        data = load_tpch(eng.catalog, sf=sf)
+        log(f"suite sf={sf}: load {time.perf_counter() - t0:.1f}s")
+        t0 = time.perf_counter()
+        warm = eng.prewarm()
+        log(f"suite sf={sf}: prewarm {warm / 1e9:.2f}GB, "
+            f"{time.perf_counter() - t0:.1f}s")
+    n_rows = eng.catalog.table("lineitem").num_rows
+
+    per_ms, ratios, paths, skipped = {}, {}, {}, []
+    checked = []
+    for name in QUERIES:
+        if time.perf_counter() - _T0 > BUDGET_S:
+            skipped.append(name)
+            continue
+        sql = QUERIES[name]
+        try:
+            t0 = time.perf_counter()
+            got = eng.query(sql)            # compile + first run
+            first = time.perf_counter() - t0
+            times = [first]
+            for _ in range(SUITE_REPEATS):
+                t0 = time.perf_counter()
+                got = eng.query(sql)
+                times.append(time.perf_counter() - t0)
+            best = min(times)
+            per_ms[name] = round(best * 1000, 1)
+            paths[name] = eng.executor.last_path
+            gate = sf <= 1 or name in GATE_BIG
+            if gate:
+                t0 = time.perf_counter()
+                want = oracle(name, data)
+                cpu_t = time.perf_counter() - t0
+                want.columns = list(got.columns)
+                ordered = True
+                assert_frames_match(got, want, ordered=ordered,
+                                    rtol=1e-6 if sf > 1 else 1e-9)
+                checked.append(name)
+                ratios[name] = round(cpu_t / best, 1)
+            log(f"sf={sf} {name}: {per_ms[name]}ms "
+                f"[{paths[name]}]"
+                + (f" oracle ok, {ratios[name]}x" if name in ratios else ""))
+        except Exception as e:                          # noqa: BLE001
+            log(f"sf={sf} {name}: FAILED {type(e).__name__}: {str(e)[:120]}")
+            per_ms[name] = None
+    ok = [v for v in per_ms.values() if v]
+    out = {
+        "sf": sf,
+        "lineitem_rows": int(n_rows),
+        "completed": len(ok),
+        "failed": sorted(k for k, v in per_ms.items() if v is None),
+        "skipped_for_budget": skipped,
+        "geomean_ms": round(geomean(ok), 1),
+        "per_query_ms": per_ms,
+        "paths": paths,
+        "oracle_checked": checked,
+        "vs_pandas": ratios,
+        "vs_pandas_geomean": round(geomean(list(ratios.values())), 1)
+        if ratios else None,
+    }
+    log(f"suite sf={sf}: {len(ok)}/22 ok, geomean {out['geomean_ms']}ms"
+        + (f", {out['vs_pandas_geomean']}x pandas geomean"
+           if out["vs_pandas_geomean"] else ""))
+    return out
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    eng, data, q1_value, q1_ratio = run_headline()
+
+    suites = {}
+    for sf in SUITE_SFS:
+        if time.perf_counter() - _T0 > BUDGET_S:
+            log(f"budget exhausted before sf={sf} suite")
+            continue
+        if sf == SF:
+            suites[f"sf{sf:g}"] = run_suite(sf, eng, data)
+        else:
+            if sf > SF:
+                # free the smaller dataset before loading the big one
+                from tests import tpch_util
+                tpch_util._FRAMES_MEMO.clear()
+                eng = data = None
+                gc.collect()
+            suites[f"sf{sf:g}"] = run_suite(sf)
+
     print(json.dumps({
         "metric": "tpch_q1_rows_per_sec",
-        "value": round(value, 1),
+        "value": round(q1_value, 1),
         "unit": "rows/s",
-        "vs_baseline": round(value / (n_rows / cpu_t), 3),
+        "vs_baseline": round(q1_ratio, 3),
+        "suites": suites,
     }))
 
 

@@ -13,8 +13,20 @@ import pandas as pd
 from ydb_tpu.bench.tpch_gen import TpchData, date32
 
 
+_FRAMES_MEMO: list = []   # [(data, frames)] — strong ref pins the dataset
+
+
 def frames(data: TpchData) -> dict[str, pd.DataFrame]:
-    return {name: pd.DataFrame(cols) for name, cols in data.tables.items()}
+    """DataFrame views of the generated tables, memoized per dataset —
+    at SF≥1 the conversion itself costs tens of seconds and every oracle
+    call needs the same frames. Identity-checked against the live object
+    (an id()-keyed map would alias a recycled address)."""
+    if _FRAMES_MEMO and _FRAMES_MEMO[0][0] is data:
+        return _FRAMES_MEMO[0][1]
+    got = {name: pd.DataFrame(cols) for name, cols in data.tables.items()}
+    _FRAMES_MEMO.clear()              # one dataset at a time (SF10 ~ 10GB)
+    _FRAMES_MEMO.append((data, got))
+    return got
 
 
 QUERIES: dict[str, str] = {

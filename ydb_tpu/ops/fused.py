@@ -144,6 +144,84 @@ def build_fused_fn(pipe, final_program: Optional[ir.Program],
     return fn, layout_box
 
 
+def build_tile_fn(pipe, scan_cols: list, K: int, CAP: int,
+                  sb_valid_names: frozenset, join_metas: list):
+    """Fused scan→filter→join→partial-agg program for ONE tile of a scan
+    too large for HBM (the streaming front half of `build_fused_fn`,
+    stopping after `pipe.partial`). The reference streams blocks through
+    its combiner the same way before the merge stage
+    (`mkql_wide_combine.cpp` InMemory state); here a tile is K stacked
+    sources in one dispatch and the partial stays device-resident for the
+    finalize/merge stage.
+
+    fn(sb, sbv, lengths, builds, params) → (data {name}, valids {name},
+    length) — compressed (active rows at front), NOT transferred."""
+
+    @jax.jit
+    def fn(sb, sbv, lengths, builds, params):
+        cap = K * CAP
+        env = {}
+        for c in scan_cols:
+            d = sb[c.name].reshape(cap)
+            v = sbv[c.name].reshape(cap) if c.name in sb_valid_names else None
+            env[c.name] = (d, v)
+        sel = (jnp.arange(CAP, dtype=jnp.int32)[None, :]
+               < lengths[:, None]).reshape(cap)
+        length = jnp.int32(cap)
+        schema = Schema(list(scan_cols))
+
+        def run(prog, env, length, sel, schema, cap):
+            env, length, sel, schema = _trace_program(
+                prog, schema.columns, cap, env, length, params, sel=sel)
+            if env:
+                cap = next(iter(env.values()))[0].shape[0]
+            return env, length, sel, schema, cap
+
+        if pipe.pre_program is not None:
+            env, length, sel, schema, cap = run(pipe.pre_program, env,
+                                                length, sel, schema, cap)
+        bi = 0
+        for kind, step in pipe.steps:
+            if kind == "join":
+                meta = join_metas[bi]
+                env, sel = probe_lut_traced(env, sel, builds[bi], meta)
+                bi += 1
+                schema = apply_join_schema(schema, meta["payload_cols"])
+            else:
+                env, length, sel, schema, cap = run(step, env, length, sel,
+                                                    schema, cap)
+        if pipe.partial is not None:
+            env, length, sel, schema, cap = run(pipe.partial, env, length,
+                                                sel, schema, cap)
+        if sel is not None:
+            env, length = compress(env, length, sel, cap)
+        out_d = {n: d for n, (d, _v) in env.items()}
+        out_v = {n: v for n, (d, v) in env.items() if v is not None}
+        return out_d, out_v, length
+
+    return fn
+
+
+def tile_cache_key(pipe, scan_cols, K, CAP, sb_valid_names, builds_sig,
+                   param_names):
+    progs = []
+    if pipe.pre_program is not None:
+        progs.append(pipe.pre_program.fingerprint())
+    for kind, step in pipe.steps:
+        if kind == "join":
+            progs.append(("join", step.probe_key, step.kind,
+                          tuple(step.payload), step.mark_col, step.not_in))
+        else:
+            progs.append(step.fingerprint())
+    if pipe.partial is not None:
+        progs.append(pipe.partial.fingerprint())
+    return ("tile", tuple(progs),
+            tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
+                  for c in scan_cols),
+            K, CAP, tuple(sorted(sb_valid_names)), builds_sig,
+            tuple(param_names))
+
+
 def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,
                     sort_spec, rank_assigns, param_names):
     pipe = plan.pipeline

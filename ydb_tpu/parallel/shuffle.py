@@ -114,8 +114,10 @@ class DistributedAgg:
     seg_rows: int = 0        # per-edge segment capacity (0: = capacity)
 
     def __post_init__(self):
-        self._fn = None
-        self._sig = None
+        # sig -> (shard_fn, out-schema holder): alternating signatures
+        # (capacity buckets, valid sets, param sets) each keep their
+        # compiled fn instead of thrashing a single slot
+        self._fns: dict = {}
 
     # -- compile ----------------------------------------------------------
 
@@ -276,23 +278,26 @@ class DistributedAgg:
         lengths = np.array([b.length for b in blocks_per_device],
                            dtype=np.int32)
 
-        sig = (cap, tuple(sorted(valid_names)), tuple(sorted(params)))
-        if self._fn is None or self._sig != sig:
-            self._fn, self._holder = self._build(cap, tuple(sorted(valid_names)),
-                                                 tuple(sorted(params)))
-            self._sig = sig
+        sig = (cap, tuple(sorted(valid_names)), tuple(sorted(params)),
+               self.seg_rows)
+        entry = self._fns.get(sig)
+        if entry is None:
+            entry = self._build(cap, tuple(sorted(valid_names)),
+                                tuple(sorted(params)))
+            self._fns[sig] = entry
+        fn, holder = entry
 
         dev_params = {k: jnp.asarray(v) for k, v in params.items()}
-        out_d, out_v, flens, overflow = self._fn(arrays, valids, lengths,
-                                                 dev_params)
+        out_d, out_v, flens, overflow = fn(arrays, valids, lengths,
+                                           dev_params)
         if bool(np.any(np.asarray(overflow))):
             # overflowed rows were clamped on device, so that result is
             # partial — discard it, rebuild with full-capacity segments
             # (seg = pcap ≥ any per-bucket count: cannot overflow) and rerun
             assert self.seg_rows, "full-capacity segments cannot overflow"
             self.seg_rows = 0
-            self._fn = None
             return self.run(blocks_per_device, params)
+        self._holder = holder
         dicts = {}
         for b in blocks_per_device:
             for name, cd in b.columns.items():
@@ -337,14 +342,17 @@ class DistributedAgg:
         lengths = jax.make_array_from_single_device_arrays(
             (ndev,), sh1, [fused[d][2][None] for d in range(ndev)])
 
-        sig = (pcap, tuple(sorted(names)), tuple(sorted(params)))
-        if self._fn is None or self._sig != sig:
-            self._fn, self._holder = self._build(pcap, tuple(sorted(names)),
-                                                 tuple(sorted(params)))
-            self._sig = sig
+        sig = (pcap, tuple(sorted(names)), tuple(sorted(params)),
+               self.seg_rows)
+        entry = self._fns.get(sig)
+        if entry is None:
+            entry = self._build(pcap, tuple(sorted(names)),
+                                tuple(sorted(params)))
+            self._fns[sig] = entry
+        fn, self._holder = entry
         dev_params = {k: jnp.asarray(v) for k, v in params.items()}
-        out_d, out_v, flens, overflow = self._fn(arrays, valids, lengths,
-                                                 dev_params)
+        out_d, out_v, flens, overflow = fn(arrays, valids, lengths,
+                                           dev_params)
         # seg_rows=0 (full capacity) is the only mode used here — overflow
         # is impossible, but keep the invariant checked
         assert not bool(np.any(np.asarray(overflow)))

@@ -499,6 +499,11 @@ class QueryEngine:
         })
         return c
 
+    def prewarm(self, tables=None) -> int:
+        """Upload table columns into the HBM cache ahead of queries (the
+        buffer-pool warmup analog; see `Executor.prewarm`)."""
+        return self.executor.prewarm(tables)
+
     def _explain_stmt(self, stmt: ast.Explain, session) -> HostBlock:
         """EXPLAIN [ANALYZE] — plan text (+ live execution stats), the
         `kqp_query_plan.cpp` plan-with-stats analog."""
@@ -577,8 +582,9 @@ class QueryEngine:
             right.columns = left.columns
             out = pd.concat([left, right], ignore_index=True)
             # the combined frame is the actual host job — guard it too
-            # (N arms each under the limit can still concat over it)
-            self._host_lane_guard(len(out), "setop")
+            # (N arms each under the limit can still concat over it);
+            # count=False: rows were already counted at their leaf arms
+            self._host_lane_guard(len(out), "setop", count=False)
             if node.op == "union":
                 out = out.drop_duplicates(ignore_index=True)
             return out
@@ -586,13 +592,16 @@ class QueryEngine:
         self._host_lane_guard(arm.length, "setop")
         return arm.to_pandas()
 
-    def _host_lane_guard(self, rows: int, lane: str) -> None:
+    def _host_lane_guard(self, rows: int, lane: str,
+                         count: bool = True) -> None:
         """Host pandas lanes (windows, set-op combine) degrade loudly: a
-        counter records the rows crossing to host, and frames above the
-        configured limit refuse instead of silently becoming single-core
-        pandas jobs."""
+        counter records the rows crossing to host (`count=False` for
+        re-checks of already-counted rows, e.g. set-op combine levels),
+        and frames above the configured limit refuse instead of silently
+        becoming single-core pandas jobs."""
         from ydb_tpu.utils.metrics import GLOBAL
-        GLOBAL.inc(f"engine/host_lane/{lane}_rows", rows)
+        if count:
+            GLOBAL.inc(f"engine/host_lane/{lane}_rows", rows)
         if rows > self.config.host_lane_max_rows:
             raise QueryError(
                 f"{lane} host-fallback lane refused a {rows}-row frame "

@@ -64,11 +64,55 @@ class Executor:
         import os as _os
         self.grace_budget_bytes = int(
             _os.environ.get("YDB_TPU_GRACE_BUDGET", 1 << 29))
+        # scans whose stacked superblock estimate exceeds this stream
+        # through the tiled fused path instead of residing in HBM
+        self.fused_scan_budget_bytes = int(
+            _os.environ.get("YDB_TPU_FUSED_SCAN_BUDGET", 6 << 30))
+        # HBM bytes per scan tile on the tiled path (2 tiles in flight)
+        self.tile_budget_bytes = int(
+            _os.environ.get("YDB_TPU_TILE_BUDGET", 1 << 30))
+        # partial-agg states above this estimate spill to host DRAM and
+        # merge per key-hash partition (WideCombiner ProcessSpilled analog)
+        self.merge_budget_bytes = int(
+            _os.environ.get("YDB_TPU_MERGE_BUDGET", 1 << 30))
 
     def _span(self, name: str, **attrs):
         from contextlib import nullcontext
         return self.tracer.span(name, **attrs) if self.tracer is not None \
             else nullcontext()
+
+    # -- cache warmup ------------------------------------------------------
+
+    def prewarm(self, tables=None, snapshot: Snapshot = MAX_SNAPSHOT) -> int:
+        """Upload every column of the given tables (default: all) into the
+        HBM superblock cache — the buffer-pool warmup analog
+        (`ydb/core/tablet_flat` shared cache fills on demand; here warmup
+        matters doubly because this platform's host→device link degrades
+        ~20x after the first device→host readout, so uploads queued
+        before any result is fetched run at full bandwidth — PERF.md).
+
+        Returns the number of bytes resident in the cache afterwards.
+        Tables whose stacked estimate exceeds the fused-scan budget are
+        skipped (they will stream through the tiled path anyway)."""
+        from ydb_tpu.storage.device_cache import (
+            enumerate_scan_sources, estimate_scan_bytes,
+        )
+        names = tables if tables is not None else list(self.catalog.tables)
+        for tname in names:
+            table = self.catalog.table(tname)
+            storage_names = list(table.schema.names)
+            try:
+                sources, _ids = enumerate_scan_sources(table, snapshot, None)
+            except AttributeError:       # row tables scan uncached
+                continue
+            if not sources:
+                continue
+            est = estimate_scan_bytes(sources, storage_names)
+            if est > self.fused_scan_budget_bytes:
+                continue
+            self.device_cache.superblock(table, storage_names, {}, snapshot,
+                                         None, sources, _ids)
+        return self.device_cache.bytes
 
     # -- entry -------------------------------------------------------------
 
@@ -105,6 +149,10 @@ class Executor:
         with self._span("fused-attempt"):
             fused = self._try_execute_fused(plan, params, snapshot) \
                 if self.enable_fused else None
+        if isinstance(fused, tuple):           # tiled path: (kind, block)
+            kind, block = fused
+            self.last_path = kind
+            return self._project_output(block, plan.output)
         if isinstance(fused, HostBlock):
             self.last_path = "fused"
             return self._project_output(fused, plan.output)
@@ -189,15 +237,31 @@ class Executor:
             schema = F.apply_join_schema(schema, payload_cols)
         if pipe.partial is not None:
             schema = ir.infer_schema(pipe.partial, schema)
+        partial_schema = schema            # tile-output schema (pre-final)
         if plan.final_program is not None:
             schema = ir.infer_schema(plan.final_program, schema)
 
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
+
+        # HBM admission: a scan whose stacked superblock would not fit the
+        # budget streams through the tiled path instead of OOMing the chip
+        from ydb_tpu.storage.device_cache import (
+            enumerate_scan_sources, estimate_scan_bytes,
+        )
+        sources, src_ids = enumerate_scan_sources(table, snapshot,
+                                                  pipe.scan.prune or None)
+        if sources and estimate_scan_bytes(sources, storage_names) \
+                > self.fused_scan_budget_bytes:
+            return self._execute_fused_tiled(
+                plan, params, pipe, sources, scan_cols, builds, join_metas,
+                dicts, partial_schema)
+
         with self._span("superblock-upload"):
             sb = self.device_cache.superblock(table, storage_names, rename,
                                               snapshot,
-                                              pipe.scan.prune or None)
+                                              pipe.scan.prune or None,
+                                              sources, src_ids)
         if sb is None:
             return builds or None          # empty scan → portioned path
         arrays, valids, lengths, K, CAP, sb_dicts = sb
@@ -299,6 +363,215 @@ class Executor:
             else:
                 spec.append((sk.name, sk.ascending, sk.nulls_first))
         return sort_params, tuple(spec), rank_assigns
+
+    # -- tiled fused path (scan > HBM budget) ------------------------------
+
+    def _execute_fused_tiled(self, plan: QueryPlan, params: dict, pipe,
+                             sources: list, scan_cols: list, builds: list,
+                             join_metas: list, build_dicts: dict,
+                             partial_schema: Schema):
+        """Stream a scan too large for HBM through fixed-size tiles: each
+        tile is K_tile stacked sources run through ONE fused
+        scan→filter→join→partial dispatch (`ops/fused.build_tile_fn`),
+        with two tiles in flight (upload overlaps compute). Partials
+        either stay device-resident for the normal finalize, spill to
+        host-DRAM key-hash partitions for a per-partition merge
+        (`ops/spill.py` — the WideCombiner InMemory→Spilling→
+        ProcessSpilled analog, `mkql_wide_combine.cpp:338-600`), or, for
+        non-aggregating plans, union host-side with per-tile top-k
+        pre-cuts (DqCnMerge-style).
+
+        Returns ("fused-tiled[...]" , HostBlock)."""
+        import dataclasses
+
+        from ydb_tpu.ops import fused as F
+        from ydb_tpu.ops import spill as SP
+        from ydb_tpu.ops.xla_exec import _SCATTER_MAX_BUCKETS
+        from ydb_tpu.utils.metrics import GLOBAL
+
+        CAP = max(bucket_capacity(max(b.length, 1)) for b in sources)
+        row_bytes = 0
+        sb_valid_names = set()
+        tile_dicts = dict(build_dicts)
+        for (s, internal) in pipe.scan.columns:
+            cd0 = sources[0].columns[s]
+            row_bytes += cd0.data.itemsize
+            if any(b.columns[s].valid is not None for b in sources):
+                sb_valid_names.add(internal)
+                row_bytes += 1
+            if cd0.dictionary is not None:
+                tile_dicts[internal] = cd0.dictionary
+        K_tile = max(1, int(self.tile_budget_bytes // (CAP * row_bytes)))
+        K_tile = min(K_tile, len(sources))
+        n_tiles = (len(sources) + K_tile - 1) // K_tile
+        tile_cap = K_tile * CAP
+        sb_valid_names = frozenset(sb_valid_names)
+
+        # static tile-output capacity: bounded-domain partial group-bys
+        # compact to their bucket count; everything else stays tile-sized
+        tile_out_cap = tile_cap
+        last = pipe.partial.commands[-1] \
+            if pipe.partial is not None and pipe.partial.commands else None
+        if isinstance(last, ir.GroupBy):
+            if not last.keys:
+                tile_out_cap = 1
+            elif last.key_domains and all(d > 0 for d in last.key_domains):
+                nb = 1
+                for d in last.key_domains:
+                    nb *= d + 1
+                if nb + 1 <= _SCATTER_MAX_BUCKETS:
+                    tile_out_cap = bucket_capacity(nb, minimum=128)
+        prow = sum(np.dtype(c.dtype.np).itemsize + 1
+                   for c in partial_schema.columns)
+        est_partial = n_tiles * min(tile_out_cap, tile_cap) * prow
+
+        fp = plan.final_program
+        merge_gb = fp.commands[0] if fp is not None and fp.commands \
+            and isinstance(fp.commands[0], ir.GroupBy) else None
+        spill = (merge_gb is not None and merge_gb.keys
+                 and est_partial > self.merge_budget_bytes)
+        union = merge_gb is None and est_partial > self.merge_budget_bytes
+
+        builds_sig = tuple(F.build_inputs_sig(bt) for bt in builds)
+        key = F.tile_cache_key(pipe, scan_cols, K_tile, CAP, sb_valid_names,
+                               builds_sig, tuple(sorted(params)))
+        fn = self._fused_cache.get(key)
+        if fn is None:
+            fn = F.build_tile_fn(pipe, scan_cols, K_tile, CAP,
+                                 sb_valid_names, join_metas)
+            self._fused_cache[key] = fn
+        build_inputs = [F.build_traced_inputs(bt) for bt in builds]
+        dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
+                      for k, v in params.items()}
+        out_dicts = {n: d for n, d in tile_dicts.items()
+                     if partial_schema.has(n)}
+
+        GLOBAL.inc("executor/tiled_queries")
+        # the tile stacks + resident partials live OUTSIDE the cache's
+        # accounting: make room so warm cache + streaming don't OOM HBM
+        self.device_cache.reserve(2 * self.tile_budget_bytes
+                                  + self.merge_budget_bytes)
+        store = None
+        if spill:
+            P = 1
+            while est_partial / P > self.merge_budget_bytes and P < 256:
+                P *= 2
+            store = SP.PartitionStore(partial_schema, list(merge_gb.keys),
+                                      P, out_dicts)
+
+        # union mode: per-tile finalize plans (top-k pre-cut when the
+        # query sort-limits, plain program application otherwise)
+        lim = None if plan.limit is None else plan.limit + (plan.offset or 0)
+        topk = bool(plan.sort) and lim is not None and lim <= (1 << 17)
+        out_names = {n for (n, _lbl) in plan.output}
+        extra = [(sk.name, sk.name) for sk in plan.sort
+                 if sk.name not in out_names]
+        if union:
+            if topk:
+                plan_tile = dataclasses.replace(
+                    plan, offset=None, limit=lim, output=plan.output + extra)
+            else:
+                plan_tile = dataclasses.replace(
+                    plan, sort=[], limit=None, offset=None,
+                    output=plan.output + extra)
+
+        partials, unions = [], []
+        prev = None
+        with self._span("tiled-scan", tiles=n_tiles, k_tile=K_tile,
+                        spill=bool(spill), union=bool(union)):
+            for t in range(n_tiles):
+                tile_sources = sources[t * K_tile:(t + 1) * K_tile]
+                sb, sbv, lengths = self._stack_tile(
+                    tile_sources, pipe.scan.columns, K_tile, CAP,
+                    sb_valid_names)
+                out_d, out_v, length = fn(sb, sbv, lengths, build_inputs,
+                                          dev_params)
+                out_d = {n: out_d[n] for n in partial_schema.names}
+                out_v = {n: v for n, v in out_v.items()
+                         if partial_schema.has(n)}
+                cap_t = (next(iter(out_d.values())).shape[0]
+                         if out_d else tile_cap)
+                dblock = DeviceBlock(partial_schema, out_d, out_v, length,
+                                     cap_t, out_dicts)
+                if spill:
+                    store.feed(dblock)       # syncs → natural backpressure
+                elif union:
+                    unions.append(self._finalize(plan_tile, [dblock],
+                                                 params))
+                else:
+                    partials.append(dblock)
+                    if prev is not None:
+                        jax.block_until_ready(prev)
+                    prev = out_d
+
+        if spill:
+            GLOBAL.inc("executor/spilled_rows", store.spilled_rows)
+            GLOBAL.inc("executor/spilled_bytes", store.spilled_bytes)
+            return ("fused-tiled-spill",
+                    self._merge_spilled(plan, store, params))
+        if union:
+            u = HostBlock.concat(unions) if len(unions) > 1 else unions[0]
+            if topk:
+                plan_merge = dataclasses.replace(
+                    plan, final_program=None, output=plan.output + extra)
+                block = self._finalize(plan_merge, [to_device(u)], params)
+            else:
+                block = SP.host_sort_limit(
+                    u, plan.sort, plan.limit, plan.offset,
+                    {**out_dicts, **plan.result_dicts})
+            return ("fused-tiled-union", block)
+        return ("fused-tiled", self._finalize(plan, partials, params))
+
+    def _stack_tile(self, tile_sources: list, scan_columns: list,
+                    K_tile: int, CAP: int, sb_valid_names: frozenset):
+        """Host-stack one tile of sources into (K_tile, CAP) arrays and
+        upload (async H2D). Short tiles pad with zero-length sources so
+        every tile shares one compiled program."""
+        lengths = np.zeros(K_tile, np.int32)
+        for k, b in enumerate(tile_sources):
+            lengths[k] = b.length
+        arrays, valids = {}, {}
+        for (s, internal) in scan_columns:
+            dtype = tile_sources[0].columns[s].data.dtype
+            stack = np.zeros((K_tile, CAP), dtype=dtype)
+            vstack = np.zeros((K_tile, CAP), np.bool_) \
+                if internal in sb_valid_names else None
+            for k, b in enumerate(tile_sources):
+                cd = b.columns[s]
+                stack[k, :b.length] = cd.data
+                if vstack is not None:
+                    vstack[k, :b.length] = (cd.valid if cd.valid is not None
+                                            else True)
+            arrays[internal] = jnp.asarray(stack)
+            if vstack is not None:
+                valids[internal] = jnp.asarray(vstack)
+        return arrays, valids, jnp.asarray(lengths)
+
+    def _merge_spilled(self, plan: QueryPlan, store, params: dict):
+        """ProcessSpilled: per key-hash partition, concat the spilled
+        pieces, run the merge group-by + rest of the final program on
+        device, then combine partitions host-side (disjoint key sets) and
+        apply ORDER BY / LIMIT on the host."""
+        import dataclasses
+
+        from ydb_tpu.ops import spill as SP
+
+        out_names = {n for (n, _lbl) in plan.output}
+        extra = [(sk.name, sk.name) for sk in plan.sort
+                 if sk.name not in out_names]
+        plan_p = dataclasses.replace(plan, sort=[], limit=None, offset=None,
+                                     output=plan.output + extra)
+        outs = []
+        with self._span("spill-merge", parts=store.nparts):
+            for p in range(store.nparts):
+                hb = store.partition(p)
+                if hb.length == 0 and outs:
+                    continue
+                outs.append(self._finalize(plan_p, [to_device(hb)], params))
+        union = HostBlock.concat(outs) if len(outs) > 1 else outs[0]
+        return SP.host_sort_limit(
+            union, plan.sort, plan.limit, plan.offset,
+            {**store.dictionaries, **plan.result_dicts})
 
     # -- distributed (mesh) path -------------------------------------------
 
@@ -654,8 +927,35 @@ class Executor:
     def _finalize(self, plan: QueryPlan, dblocks: list,
                   params: dict) -> HostBlock:
         """Concat partials + final program + sort + limit in ONE device
-        call, then one batched transfer."""
+        call, then one batched transfer. Partial-agg states too large to
+        merge in one device concat (high-cardinality group-bys on the
+        portioned path) route to the host-DRAM partitioned merge instead
+        of compiling an HBM-sized program."""
         in_schema = dblocks[0].schema
+
+        fp = plan.final_program
+        merge_gb = fp.commands[0] if fp is not None and fp.commands \
+            and isinstance(fp.commands[0], ir.GroupBy) else None
+        if merge_gb is not None and merge_gb.keys and len(dblocks) > 1:
+            prow = sum(np.dtype(c.dtype.np).itemsize + 1
+                       for c in in_schema.columns)
+            total = sum(d.capacity for d in dblocks) * prow
+            if total > self.merge_budget_bytes:
+                from ydb_tpu.ops import spill as SP
+                from ydb_tpu.utils.metrics import GLOBAL
+                P = 1
+                while total / P > self.merge_budget_bytes and P < 256:
+                    P *= 2
+                dicts = {}
+                for d in dblocks:
+                    dicts.update(d.dictionaries)
+                store = SP.PartitionStore(in_schema, list(merge_gb.keys),
+                                          P, dicts)
+                for d in dblocks:
+                    store.feed(d)
+                GLOBAL.inc("executor/spilled_rows", store.spilled_rows)
+                GLOBAL.inc("executor/spilled_bytes", store.spilled_bytes)
+                return self._merge_spilled(plan, store, params)
         sort_params, sort_spec, rank_assigns = self._sort_setup(
             plan, in_schema, dblocks)
         all_params = {**params, **sort_params}

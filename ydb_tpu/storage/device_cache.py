@@ -21,7 +21,44 @@ from ydb_tpu.core.block import HostBlock
 from ydb_tpu.ops.device import DeviceBlock, bucket_capacity
 from ydb_tpu.storage.portion import Portion
 
-DEFAULT_BUDGET = 6 << 30          # bytes of HBM for cached columns
+import os as _os
+
+# bytes of HBM for cached columns (v5e: 16GB total; leave headroom for
+# sort/groupby working sets)
+DEFAULT_BUDGET = int(_os.environ.get("YDB_TPU_HBM_BUDGET", 10 << 30))
+
+
+def enumerate_scan_sources(table, snapshot, prune):
+    """Every visible scan source of a table: (HostBlocks, source ids).
+    Source ids key superblock cache entries (write id, not list position:
+    two snapshots seeing different insert subsets must not collide)."""
+    sources, src_ids = [], []
+    for shard in table.shards:
+        portions, insert_entries = shard.scan_sources(snapshot, prune)
+        for p in portions:
+            sources.append(p.block)
+            src_ids.append(("p", p.id))
+        for e in insert_entries:
+            sources.append(e.block)
+            src_ids.append(("i", shard.shard_id, e.write_id))
+    return sources, src_ids
+
+
+def estimate_scan_bytes(sources, storage_names: list) -> int:
+    """Superblock HBM footprint of a scan: K stacked sources at the max
+    capacity bucket, per column data + validity — the fused-path
+    admission estimate (no upload happens to find out it didn't fit)."""
+    if not sources:
+        return 0
+    K = len(sources)
+    CAP = max(bucket_capacity(max(b.length, 1)) for b in sources)
+    total = 0
+    for s in storage_names:
+        cd0 = sources[0].columns[s]
+        total += K * CAP * cd0.data.itemsize
+        if any(b.columns[s].valid is not None for b in sources):
+            total += K * CAP
+    return total
 
 
 class DeviceColumnCache:
@@ -36,6 +73,14 @@ class DeviceColumnCache:
         while self.bytes > self.budget and self._entries:
             _key, (_d, _v, nbytes) = self._entries.popitem(last=False)
             self.bytes -= nbytes
+
+    def reserve(self, nbytes: int) -> None:
+        """Evict LRU entries until `nbytes` of HBM fits beside the cached
+        set — for paths that allocate device memory the cache doesn't
+        track (tiled scan stacks, spill partials)."""
+        while self.bytes + nbytes > self.budget and self._entries:
+            _key, (_d, _v, nb) = self._entries.popitem(last=False)
+            self.bytes -= nb
 
     def column(self, portion: Portion, col: str, device=None):
         """(device data, device valid | None), padded to the portion's
@@ -66,26 +111,19 @@ class DeviceColumnCache:
         return data, valid
 
     def superblock(self, table, storage_names: list, rename: dict,
-                   snapshot, prune):
+                   snapshot, prune, sources=None, src_ids=None):
         """Stacked (K, CAP) device arrays covering every visible scan source
         of `table` — the input of the whole-query fused program
         (`ydb_tpu/ops/fused.py`), one upload per column per data version.
 
+        `sources`/`src_ids`: pass a pre-enumerated source list (the
+        executor's admission estimate already walked the shards once).
+
         Returns (arrays {internal: (K,CAP)}, valids {internal: (K,CAP)},
         lengths jnp (K,), K, CAP, dicts) or None when the table has no
         visible sources."""
-        sources = []          # HostBlocks
-        src_ids = []
-        for shard in table.shards:
-            portions, insert_entries = shard.scan_sources(snapshot, prune)
-            for p in portions:
-                sources.append(p.block)
-                src_ids.append(("p", p.id))
-            for e in insert_entries:
-                # write id, not list position: two snapshots seeing
-                # different insert subsets must not collide in the cache
-                sources.append(e.block)
-                src_ids.append(("i", shard.shard_id, e.write_id))
+        if sources is None:
+            sources, src_ids = enumerate_scan_sources(table, snapshot, prune)
         if not sources:
             return None
         K = len(sources)

@@ -61,7 +61,7 @@ class Dictionary:
         return self._map.get(value, -2)
 
     def decode(self, codes: np.ndarray) -> np.ndarray:
-        values = np.asarray(self._values, dtype=object)
+        values = self.values_array()
         out = np.empty(len(codes), dtype=object)
         ok = codes >= 0
         out[ok] = values[codes[ok]]
@@ -69,7 +69,10 @@ class Dictionary:
         return out
 
     def values_array(self) -> np.ndarray:
-        return np.asarray(self._values, dtype=object)
+        # length captured first: a concurrent writer appending (append-only,
+        # codes are stable) must not grow the list mid-conversion
+        vals = self._values
+        return np.asarray(vals[:len(vals)], dtype=object)
 
     def lut(self, predicate) -> np.ndarray:
         """Evaluate `predicate(value) -> bool` over all dictionary entries.
@@ -78,7 +81,8 @@ class Dictionary:
         on a code column as `lut[code]` (a gather).
         """
         vals = self._values
-        out = np.empty(len(vals), dtype=np.bool_)
-        for i, v in enumerate(vals):
-            out[i] = predicate(v)
+        n = len(vals)                 # stable under concurrent appends
+        out = np.empty(n, dtype=np.bool_)
+        for i in range(n):
+            out[i] = predicate(vals[i])
         return out

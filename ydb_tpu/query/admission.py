@@ -1,0 +1,100 @@
+"""Memory admission for concurrent queries (the KQP resource-manager seat).
+
+The reference admits queries against per-node memory pools
+(`ydb/core/kqp/rm_service/kqp_rm_service.h:68` — TxMemory limits with
+queueing at the session/executer boundary). Here: a byte-budget gate over
+the device working set — each query's scan + build estimate reserves
+budget before dispatch, waits (bounded) when the chip is oversubscribed,
+and sheds with an admission error past the deadline. Estimates above the
+whole budget clamp to it, so giant (tiled/spilled) queries serialize
+against everything rather than deadlock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class AdmissionTimeout(Exception):
+    pass
+
+
+class MemoryAdmission:
+    def __init__(self, budget_bytes: int, timeout_s: float = 60.0):
+        self.budget = int(budget_bytes)
+        self.timeout_s = timeout_s
+        self.in_flight = 0
+        self.active = 0
+        self._cv = threading.Condition()
+
+    @contextmanager
+    def admit(self, est_bytes: int):
+        from ydb_tpu.utils.metrics import GLOBAL
+        est = max(0, min(int(est_bytes), self.budget))
+        with self._cv:
+            deadline = time.monotonic() + self.timeout_s
+            waited = False
+            while self.in_flight + est > self.budget:
+                waited = True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(remaining):
+                    GLOBAL.inc("admission/timeouts")
+                    raise AdmissionTimeout(
+                        f"memory admission timed out: need {est} bytes, "
+                        f"{self.budget - self.in_flight} free of "
+                        f"{self.budget} (queries queue while the device "
+                        f"is oversubscribed)")
+            if waited:
+                GLOBAL.inc("admission/waits")
+            self.in_flight += est
+            self.active += 1
+            GLOBAL.set("admission/in_flight_bytes", self.in_flight)
+            GLOBAL.set("admission/active_queries", self.active)
+        try:
+            yield
+        finally:
+            with self._cv:
+                self.in_flight -= est
+                self.active -= 1
+                GLOBAL.set("admission/in_flight_bytes", self.in_flight)
+                GLOBAL.set("admission/active_queries", self.active)
+                self._cv.notify_all()
+
+
+def estimate_plan_bytes(catalog, plan, snapshot) -> int:
+    """Device-byte estimate for a SELECT plan: the driving scan's columns
+    at the table's row count, plus each join build's scan (one level deep
+    — build subplans estimate their own driving scan).
+
+    Deliberately stats-only (row counts × column widths): the executor
+    enumerates and prunes the actual scan sources right after admission —
+    doing it here too would walk every shard twice per query."""
+    import numpy as np
+
+    def pipe_bytes(pipe) -> int:
+        try:
+            table = catalog.table(pipe.scan.table)
+        except KeyError:
+            return 0
+        rows = getattr(table, "num_rows", 0)
+        if not rows:
+            return 0
+        per_row = 0
+        for (s, _i) in pipe.scan.columns:
+            if not table.schema.has(s):
+                continue
+            dt = table.schema.dtype(s)
+            per_row += np.dtype(dt.np).itemsize + (1 if dt.nullable else 0)
+        return rows * per_row
+
+    total = pipe_bytes(plan.pipeline)
+    for kind, step in plan.pipeline.steps:
+        if kind != "join":
+            continue
+        build = step.build
+        bp = getattr(build, "pipeline", build)   # QueryPlan | Pipeline
+        if hasattr(bp, "scan"):
+            total += pipe_bytes(bp)
+    return total

@@ -56,9 +56,11 @@ class Executor:
         self.enable_fused = True
         # engine-provided tracer (utils/tracing.Tracer) — None = no spans
         self.tracer = None
-        # which path the last execute() took:
-        # fused | portioned | distributed | distributed-map | literal
-        self.last_path = ""
+        # which path the last execute() took (THREAD-LOCAL — concurrent
+        # sessions each observe their own):
+        # fused | fused-tiled[...] | portioned | distributed | literal
+        import threading as _threading
+        self._tls = _threading.local()
         # build sides above this estimate hash-partition into a GraceJoin
         # (host-DRAM partitions probed one at a time — the spill budget)
         import os as _os
@@ -75,6 +77,14 @@ class Executor:
         # merge per key-hash partition (WideCombiner ProcessSpilled analog)
         self.merge_budget_bytes = int(
             _os.environ.get("YDB_TPU_MERGE_BUDGET", 1 << 30))
+
+    @property
+    def last_path(self) -> str:
+        return getattr(self._tls, "last_path", "")
+
+    @last_path.setter
+    def last_path(self, v: str):
+        self._tls.last_path = v
 
     def _span(self, name: str, **attrs):
         from contextlib import nullcontext

@@ -149,33 +149,32 @@ class _Handler(socketserver.BaseRequestHandler):
         first = sql.strip().split(None, 1)[0].lower().rstrip(";")
         if self._aborted:
             if first in ("rollback", "commit"):
-                with srv.lock:
-                    try:
-                        srv.engine.execute("rollback", session=session,
-                                           _internal=True)
-                    except Exception:            # noqa: BLE001
-                        pass
+                try:
+                    srv.engine.execute("rollback", session=session,
+                                       _internal=True)
+                except Exception:            # noqa: BLE001
+                    pass
                 self._aborted = False
                 return _msg(b"C", _cstr("ROLLBACK")) \
                     + _ready(self._status(session))
             return _error("current transaction is aborted, commands "
                           "ignored until end of transaction block",
                           code="25P02") + _ready(self._status(session))
-        # result building (block decode) stays under the same lock as
-        # execution: the engine's structures are not thread-safe
-        with srv.lock:
-            try:
-                block = srv.engine.execute(sql, session=session)
-                kind = srv.engine.last_stats.kind
-                if kind in ("select", "setop", "explain"):
-                    return self._rows(block) \
-                        + _ready(self._status(session))
-                n = getattr(srv.engine, "last_rows_affected", 0)
-            except Exception as e:               # noqa: BLE001 — wire boundary
-                if session.tx is not None:
-                    self._aborted = True
-                return _error(f"{type(e).__name__}: {e}") \
+        # no front-side lock: the engine serializes its own write path
+        # internally and SELECTs run concurrently over MVCC snapshots;
+        # last_stats / last_rows_affected are thread-local to this handler
+        try:
+            block = srv.engine.execute(sql, session=session)
+            kind = srv.engine.last_stats.kind
+            if kind in ("select", "setop", "explain"):
+                return self._rows(block) \
                     + _ready(self._status(session))
+            n = getattr(srv.engine, "last_rows_affected", 0)
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            if session.tx is not None:
+                self._aborted = True
+            return _error(f"{type(e).__name__}: {e}") \
+                + _ready(self._status(session))
         tag = {"insert": f"INSERT 0 {n}",
                "update": f"UPDATE {n}",
                "delete": f"DELETE {n}",
@@ -229,7 +228,6 @@ class PgServer:
 
     def __init__(self, engine, port: int = 0, host: str = "127.0.0.1"):
         self.engine = engine
-        self.lock = engine.lock   # shared with the gRPC front
 
         class _TCP(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

@@ -73,11 +73,13 @@ _STARTED = _time.monotonic()
 
 class QueryServicer:
     def __init__(self, engine, max_sessions: int = MAX_SESSIONS):
+        import threading
         from collections import OrderedDict
         self.engine = engine
-        # the ENGINE's lock, shared with every other front (pgwire):
-        # per-front locks would not exclude each other
-        self._lock = engine.lock
+        # the engine locks its own write path internally now; SELECTs run
+        # concurrently across the gRPC thread pool over MVCC snapshots.
+        # This lock only guards the servicer's session table.
+        self._lock = threading.Lock()
         self._sessions: "OrderedDict" = OrderedDict()
         self._max_sessions = max_sessions
 
@@ -109,18 +111,17 @@ class QueryServicer:
 
     def execute_query(self, request, context):
         sql = request.get("sql", "")
-        with self._lock:
-            try:
+        try:
+            with self._lock:
                 session = self._session(request.get("session_id"))
-                block = self.engine.execute(sql, session=session)
-                stats = getattr(self.engine, "last_stats", None)
-                return _result_payload(block, stats)
-            except Exception as e:               # noqa: BLE001 — wire boundary
-                return {"error": f"{type(e).__name__}: {e}"}
+            block = self.engine.execute(sql, session=session)
+            stats = getattr(self.engine, "last_stats", None)
+            return _result_payload(block, stats)
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
 
     def counters(self, request, context):
-        with self._lock:
-            return {"counters": self.engine.counters()}
+        return {"counters": self.engine.counters()}
 
     def ping(self, request, context):
         return {"ok": True}

@@ -63,11 +63,15 @@ def estimate_scan_bytes(sources, storage_names: list) -> int:
 
 class DeviceColumnCache:
     def __init__(self, budget_bytes: int = DEFAULT_BUDGET):
+        import threading
         self.budget = budget_bytes
         self._entries: OrderedDict = OrderedDict()  # (pid, col) -> (data, valid, nbytes)
         self.bytes = 0
         self.hits = 0
         self.misses = 0
+        # concurrent readers share the cache; the lock covers the
+        # LRU bookkeeping (uploads serialize on the device link anyway)
+        self._mu = threading.RLock()
 
     def _evict(self):
         while self.bytes > self.budget and self._entries:
@@ -78,22 +82,47 @@ class DeviceColumnCache:
         """Evict LRU entries until `nbytes` of HBM fits beside the cached
         set — for paths that allocate device memory the cache doesn't
         track (tiled scan stacks, spill partials)."""
-        while self.bytes + nbytes > self.budget and self._entries:
-            _key, (_d, _v, nb) = self._entries.popitem(last=False)
-            self.bytes -= nb
+        with self._mu:
+            while self.bytes + nbytes > self.budget and self._entries:
+                _key, (_d, _v, nb) = self._entries.popitem(last=False)
+                self.bytes -= nb
+
+    def _lookup(self, key):
+        with self._mu:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+            else:
+                self.misses += 1
+            return hit
+
+    def _insert(self, key, data, valid, nbytes):
+        """Insert a freshly built entry; a concurrent builder of the same
+        key may have won the race — keep the existing entry (dropping the
+        duplicate upload) so bytes accounting stays exact."""
+        with self._mu:
+            hit = self._entries.get(key)
+            if hit is not None:
+                return hit[0], hit[1]
+            self._entries[key] = (data, valid, nbytes)
+            self.bytes += nbytes
+            self._evict()
+            return data, valid
 
     def column(self, portion: Portion, col: str, device=None):
         """(device data, device valid | None), padded to the portion's
-        capacity bucket; committed to `device` when given (mesh placement)."""
+        capacity bucket; committed to `device` when given (mesh placement).
+
+        The stack/upload work runs OUTSIDE the cache mutex — holding it
+        across device transfers would serialize every concurrent SELECT's
+        data prep on one lock."""
         import jax
 
         key = (portion.id, col, None if device is None else device.id)
-        hit = self._entries.get(key)
+        hit = self._lookup(key)
         if hit is not None:
-            self._entries.move_to_end(key)
-            self.hits += 1
             return hit[0], hit[1]
-        self.misses += 1
         put = (lambda x: jax.device_put(x, device)) if device is not None \
             else jnp.asarray
         cd = portion.block.columns[col]
@@ -105,10 +134,7 @@ class DeviceColumnCache:
         if cd.valid is not None:
             valid = put(np.pad(cd.valid, (0, pad)) if pad else cd.valid)
             nbytes += valid.nbytes
-        self._entries[key] = (data, valid, nbytes)
-        self.bytes += nbytes
-        self._evict()
-        return data, valid
+        return self._insert(key, data, valid, nbytes)
 
     def superblock(self, table, storage_names: list, rename: dict,
                    snapshot, prune, sources=None, src_ids=None):
@@ -139,15 +165,13 @@ class DeviceColumnCache:
         for s in storage_names:
             out = rename.get(s, s)
             key = ("sbc", src_key, s)
-            hit = self._entries.get(key)
+            hit = self._lookup(key)
             if hit is not None:
-                self._entries.move_to_end(key)
-                self.hits += 1
                 arrays[out] = hit[0]
                 if hit[1] is not None:
                     valids[out] = hit[1]
             else:
-                self.misses += 1
+                # stack + upload OUTSIDE the mutex (see column())
                 dtype = sources[0].columns[s].data.dtype
                 stack = np.zeros((K, CAP), dtype=dtype)
                 has_valid = any(b.columns[s].valid is not None
@@ -162,9 +186,7 @@ class DeviceColumnCache:
                 d = jnp.asarray(stack)
                 v = jnp.asarray(vstack) if vstack is not None else None
                 nbytes = d.nbytes + (v.nbytes if v is not None else 0)
-                self._entries[key] = (d, v, nbytes)
-                self.bytes += nbytes
-                self._evict()
+                d, v = self._insert(key, d, v, nbytes)
                 arrays[out] = d
                 if v is not None:
                     valids[out] = v
@@ -173,11 +195,10 @@ class DeviceColumnCache:
                 dicts[out] = cd0.dictionary
 
         lkey = ("sbl", src_key)
-        lhit = self._entries.get(lkey)
+        lhit = self._lookup(lkey)
         if lhit is None:
             lengths = jnp.asarray(lengths_np)
-            self._entries[lkey] = (lengths, None, lengths.nbytes)
-            self.bytes += lengths.nbytes
+            lengths, _ = self._insert(lkey, lengths, None, lengths.nbytes)
         else:
             lengths = lhit[0]
         return arrays, valids, lengths, K, CAP, dicts

@@ -83,7 +83,11 @@ class Store:
 
     def save_catalog(self, catalog) -> None:
         metas = {}
-        for name, t in catalog.tables.items():
+        # list() snapshot: lock-free SELECTs register/drop transient CTE
+        # temps in the tables dict concurrently; transients never persist
+        for name, t in list(catalog.tables.items()):
+            if getattr(t, "transient", False):
+                continue
             metas[name] = {
                 "schema": [[c.name, c.dtype.kind.value, c.dtype.nullable]
                            for c in t.schema],

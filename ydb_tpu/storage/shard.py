@@ -71,23 +71,38 @@ class ColumnShard:
                         or e.committed_version is not None]
 
     def indexate(self) -> int:
-        """Background indexation: committed inserts → portions. Returns #portions."""
+        """Background indexation: committed inserts → portions. Returns
+        #portions.
+
+        Concurrent-reader discipline: the portions list is extended in ONE
+        rebind (atomic under the GIL) BEFORE the consumed inserts are
+        removed in a second rebind; a reader between the two sees the rows
+        in both places, and `scan_sources` dedups by the portions'
+        `src_write_ids` — never zero copies, never two."""
         ready = [e for e in self.inserts if e.committed_version is not None]
         if not ready:
             return 0
-        self.inserts = [e for e in self.inserts if e.committed_version is None]
-        made = 0
+        made = []
         # group by version so a portion has a single write version
-        by_ver: dict[WriteVersion, list[HostBlock]] = {}
+        by_ver: dict[WriteVersion, list] = {}
         for e in ready:
-            by_ver.setdefault(e.committed_version, []).append(e.block)
-        for ver, blocks in by_ver.items():
-            merged = HostBlock.concat(blocks)
+            by_ver.setdefault(e.committed_version, []).append(e)
+        for ver, entries in by_ver.items():
+            wids = frozenset(e.write_id for e in entries)
+            blocks = [e.block for e in entries]
+            merged = HostBlock.concat(blocks) if len(blocks) > 1 else blocks[0]
             for start in range(0, merged.length, self.portion_rows):
-                chunk = merged.slice(start, min(start + self.portion_rows, merged.length))
-                self.portions.append(Portion.from_block(chunk, ver))
-                made += 1
-        return made
+                chunk = merged.slice(start, min(start + self.portion_rows,
+                                                merged.length))
+                p = Portion.from_block(chunk, ver)
+                p.src_write_ids = wids
+                made.append(p)
+        consumed = {e.write_id for e in ready}
+        self.portions = self.portions + made
+        self.inserts = [e for e in self.inserts
+                        if e.write_id not in consumed
+                        or e.committed_version is None]
+        return len(made)
 
     def compact(self, watermark: Optional[int] = None) -> int:
         """Merge small portions into full ones (`general_compaction.cpp`).
@@ -107,14 +122,23 @@ class ColumnShard:
         if len(small) < COMPACT_MIN_PORTIONS:
             return 0
         ids = {p.id for p in small}
-        self.portions = [p for p in self.portions if p.id not in ids]
         merged = HostBlock.concat([p.block for p in small])
         ver = max(p.version for p in small)
+        new_portions = []
+        src = frozenset().union(*(getattr(p, "src_write_ids", frozenset())
+                                  for p in small))
         for start in range(0, merged.length, self.portion_rows):
             chunk = merged.slice(start,
                                  min(start + self.portion_rows,
                                      merged.length))
-            self.portions.append(Portion.from_block(chunk, ver))
+            p2 = Portion.from_block(chunk, ver)
+            p2.src_write_ids = src
+            new_portions.append(p2)
+        # ONE rebind: a concurrent reader sees either the old set or the
+        # new set — both contain the same rows for any snapshot at or
+        # past the watermark (the eligibility gate above)
+        self.portions = [p for p in self.portions
+                         if p.id not in ids] + new_portions
         return len(small)
 
     # -- read path --------------------------------------------------------
@@ -131,16 +155,31 @@ class ColumnShard:
         list) under the snapshot, after min/max pruning. Entries (not bare
         blocks) so callers can key device caches on stable write ids."""
         prune_predicates = prune_predicates or []
+        # READ ORDER CONTRACT with indexate(): inserts FIRST, portions
+        # second. Indexate appends portions before removing consumed
+        # inserts, so a reader can see a row in both places (deduped by
+        # covered write ids below) but never in neither. Reading portions
+        # first would open exactly that missing-rows window.
+        all_inserts = self.inserts           # one read: stable list object
+        all_portions = self.portions
         portions = [
-            p for p in self.portions
+            p for p in all_portions
             if snapshot.includes(p.version)
             and not any(prune_by_range(p, c, op, v)
                         for (c, op, v) in prune_predicates)]
-        inserts = [e for e in self.inserts
-                   if (e.committed_version
-                       and snapshot.includes(e.committed_version))
-                   or (e.committed_version is None and e.tx is not None
-                       and e.tx == snapshot.tx_view)]
+        # write ids already covered by a visible portion: during the
+        # indexation window a reader can see an insert both places — the
+        # portion wins (indexate's rebind-ordering contract)
+        covered = set()
+        for p in all_portions:
+            if snapshot.includes(p.version):
+                covered.update(getattr(p, "src_write_ids", ()))
+        inserts = [e for e in all_inserts
+                   if e.write_id not in covered
+                   and ((e.committed_version
+                         and snapshot.includes(e.committed_version))
+                        or (e.committed_version is None and e.tx is not None
+                            and e.tx == snapshot.tx_view))]
         return portions, inserts
 
     def scan(self, columns: list[str],

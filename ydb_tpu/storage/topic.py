@@ -28,9 +28,13 @@ from ydb_tpu.storage import blobfile as B
 
 class TopicPartition:
     def __init__(self, path: Optional[str]):
+        import threading
         self.path = path               # None = volatile (no store)
         self.records: list = []        # [{offset, data, producer?, seq?}]
         self._producer_seq: dict = {}  # producer id -> last seq_no
+        # producers append from concurrent session threads (and the
+        # tracer sink): offset assignment + WAL append must be atomic
+        self._mu = threading.Lock()
         if path is not None:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             for rec in B.wal_replay(path):
@@ -48,18 +52,19 @@ class TopicPartition:
                seq_no: Optional[int] = None) -> Optional[int]:
         """Returns the assigned offset, or None when deduplicated
         (exactly-once: seq_no at or below the producer's high-water)."""
-        if producer is not None and seq_no is not None:
-            if seq_no <= self._producer_seq.get(producer, -1):
-                return None
-            self._producer_seq[producer] = seq_no
-        rec = {"offset": len(self.records), "data": data}
-        if producer is not None and seq_no is not None:
-            rec["producer"] = producer
-            rec["seq"] = seq_no
-        self.records.append(rec)
-        if self.path is not None:
-            B.wal_append(self.path, rec)
-        return rec["offset"]
+        with self._mu:
+            if producer is not None and seq_no is not None:
+                if seq_no <= self._producer_seq.get(producer, -1):
+                    return None
+                self._producer_seq[producer] = seq_no
+            rec = {"offset": len(self.records), "data": data}
+            if producer is not None and seq_no is not None:
+                rec["producer"] = producer
+                rec["seq"] = seq_no
+            self.records.append(rec)
+            if self.path is not None:
+                B.wal_append(self.path, rec)
+            return rec["offset"]
 
     def read(self, offset: int, limit: int = 100) -> list:
         return self.records[offset:offset + limit]

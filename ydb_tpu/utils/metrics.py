@@ -21,22 +21,28 @@ from typing import Optional
 
 
 class Counters:
-    """Hierarchical monotonic counters: `inc("engine/queries")`."""
+    """Hierarchical monotonic counters: `inc("engine/queries")`.
+    Thread-safe — concurrent sessions increment from their own threads."""
 
     def __init__(self):
+        import threading
         self._c: dict[str, float] = {}
+        self._mu = threading.Lock()
 
     def inc(self, name: str, by: float = 1) -> None:
-        self._c[name] = self._c.get(name, 0) + by
+        with self._mu:
+            self._c[name] = self._c.get(name, 0) + by
 
     def set(self, name: str, value: float) -> None:
-        self._c[name] = value
+        with self._mu:
+            self._c[name] = value
 
     def get(self, name: str) -> float:
         return self._c.get(name, 0)
 
     def snapshot(self) -> dict:
-        return dict(sorted(self._c.items()))
+        with self._mu:
+            return dict(sorted(self._c.items()))
 
 
 GLOBAL = Counters()

@@ -19,6 +19,7 @@ from typing import Callable, Optional
 class TokenBucket:
     def __init__(self, rate: float, burst: float,
                  clock: Optional[Callable[[], float]] = None):
+        import threading
         if rate <= 0 or burst <= 0:
             raise ValueError("rate and burst must be positive")
         self.rate = float(rate)
@@ -26,16 +27,18 @@ class TokenBucket:
         self._clock = clock or time.monotonic
         self._tokens = self.burst
         self._last = self._clock()
+        self._mu = threading.Lock()   # admission runs on session threads
 
     def try_acquire(self, amount: float = 1.0) -> bool:
-        now = self._clock()
-        self._tokens = min(self.burst,
-                           self._tokens + (now - self._last) * self.rate)
-        self._last = now
-        if self._tokens >= amount:
-            self._tokens -= amount
-            return True
-        return False
+        with self._mu:
+            now = self._clock()
+            self._tokens = min(self.burst,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= amount:
+                self._tokens -= amount
+                return True
+            return False
 
 
 class Quoter:

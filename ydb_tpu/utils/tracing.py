@@ -43,38 +43,59 @@ class Tracer:
     One trace per statement (`begin_trace`); `span(name)` nests under the
     innermost open span. Finished traces go to `sink` (a callable) when
     set — the engine wires this to a topic for export.
-    """
+
+    Trace state is THREAD-LOCAL: concurrent sessions each build their own
+    span tree (the reference threads TTraceId through per-request actor
+    chains for the same reason)."""
 
     def __init__(self):
-        self.spans: list[Span] = []
-        self._stack: list[Span] = []
-        self._trace_id = 0
-        self._depth = 0          # nested execute (EXPLAIN ANALYZE, DML
-        self._t0 = time.perf_counter()  # subflows) joins the outer trace
+        import threading
+        self._tls = threading.local()
+        self._t0 = time.perf_counter()
         self.sink = None
+
+    def _state(self):
+        s = self._tls
+        if not hasattr(s, "spans"):
+            s.spans, s.stack, s.trace_id, s.depth = [], [], 0, 0
+        return s
+
+    @property
+    def spans(self) -> list:
+        return self._state().spans
+
+    @property
+    def _stack(self) -> list:
+        return self._state().stack
+
+    @property
+    def _trace_id(self) -> int:
+        return self._state().trace_id
 
     def _now(self) -> float:
         return (time.perf_counter() - self._t0) * 1000.0
 
     def begin_trace(self) -> int:
-        self._depth += 1
-        if self._depth == 1:
-            self._trace_id = next(_ids)
-            self.spans = []
-            self._stack = []
-        return self._trace_id
+        s = self._state()
+        s.depth += 1
+        if s.depth == 1:
+            s.trace_id = next(_ids)
+            s.spans = []
+            s.stack = []
+        return s.trace_id
 
     def span(self, name: str, **attrs):
         return _SpanCtx(self, name, attrs)
 
     def end_trace(self) -> list[Span]:
-        self._depth = max(0, self._depth - 1)
-        if self._depth > 0:
-            return self.spans
-        out = self.spans
+        s = self._state()
+        s.depth = max(0, s.depth - 1)
+        if s.depth > 0:
+            return s.spans
+        out = s.spans
         if self.sink is not None and out:
             try:
-                self.sink([s.to_dict() for s in out])
+                self.sink([sp.to_dict() for sp in out])
             except Exception:                    # noqa: BLE001 — export
                 pass                             # must never fail a query
         return out

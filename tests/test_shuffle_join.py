@@ -1,0 +1,116 @@
+"""Distributed shuffle join: partitioned builds over the mesh.
+
+VERDICT r3 item 3: stop replicating join builds to every device. The
+build hash-partitions across mesh devices (no device holds the full
+build — pinned by construction in `partition_build`) and probe rows
+route to their key's owner via one ICI all_to_all
+(`parallel/shuffle_join.py`, the `dq_opt_join.cpp` ShuffleJoin +
+`dq_tasks_graph.h` stage-boundary analog).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ydb_tpu.parallel import make_mesh
+from ydb_tpu.query import QueryEngine
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = QueryEngine(block_rows=1 << 10, mesh=make_mesh(8))
+    e.execute("create table fact (id Int64 not null, k Int64 not null, "
+              "g Int64 not null, v Double not null, primary key (id))")
+    e.execute("create table dim (k2 Int64 not null, w Double not null, "
+              "primary key (k2))")
+    n, m = 20_000, 4_000
+    ids = np.arange(n)
+    ks = (ids * 7) % m          # some dim keys never hit
+    gs = ids % 11
+    vs = ids * 0.5
+    for lo in range(0, n, 5_000):
+        rows = ",".join(f"({i},{k},{g},{v})" for i, k, g, v in
+                        zip(ids[lo:lo+5_000], ks[lo:lo+5_000],
+                            gs[lo:lo+5_000], vs[lo:lo+5_000]))
+        e.execute(f"insert into fact (id, k, g, v) values {rows}")
+    rows = ",".join(f"({k},{k * 1.5})" for k in range(0, m, 2))
+    e.execute(f"insert into dim (k2, w) values {rows}")
+    # force the partitioned path: every build is "too big to broadcast"
+    e.executor.dist_broadcast_budget_bytes = 1
+    e.fact = pd.DataFrame({"id": ids, "k": ks, "g": gs, "v": vs})
+    e.dim = pd.DataFrame({"k2": np.arange(0, m, 2),
+                          "w": np.arange(0, m, 2) * 1.5})
+    return e
+
+
+def test_shuffle_inner_join_agg(eng):
+    got = eng.query(
+        "select g, count(*) as n, sum(v + w) as s from fact, dim "
+        "where k = k2 group by g order by g")
+    assert eng.executor.last_path == "distributed-shuffle-join"
+    j = eng.fact.merge(eng.dim, left_on="k", right_on="k2")
+    w = j.assign(s=j.v + j.w).groupby("g").agg(
+        n=("s", "size"), s=("s", "sum")).reset_index()
+    assert list(got.g) == list(w.g)
+    assert list(got.n) == list(w.n)
+    np.testing.assert_allclose(got.s, w.s, rtol=1e-9)
+    from ydb_tpu.utils.metrics import GLOBAL
+    assert GLOBAL.snapshot().get("executor/shuffle_joins", 0) >= 1
+
+
+def test_shuffle_semi_join_agg(eng):
+    got = eng.query(
+        "select g, sum(v) as s from fact where k in (select k2 from dim) "
+        "group by g order by g")
+    assert eng.executor.last_path == "distributed-shuffle-join"
+    f = eng.fact[eng.fact.k.isin(eng.dim.k2)]
+    w = f.groupby("g").v.sum().reset_index()
+    assert list(got.g) == list(w.g)
+    np.testing.assert_allclose(got.s, w.v, rtol=1e-9)
+
+
+def test_shuffle_anti_join_agg(eng):
+    got = eng.query(
+        "select g, count(*) as n from fact "
+        "where not exists (select * from dim where k2 = k) "
+        "group by g order by g")
+    assert eng.executor.last_path == "distributed-shuffle-join"
+    f = eng.fact[~eng.fact.k.isin(eng.dim.k2)]
+    w = f.groupby("g").size().reset_index(name="n")
+    assert list(got.g) == list(w.g)
+    assert list(got.n) == list(w.n)
+
+
+def test_shuffle_join_global_agg(eng):
+    got = eng.query("select sum(v * w) as s, count(*) as n "
+                    "from fact, dim where k = k2")
+    assert eng.executor.last_path == "distributed-shuffle-join"
+    j = eng.fact.merge(eng.dim, left_on="k", right_on="k2")
+    np.testing.assert_allclose(got.s[0], (j.v * j.w).sum(), rtol=1e-9)
+    assert got.n[0] == len(j)
+
+
+def test_no_device_holds_full_build(eng):
+    """Pin the partitioning contract: each device's build partition is a
+    strict subset (the point of the shuffle join)."""
+    from ydb_tpu.parallel.shuffle_join import partition_build
+    from ydb_tpu.core.block import HostBlock
+    import ydb_tpu.core.dtypes as dt
+    from ydb_tpu.core.schema import Column, Schema
+
+    n = 10_000
+    schema = Schema([Column("k", dt.DType(dt.Kind.INT64, False)),
+                     Column("w", dt.DType(dt.Kind.FLOAT64, False))])
+    hb = HostBlock.from_arrays(schema, {
+        "k": np.arange(n, dtype=np.int64),
+        "w": np.arange(n, dtype=np.float64)})
+    arrays, pschema, dicts, bcap = partition_build(hb, "k", ["w"], 8)
+    assert int(arrays["ns"].sum()) == n
+    assert all(int(c) < n for c in arrays["ns"])      # strict subsets
+    # partitions are disjoint by key hash
+    seen = set()
+    for p in range(8):
+        ks = set(arrays["keys"][p][:arrays["ns"][p]].tolist())
+        assert not (ks & seen)
+        seen |= ks
+    assert len(seen) == n

@@ -18,15 +18,15 @@ from tests.tpch_util import QUERIES, assert_frames_match, oracle
 
 SF = 0.002
 
-# queries run with a mesh configured; two-phase aggregation shapes route
-# through the ICI hash shuffle, the rest fall back to single-device
+# ALL 22 queries run with a mesh configured; two-phase aggregation shapes
+# route through the ICI hash shuffle, the rest fall back to single-device
 # execution under the same engine — either way results must match the
 # oracle (test_distributed_path_taken pins that the mesh is exercised).
-# A subset of the 22: one process accumulates hundreds of XLA CPU
-# executables across 8 virtual devices and the full set segfaults the
-# test runner; the single-device suite covers all 22.
-DIST_QUERIES = ["q1", "q3", "q4", "q5", "q6", "q10", "q12", "q14",
-                "q13", "q15", "q16", "q21"]
+# One process accumulates hundreds of XLA CPU executables across 8
+# virtual devices and used to segfault the runner past ~12 queries; the
+# fixture clears compiled-executable caches between queries to bound the
+# live-executable population.
+DIST_QUERIES = list(QUERIES)
 
 
 @pytest.fixture(scope="module")
@@ -38,8 +38,25 @@ def eng():
     return e
 
 
+def _clear_compiled(e):
+    """Drop every compiled-executable reference (engine-side caches + the
+    global jit caches) so the XLA CPU client's live-executable count stays
+    bounded across the suite."""
+    import jax
+
+    from ydb_tpu.ops import xla_exec
+    e.executor._fused_cache.clear()
+    e.executor._finalize_cache.clear()
+    e.executor._dist_aggs.clear()
+    if hasattr(e.executor, "_shuffle_joins"):
+        e.executor._shuffle_joins.clear()
+    xla_exec._GLOBAL_CACHE._cache.clear()
+    jax.clear_caches()
+
+
 @pytest.mark.parametrize("name", DIST_QUERIES)
 def test_tpch_distributed(eng, name):
+    _clear_compiled(eng)
     got = eng.query(QUERIES[name])
     want = oracle(name, eng.tpch_data)
     want.columns = list(got.columns)

@@ -19,9 +19,26 @@ precision for SQL aggregate semantics); therefore jax x64 mode is enabled
 at package import.
 """
 
+import os as _os
+
 import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: SQL engines compile one executable per
+# (program, shape-bucket) and re-create the same shapes across processes
+# (server restarts, CLI runs, benchmarks). On this platform a remote
+# compile costs seconds-to-minutes; a cache hit costs ~0.1s. Opt out with
+# YDB_TPU_JIT_CACHE=0, relocate with YDB_TPU_JIT_CACHE=/path.
+_cache_dir = _os.environ.get("YDB_TPU_JIT_CACHE", "")
+if _cache_dir != "0":
+    if not _cache_dir:
+        _cache_dir = _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), ".jax_cache")
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    except Exception:                    # noqa: BLE001 — cache is optional
+        pass
 
 # pandas 3 defaults str columns/indexes to pyarrow-backed storage, and
 # ArrowStringArray._from_sequence intermittently SEGFAULTS when a

@@ -1,0 +1,280 @@
+"""Distributed shuffle join: partitioned build + probe-row exchange.
+
+The reference's shuffle-join strategy (`dq_opt_join.cpp` EJoinAlgoType::
+ShuffleJoin over `dq_tasks_graph.h:43` task stages): when a join's build
+side is too large to broadcast to every node, BOTH sides hash-partition
+by the join key — stage N builds its partition's hash table, stage N+1
+routes each probe row to its key's owner over the interconnect.
+
+TPU shape: the build is hash-partitioned host-side (splitmix64, the same
+family as every other routing decision) with partition d committed to
+mesh device d — no device holds the full build. Probe rows arrive as the
+per-device stage-A outputs; ONE `shard_map` program buckets them by key,
+exchanges segments via `jax.lax.all_to_all` over ICI, compacts, probes
+the LOCAL build partition with a vectorized searchsorted, and runs the
+rest of the pipeline (post-join programs + partial aggregation) without
+leaving the device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ydb_tpu.core.block import HostBlock
+from ydb_tpu.core.dtypes import DType, Kind
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.ops import ir
+from ydb_tpu.ops.device import DeviceBlock, bucket_capacity
+from ydb_tpu.ops.join import _select_and_gather, build as build_table
+from ydb_tpu.ops.xla_exec import _trace_program, compress
+from ydb_tpu.parallel.shuffle import AXIS, _bucket_of, _fuse_device_blocks
+from ydb_tpu.utils.hashing import splitmix64
+
+
+def partition_build(built: HostBlock, key: str, payload: list, ndev: int):
+    """Hash-partition a build side into ndev per-device BuildTables plus
+    the padded/stacked arrays a shard_map consumes. Returns
+    (stacked arrays dict, payload schema, dictionaries, max row count)."""
+    from ydb_tpu.ops.join import _host_key
+
+    enc, valid = _host_key(built, key)
+    if valid is not None:
+        keep = np.nonzero(valid)[0]       # NULL keys never match
+        built = built.take(keep)
+        enc = enc[keep]
+    h = splitmix64(np, enc.astype(np.int64))
+    part = (h % np.uint64(ndev)).astype(np.int64)
+    tables = []
+    for p in range(ndev):
+        idx = np.nonzero(part == p)[0]
+        tables.append(build_table(built.take(idx), key, list(payload)))
+    cap = max(t.keys_sorted.shape[0] for t in tables)
+    keys = np.full((ndev, cap), np.iinfo(np.int64).max, np.int64)
+    ns = np.zeros(ndev, np.int32)
+    payload_np: dict = {n: None for n in payload}
+    pvalid_np: dict = {}
+    for p, t in enumerate(tables):
+        kcap = t.keys_sorted.shape[0]
+        keys[p, :kcap] = np.asarray(t.keys_sorted)
+        ns[p] = t.n
+        for n in payload:
+            arr = np.asarray(t.payload[n])
+            if payload_np[n] is None:
+                payload_np[n] = np.zeros((ndev, cap), arr.dtype)
+            payload_np[n][p, :len(arr)] = arr
+            pv = t.payload_valid.get(n)
+            if pv is not None:
+                pvalid_np.setdefault(
+                    n, np.zeros((ndev, cap), np.bool_))
+                pvalid_np[n][p, :len(pv)] = np.asarray(pv)
+    dicts = dict(tables[0].dictionaries) if tables else {}
+    return ({"keys": keys, "ns": ns, "payload": payload_np,
+             "pvalid": pvalid_np},
+            tables[0].schema if tables else Schema([]), dicts, cap)
+
+
+class ShuffleJoin:
+    """Compiled probe-row exchange + local probe + post-join pipeline."""
+
+    def __init__(self, mesh, in_schema: Schema, probe_key: str, kind: str,
+                 payload_cols: list, mark_col: str, not_in: bool,
+                 rest_programs: list, partial):
+        self.mesh = mesh
+        self.in_schema = in_schema
+        self.probe_key = probe_key
+        self.kind = kind
+        self.payload_cols = payload_cols       # [Column] appended by probe
+        self.mark_col = mark_col
+        self.not_in = not_in
+        self.rest_programs = rest_programs     # [ir.Program] after the join
+        self.partial = partial                 # ir.Program | None
+        self._fns: dict = {}
+
+    def _build(self, pcap: int, bcap: int, payload_names: tuple,
+               pvalid_names: tuple, param_names: tuple):
+        ndev = self.mesh.devices.size
+        in_cols = list(self.in_schema.columns)
+        names = [c.name for c in in_cols]
+        probe_key, kind, not_in = self.probe_key, self.kind, self.not_in
+        payload_cols = self.payload_cols
+        mark_col = self.mark_col
+        rest = list(self.rest_programs)
+        partial = self.partial
+
+        def per_device(arrays, valids, length, bkeys, bns, bpay, bpv,
+                       params):
+            env = {n: (arrays[n][0], valids[n][0]) for n in names}
+            glen = length[0]
+            # --- route probe rows to their key's owner (ICI all_to_all)
+            bucket = _bucket_of(env, [probe_key], ndev)
+            iota = jnp.arange(pcap, dtype=jnp.int32)
+            active = iota < glen
+            seg_d = {n: [] for n in names}
+            seg_v = {n: [] for n in names}
+            counts = []
+            for d_t in range(ndev):
+                mask = active & (bucket == d_t)
+                env_c, cnt = compress(env, glen, mask, pcap)
+                counts.append(cnt)            # seg = pcap: cannot overflow
+                for n in names:
+                    seg_d[n].append(env_c[n][0])
+                    v = env_c[n][1]
+                    seg_v[n].append(v if v is not None
+                                    else jnp.ones((pcap,), jnp.bool_))
+            stacked_d = {n: jnp.stack(seg_d[n]) for n in names}
+            stacked_v = {n: jnp.stack(seg_v[n]) for n in names}
+            cnts = jnp.stack(counts)
+            recv_d = {n: jax.lax.all_to_all(stacked_d[n], AXIS, 0, 0)
+                      for n in names}
+            recv_v = {n: jax.lax.all_to_all(stacked_v[n], AXIS, 0, 0)
+                      for n in names}
+            recv_c = jax.lax.all_to_all(cnts[:, None], AXIS, 0, 0)[:, 0]
+            flat = ndev * pcap
+            jrow = jnp.arange(pcap, dtype=jnp.int32)
+            seg_mask = (jrow[None, :] < recv_c[:, None]).reshape(-1)
+            env2 = {n: (recv_d[n].reshape(-1), recv_v[n].reshape(-1))
+                    for n in names}
+            env2, tot = compress(env2, jnp.int32(flat), seg_mask, flat)
+
+            # --- probe the LOCAL build partition (vectorized binsearch)
+            d, v = env2[probe_key]
+            enc = d.astype(jnp.int64)
+            iota2 = jnp.arange(flat, dtype=jnp.int32)
+            act2 = iota2 < tot
+            matchable = act2 if v is None else (act2 & v)
+            keys_local = bkeys[0]
+            n_local = bns[0]
+            pos = jnp.searchsorted(keys_local, enc).astype(jnp.int32)
+            safe = jnp.clip(pos, 0, bcap - 1)
+            found = (keys_local[safe] == enc) & matchable \
+                & (safe < n_local)
+            payload_local = {n: bpay[n][0] for n in payload_names}
+            pvalid_local = {n: bpv[n][0] for n in pvalid_names}
+            out_sel, gathered, gathered_valid = _select_and_gather(
+                found, safe, act2, v, n_local, kind, not_in,
+                payload_local, pvalid_local, payload_names)
+
+            schema = Schema(list(in_cols))
+            for c in payload_cols:
+                if c.name == mark_col:
+                    env2[c.name] = (found, None)
+                elif c.name in gathered:
+                    env2[c.name] = (gathered[c.name],
+                                    gathered_valid[c.name])
+                schema = Schema([x for x in schema.columns
+                                 if x.name != c.name] + [c])
+            if kind != "mark":
+                env2, tot = compress(env2, tot, out_sel, flat)
+
+            # --- rest of the pipeline + partial, all on-device
+            cap2 = flat
+            sel = None
+            for prog in rest:
+                env2, tot, sel, schema = _trace_program(
+                    prog, schema.columns, cap2, env2, tot, params, sel=sel)
+                if env2:
+                    cap2 = next(iter(env2.values()))[0].shape[0]
+            if partial is not None:
+                env2, tot, sel, schema = _trace_program(
+                    partial, schema.columns, cap2, env2, tot, params,
+                    sel=sel)
+                if env2:
+                    cap2 = next(iter(env2.values()))[0].shape[0]
+            if sel is not None:
+                env2, tot = compress(env2, tot, sel, cap2)
+            out_d = {n: env2[n][0] for n in schema.names}
+            out_v = {n: (env2[n][1] if env2[n][1] is not None
+                         else jnp.ones_like(out_d[n], dtype=jnp.bool_))
+                     for n in schema.names}
+            return out_d, out_v, tot, tuple(
+                (c.name, c.dtype.kind.value, c.dtype.nullable)
+                for c in schema.columns)
+
+        holder = {}
+
+        def wrapper(arrays, valids, lengths, bkeys, bns, bpay, bpv, params):
+            out_d, out_v, tot, sig = per_device(
+                arrays, valids, lengths, bkeys, bns, bpay, bpv, params)
+            holder["sig"] = sig
+            return ({n: x[None] for n, x in out_d.items()},
+                    {n: x[None] for n, x in out_v.items()}, tot[None])
+
+        pspec_in = (
+            {n: P(AXIS, None) for n in names},
+            {n: P(AXIS, None) for n in names},
+            P(AXIS),
+            P(AXIS, None),
+            P(AXIS),
+            {n: P(AXIS, None) for n in payload_names},
+            {n: P(AXIS, None) for n in pvalid_names},
+            {n: P() for n in param_names},
+        )
+        fn = jax.jit(jax.shard_map(
+            wrapper, mesh=self.mesh, in_specs=pspec_in,
+            out_specs=(P(AXIS, None), P(AXIS, None), P(AXIS)),
+            check_vma=False))
+        return fn, holder
+
+    def run(self, per_dev_blocks: list, build_arrays: dict, bcap: int,
+            params: dict, dicts: dict) -> list:
+        """per_dev_blocks[d]: stage-A DeviceBlocks on device d. Returns one
+        post-join (post-partial) DeviceBlock per device."""
+        ndev = self.mesh.devices.size
+        names = tuple(self.in_schema.names)
+        total_caps = [sum(b.capacity for b in blks)
+                      for blks in per_dev_blocks]
+        pcap = bucket_capacity(max(total_caps), minimum=128)
+        fused = []
+        for blks in per_dev_blocks:
+            blocks_in = tuple((b.arrays, b.valids, b.length) for b in blks)
+            caps = tuple(b.capacity for b in blks)
+            fused.append(_fuse_device_blocks(blocks_in, caps, pcap, names))
+        sh2 = NamedSharding(self.mesh, P(AXIS, None))
+        sh1 = NamedSharding(self.mesh, P(AXIS))
+        arrays = {n: jax.make_array_from_single_device_arrays(
+            (ndev, pcap), sh2, [fused[d][0][n][None] for d in range(ndev)])
+            for n in names}
+        valids = {n: jax.make_array_from_single_device_arrays(
+            (ndev, pcap), sh2, [fused[d][1][n][None] for d in range(ndev)])
+            for n in names}
+        lengths = jax.make_array_from_single_device_arrays(
+            (ndev,), sh1, [fused[d][2][None] for d in range(ndev)])
+
+        bkeys = jax.device_put(build_arrays["keys"], sh2)
+        bns = jax.device_put(build_arrays["ns"], sh1)
+        bpay = {n: jax.device_put(a, sh2)
+                for n, a in build_arrays["payload"].items()}
+        bpv = {n: jax.device_put(a, sh2)
+               for n, a in build_arrays["pvalid"].items()}
+
+        payload_names = tuple(sorted(build_arrays["payload"]))
+        pvalid_names = tuple(sorted(build_arrays["pvalid"]))
+        key = (pcap, bcap, payload_names, pvalid_names,
+               tuple(sorted(params)))
+        entry = self._fns.get(key)
+        if entry is None:
+            entry = self._build(pcap, bcap, payload_names, pvalid_names,
+                                tuple(sorted(params)))
+            self._fns[key] = entry
+        fn, holder = entry
+        dev_params = {k: jnp.asarray(v) for k, v in params.items()}
+        out_d, out_v, lens = fn(arrays, valids, lengths, bkeys, bns, bpay,
+                                bpv, dev_params)
+        out_cols = [Column(n, DType(Kind(k), nullable))
+                    for (n, k, nullable) in holder["sig"]]
+        schema = Schema(out_cols)
+        out_cap = next(iter(out_d.values())).shape[1] if out_d else 0
+        blocks = []
+        for d in range(ndev):
+            arrays_d = {c.name: out_d[c.name].addressable_shards[d].data[0]
+                        for c in out_cols}
+            valids_d = {c.name: out_v[c.name].addressable_shards[d].data[0]
+                        for c in out_cols}
+            len_d = lens.addressable_shards[d].data[0]
+            blocks.append(DeviceBlock(
+                schema, arrays_d, valids_d, len_d, out_cap,
+                {n: dc for n, dc in dicts.items() if schema.has(n)}))
+        return blocks

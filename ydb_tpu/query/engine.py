@@ -120,6 +120,13 @@ class QueryEngine:
         # per-statement result metadata is THREAD-LOCAL: concurrent
         # sessions must each see their own stats/trace/rows-affected
         self._tls = threading.local()
+        # in-flight lock-free reads register their snapshot plan step so
+        # auto-compaction's watermark never restamps portions a running
+        # SELECT still needs (autocommit snapshots are not coordinator-
+        # pinned; explicit txs pin theirs)
+        from collections import Counter as _Counter
+        self._active_reads = _Counter()
+        self._reads_mu = threading.Lock()
         # admission rate limiting (Kesus/quoter analog): meter the
         # "queries" resource via engine.quoter.set_quota(...)
         from ydb_tpu.utils.quota import Quoter
@@ -150,6 +157,28 @@ class QueryEngine:
     @last_trace.setter
     def last_trace(self, v):
         self._tls.last_trace = v
+
+    # -- in-flight read registry (compaction safety floor) -----------------
+
+    def _enter_read(self, plan_step: int) -> None:
+        with self._reads_mu:
+            self._active_reads[plan_step] += 1
+
+    def _exit_read(self, plan_step: int) -> None:
+        with self._reads_mu:
+            self._active_reads[plan_step] -= 1
+            if self._active_reads[plan_step] <= 0:
+                del self._active_reads[plan_step]
+
+    def _maintenance_watermark(self) -> int:
+        """Highest plan step background compaction may restamp up to:
+        bounded by pinned tx snapshots (coordinator) AND every in-flight
+        lock-free read."""
+        w = self.coordinator.safe_watermark()
+        with self._reads_mu:
+            if self._active_reads:
+                w = min(w, min(self._active_reads))
+        return w
 
     # -- versions (coordinator time, ydb_tpu/tx/coordinator.py) ------------
 
@@ -269,11 +298,17 @@ class QueryEngine:
             GLOBAL.inc("engine/throttled")
             raise QueryError("query rate limit exceeded (quoter: the "
                              "'queries' resource bucket is empty)")
+        from contextlib import nullcontext
+        session = session or self._default_session
+        # per-session statement serialization (SESSION_BUSY analog —
+        # concurrency comes from many sessions, not one)
+        ctx = session._mu if session is not self._default_session \
+            else nullcontext()
         self.tracer.begin_trace()
         kind_box: list = []
         ok = False
         try:
-            with self.tracer.span("statement", sql=sql[:60]):
+            with ctx, self.tracer.span("statement", sql=sql[:60]):
                 block = self._execute_traced(sql, session, kind_box)
             ok = True
             return block
@@ -350,61 +385,16 @@ class QueryEngine:
                     for name in names:
                         if self.catalog.has(name):
                             tx.lock(self.catalog.table(name))
-            if isinstance(stmt, ast.SetOp):
-                block = self._execute_set_op(stmt, snap)
-                self.executor.last_path = "set-op"
-                self._finish_stats(stats, t, block)
-                return block
-            if isinstance(stmt, ast.Select):
-                from ydb_tpu.query import window as W
-                if W.has_window(stmt):
-                    block = self._execute_windowed(stmt, snap)
-                    self._finish_stats(stats, t, block)
-                    return block
-                if stmt.relation is None:
-                    block = self._select_without_from(stmt, snap)
-                    self.executor.last_path = "literal"
-                    self._finish_stats(stats, t, block)
-                    return block
-                if self._needs_materialize(stmt):
-                    block = self._execute_materialized(stmt, snap)
-                    self._finish_stats(stats, t, block)
-                    return block
-                fp = self._table_fingerprint(stmt)
-                cached = self._plan_cache.get(sql) \
-                    if self.config.flag("enable_plan_cache") else None
-                if cached is not None and cached[0] == fp:
-                    plan = cached[1]
-                    self.plan_cache_hits += 1
-                    stats.plan_cache_hit = True
-                    GLOBAL.inc("engine/plan_cache_hits")
-                else:
-                    with self.tracer.span("plan"):
-                        plan = self.planner.plan_select(stmt)
-                    if self.config.flag("enable_plan_cache"):
-                        self._plan_cache[sql] = (fp, plan)
-                    GLOBAL.inc("engine/plan_cache_misses")
-                stats.plan_ms = t.lap()
-                # memory admission (kqp_rm_service analog): reserve the
-                # scan+build estimate; oversubscribed queries queue here
-                from ydb_tpu.query.admission import (
-                    AdmissionTimeout, estimate_plan_bytes,
-                )
-                # floor: even column-less scans (count(*)) reserve a
-                # nominal slot so admission can actually bound concurrency
-                est = max(estimate_plan_bytes(self.catalog, plan, snap),
-                          1 << 20)
+                # register the snapshot: auto-compaction must not restamp
+                # portions this lock-free read still scans
+                self._enter_read(snap.plan_step)
                 try:
-                    with self.admission.admit(est):
-                        with self.tracer.span("execute", admitted_mb=est >> 20):
-                            block = self.executor.execute(plan, snap)
-                except AdmissionTimeout as e:
-                    raise QueryError(str(e)) from e
-                self._finish_stats(stats, t, block)
-                return block
+                    return self._execute_read(stmt, sql, snap, stats, t)
+                finally:
+                    self._exit_read(snap.plan_step)
             # everything below mutates shared state — one writer at a time
             # (readers above run lock-free over their MVCC snapshots)
-            with self.lock:
+            with self.lock:   # noqa: SIM117
                 # re-take the autocommit snapshot UNDER the lock: two
                 # UPDATE v = v + 1 statements that both snapshotted before
                 # serializing here would otherwise read the same state and
@@ -461,6 +451,60 @@ class QueryEngine:
                     f"unsupported statement {type(stmt).__name__}")
         except (BindError, PlanError) as e:
             raise QueryError(str(e)) from e
+
+    def _execute_read(self, stmt, sql: str, snap, stats, t) -> HostBlock:
+        """SELECT / set-op execution — lock-free, runs concurrently."""
+        from ydb_tpu.utils.metrics import GLOBAL
+        if isinstance(stmt, ast.SetOp):
+            block = self._execute_set_op(stmt, snap)
+            self.executor.last_path = "set-op"
+            self._finish_stats(stats, t, block)
+            return block
+        from ydb_tpu.query import window as W
+        if W.has_window(stmt):
+            block = self._execute_windowed(stmt, snap)
+            self._finish_stats(stats, t, block)
+            return block
+        if stmt.relation is None:
+            block = self._select_without_from(stmt, snap)
+            self.executor.last_path = "literal"
+            self._finish_stats(stats, t, block)
+            return block
+        if self._needs_materialize(stmt):
+            block = self._execute_materialized(stmt, snap)
+            self._finish_stats(stats, t, block)
+            return block
+        fp = self._table_fingerprint(stmt)
+        cached = self._plan_cache.get(sql) \
+            if self.config.flag("enable_plan_cache") else None
+        if cached is not None and cached[0] == fp:
+            plan = cached[1]
+            self.plan_cache_hits += 1
+            stats.plan_cache_hit = True
+            GLOBAL.inc("engine/plan_cache_hits")
+        else:
+            with self.tracer.span("plan"):
+                plan = self.planner.plan_select(stmt)
+            if self.config.flag("enable_plan_cache"):
+                self._plan_cache[sql] = (fp, plan)
+            GLOBAL.inc("engine/plan_cache_misses")
+        stats.plan_ms = t.lap()
+        # memory admission (kqp_rm_service analog): reserve the
+        # scan+build estimate; oversubscribed queries queue here
+        from ydb_tpu.query.admission import (
+            AdmissionTimeout, estimate_plan_bytes,
+        )
+        # floor: even column-less scans (count(*)) reserve a
+        # nominal slot so admission can actually bound concurrency
+        est = max(estimate_plan_bytes(self.catalog, plan, snap), 1 << 20)
+        try:
+            with self.admission.admit(est):
+                with self.tracer.span("execute", admitted_mb=est >> 20):
+                    block = self.executor.execute(plan, snap)
+        except AdmissionTimeout as e:
+            raise QueryError(str(e)) from e
+        self._finish_stats(stats, t, block)
+        return block
 
     def _select_without_from(self, sel: ast.Select,
                              snap: Optional[Snapshot] = None) -> HostBlock:
@@ -1202,7 +1246,7 @@ class QueryEngine:
         writes = table.write(block)
         table.commit(writes, self._next_version())
         self.last_rows_affected = block.length
-        table.indexate(self.coordinator.safe_watermark(),
+        table.indexate(self._maintenance_watermark(),
                        compact=self.config.flag("enable_auto_compaction"))
         return _unit_block()
 
@@ -1332,7 +1376,7 @@ class QueryEngine:
             return 0
         from ydb_tpu.storage.portion import Portion
         # inserts → portions first: the WAL must
-        table.indexate(self.coordinator.safe_watermark(),
+        table.indexate(self._maintenance_watermark(),
                        compact=self.config.flag("enable_auto_compaction"))
         #                           never resurrect rewritten rows
         removed = 0

@@ -77,6 +77,10 @@ class Executor:
         # merge per key-hash partition (WideCombiner ProcessSpilled analog)
         self.merge_budget_bytes = int(
             _os.environ.get("YDB_TPU_MERGE_BUDGET", 1 << 30))
+        # mesh joins: build sides above this estimate hash-partition across
+        # devices (shuffle join) instead of replicating to every device
+        self.dist_broadcast_budget_bytes = int(
+            _os.environ.get("YDB_TPU_DIST_BROADCAST_BUDGET", 256 << 20))
 
     @property
     def last_path(self) -> str:
@@ -147,8 +151,15 @@ class Executor:
 
         if self.mesh is not None and self.mesh.devices.size > 1:
             if self._can_distribute(plan):
+                prebuilt: dict = {}
+                sj = self._try_execute_shuffle_join(plan, params, snapshot,
+                                                    prebuilt)
+                if sj is not None:
+                    self.last_path = "distributed-shuffle-join"
+                    return self._project_output(sj, plan.output)
                 self.last_path = "distributed"
-                merged = self._execute_distributed(plan, params, snapshot)
+                merged = self._execute_distributed(plan, params, snapshot,
+                                                   prebuilt)
                 return self._project_output(merged, plan.output)
             if self._can_distribute_map(plan, snapshot):
                 self.last_path = "distributed-map"
@@ -671,20 +682,18 @@ class Executor:
         return self._finalize(plan, [to_device(union)], params)
 
     def _execute_distributed(self, plan: QueryPlan, params: dict,
-                             snapshot: Snapshot) -> HostBlock:
+                             snapshot: Snapshot,
+                             prebuilt: Optional[dict] = None) -> HostBlock:
         """Scan partitions round-robin across mesh devices, run the full
         per-block pipeline (pushdown → joins → partial agg) on each
         device, hash-shuffle the partials over the mesh, merge, then run
         the remaining final program + sort/limit single-device (post-agg
         tails are small)."""
-        import dataclasses
-
-        from ydb_tpu.parallel.shuffle import DistributedAgg
-
         pipe = plan.pipeline
         devs = list(self.mesh.devices.flat)
         ndev = len(devs)
-        builds = self._prepare_builds(pipe, params, snapshot)
+        builds = self._prepare_builds(pipe, params, snapshot,
+                                      prebuilt=prebuilt)
         builds_by_dev = [[J.place(b, d) for b in builds] for d in devs]
 
         per_dev = [[] for _ in range(ndev)]
@@ -702,6 +711,146 @@ class Executor:
         # merge GroupBy runs twice (pre-shuffle local combine + post-shuffle
         # final merge) — merge aggregation is associative, so this is the
         # BlockCombineHashed → BlockMergeFinalizeHashed split
+        return self._merge_distributed_partials(plan, per_dev, params)
+
+    # -- distributed shuffle join ------------------------------------------
+
+    def _try_execute_shuffle_join(self, plan: QueryPlan, params: dict,
+                                  snapshot: Snapshot,
+                                  prebuilt: Optional[dict] = None):
+        """Shuffle join over the mesh (`dq_opt_join.cpp` ShuffleJoin): the
+        LAST join's build side hash-partitions across devices — no device
+        holds the full build — and probe rows route to their key's owner
+        via one ICI all_to_all (`parallel/shuffle_join.py`). Triggers when
+        the build's stats estimate exceeds the broadcast budget; declines
+        (→ broadcast path) for shapes the exchange doesn't cover yet:
+        float/string keys, composite hash keys, NOT IN, duplicate-key
+        inner/left builds, joins followed by further joins."""
+        from ydb_tpu.core.dtypes import DType, Kind as _K
+
+        pipe = plan.pipeline
+        join_pos = [i for i, (k, _s) in enumerate(pipe.steps)
+                    if k == "join"]
+        if not join_pos:
+            return None
+        j = join_pos[-1]
+        step = pipe.steps[j][1]
+        if step.kind not in ("inner", "left", "left_semi", "left_anti",
+                             "mark"):
+            return None
+        if step.build_hash_keys or step.not_in:
+            # composite hash keys and NOT IN null semantics stay on the
+            # broadcast path (NOT EXISTS is fine: null build keys are
+            # dropped by partition_build, matching build())
+            return None
+
+        # cheap stats gate: the build's driving-scan footprint
+        bp = getattr(step.build, "pipeline", step.build)
+        if not hasattr(bp, "scan"):
+            return None
+        from ydb_tpu.query.admission import estimate_plan_bytes
+        bplan = step.build if isinstance(step.build, QueryPlan) else None
+        est = estimate_plan_bytes(
+            self.catalog,
+            bplan if bplan is not None else QueryPlan(pipeline=step.build),
+            snapshot)
+        if est <= self.dist_broadcast_budget_bytes:
+            return None
+
+        # materialize the build side (host) and check key shape; every
+        # decline below hands the block to the broadcast path via
+        # `prebuilt` so it is never executed twice
+        if isinstance(step.build, QueryPlan):
+            built = self.execute(step.build, snapshot)
+        else:
+            built = HostBlock.concat(
+                [to_host(d) for d in
+                 self._run_pipeline(step.build, params, snapshot)])
+        if prebuilt is not None:
+            prebuilt[j] = built
+        kcd = built.columns.get(step.build_key)
+        if kcd is None or np.issubdtype(kcd.data.dtype, np.floating) \
+                or kcd.dictionary is not None:
+            return None
+        # duplicate keys: the exchange probe is first-match only
+        if step.kind in ("inner", "left", "mark"):
+            enc = built.columns[step.build_key].data
+            if len(enc) > 1 and len(np.unique(enc)) != len(enc):
+                return None
+        from ydb_tpu.parallel import shuffle_join as SJ
+        devs = list(self.mesh.devices.flat)
+        ndev = len(devs)
+        barrays, pschema, bdicts, bcap = SJ.partition_build(
+            built, step.build_key, list(step.payload), ndev)
+        if not pschema.names and step.payload:
+            return None
+
+        with self._span("shuffle-join", ndev=ndev, build_rows=built.length):
+            # stage A: pipeline prefix per device (earlier joins broadcast)
+            prefix_builds = self._prepare_builds(pipe, params, snapshot,
+                                                 until=j)
+            builds_by_dev = [[J.place(b, d) for b in prefix_builds]
+                             for d in devs]
+            per_dev = [[] for _ in range(ndev)]
+            for di, dblock in self._scan_device_blocks(pipe, snapshot,
+                                                       devices=devs):
+                per_dev[di].extend(self._run_block_multi(
+                    pipe, dblock, builds_by_dev[di], params, until=j))
+            for di in range(ndev):
+                if not per_dev[di]:
+                    empty = to_device(self._empty_scan_block(pipe),
+                                      device=devs[di])
+                    per_dev[di].extend(self._run_block_multi(
+                        pipe, empty, builds_by_dev[di], params, until=j))
+
+            in_schema = per_dev[0][0].schema
+            payload_cols = []
+            for name in pschema.names:
+                payload_cols.append(
+                    Column(name, pschema.dtype(name).with_nullable(True)))
+            if step.kind == "mark":
+                payload_cols.append(Column(step.mark_col or "__mark",
+                                           DType(_K.BOOL, False)))
+            rest = [s for (k, s) in pipe.steps[j + 1:]]
+            key = (tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
+                         for c in in_schema.columns),
+                   step.probe_key, step.kind,
+                   tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
+                         for c in payload_cols),
+                   ndev,
+                   tuple(p.fingerprint() for p in rest),
+                   pipe.partial.fingerprint() if pipe.partial else "")
+            sj = self._shuffle_joins.get(key) if hasattr(
+                self, "_shuffle_joins") else None
+            if not hasattr(self, "_shuffle_joins"):
+                self._shuffle_joins = {}
+            if sj is None:
+                sj = SJ.ShuffleJoin(self.mesh, in_schema, step.probe_key,
+                                    step.kind, payload_cols,
+                                    step.mark_col or "__mark", step.not_in,
+                                    rest, pipe.partial)
+                self._shuffle_joins[key] = sj
+            dicts = {}
+            for blks in per_dev:
+                for b in blks:
+                    dicts.update(b.dictionaries)
+            dicts.update(bdicts)
+            post_blocks = sj.run(per_dev, barrays, bcap, params, dicts)
+
+        from ydb_tpu.utils.metrics import GLOBAL
+        GLOBAL.inc("executor/shuffle_joins")
+        return self._merge_distributed_partials(plan, [[b] for b in
+                                                       post_blocks], params)
+
+    def _merge_distributed_partials(self, plan: QueryPlan, per_dev: list,
+                                    params: dict) -> HostBlock:
+        """Shared tail of the mesh paths: hash-shuffle merge of per-device
+        partial-agg blocks + the rest of the final program."""
+        import dataclasses
+
+        from ydb_tpu.parallel.shuffle import DistributedAgg
+
+        ndev = self.mesh.devices.size
         gb = plan.final_program.commands[0]
         merge_prog = ir.Program([gb])
         in_schema = per_dev[0][0].schema
@@ -710,10 +859,10 @@ class Executor:
                      for c in in_schema.columns), ndev)
         dag = self._dist_aggs.get(key)
         if dag is None:
-            dag = DistributedAgg(merge_prog, merge_prog, in_schema, self.mesh)
+            dag = DistributedAgg(merge_prog, merge_prog, in_schema,
+                                 self.mesh)
             self._dist_aggs[key] = dag
         merged = dag.run_device_blocks(per_dev, params)
-
         rest = list(plan.final_program.commands[1:])
         plan2 = dataclasses.replace(
             plan, final_program=ir.Program(rest) if rest else None)
@@ -746,17 +895,21 @@ class Executor:
         return out[0]
 
     def _run_block_multi(self, pipe: Pipeline, d: DeviceBlock, builds: list,
-                         params: dict) -> list:
+                         params: dict, until: Optional[int] = None) -> list:
         """Run one scan block through the pipeline. A GraceJoin-partitioned
         build forks the stream: probe rows route to their key's partition
         (device-side splitmix64 matches the host partitioner) and each
         partition continues through the remaining steps independently —
-        their partials merge like any other blocks."""
+        their partials merge like any other blocks.
+
+        `until`: stop BEFORE step index `until` and skip the partial (the
+        shuffle-join stage-A prefix)."""
         if pipe.pre_program is not None:
             d = run_on_device(pipe.pre_program, d, params)
+        stop = len(pipe.steps) if until is None else until
 
         def run_steps(d: DeviceBlock, si: int, bi: int) -> list:
-            while si < len(pipe.steps):
+            while si < stop:
                 kind, step = pipe.steps[si]
                 if kind != "join":
                     d = run_on_device(step, d, params)
@@ -773,7 +926,7 @@ class Executor:
                     return out
                 return self._probe_one(d, table, step, pipe, run_steps,
                                        si, bi)
-            if pipe.partial is not None:
+            if until is None and pipe.partial is not None:
                 d = run_on_device(pipe.partial, d, params)
             return [d]
 
@@ -804,31 +957,42 @@ class Executor:
         return compress_block(d, part == jnp.uint64(p))
 
     def _prepare_builds(self, pipe: Pipeline, params: dict,
-                        snapshot: Snapshot) -> list:
+                        snapshot: Snapshot,
+                        until: Optional[int] = None,
+                        prebuilt: Optional[dict] = None) -> list:
         """Prepare every join build of a pipeline in order, threading the
         probe side's string dictionaries so cross-dictionary string keys
         remap to probe codes (each table/temp owns its own dictionary —
-        raw code equality across two of them is meaningless)."""
+        raw code equality across two of them is meaningless).
+
+        `until`: only the joins among steps[:until] (shuffle-join prefix).
+        `prebuilt`: {step index: HostBlock} already-materialized build
+        sides (a declined shuffle-join attempt hands its block over)."""
         probe_dicts = dict(self.catalog.table(pipe.scan.table).dictionaries)
         # scan columns are renamed storage→internal in the env
         for (storage, internal) in pipe.scan.columns:
             if storage in probe_dicts:
                 probe_dicts[internal] = probe_dicts[storage]
         builds = []
-        for kind, step in pipe.steps:
-            if kind != "join":
+        for si, (kind, step) in enumerate(pipe.steps):
+            if kind != "join" or (until is not None and si >= until):
                 continue
             bt = self._prepare_join(step, params, snapshot,
                                     probe_dict=probe_dicts.get(
-                                        step.probe_key))
+                                        step.probe_key),
+                                    prebuilt_block=(prebuilt or {}).get(si))
             builds.append(bt)
             # payload columns join the probe namespace for later steps
             probe_dicts.update(getattr(bt, "dictionaries", None) or {})
         return builds
 
     def _prepare_join(self, step: JoinStep, params: dict,
-                      snapshot: Snapshot, probe_dict=None) -> J.BuildTable:
-        if isinstance(step.build, QueryPlan):
+                      snapshot: Snapshot, probe_dict=None,
+                      prebuilt_block: Optional[HostBlock] = None
+                      ) -> J.BuildTable:
+        if prebuilt_block is not None:
+            built = prebuilt_block
+        elif isinstance(step.build, QueryPlan):
             built = self.execute(step.build, snapshot)
         else:
             built = HostBlock.concat(

@@ -76,8 +76,15 @@ class Session:
     most one open transaction."""
 
     def __init__(self, engine):
+        import threading
         self.engine = engine
         self.tx: Optional[Transaction] = None
+        # one statement at a time per session: a client pipelining e.g.
+        # SELECT and COMMIT on the same session must not race on self.tx
+        # (the reference rejects with SESSION_BUSY; here the second
+        # statement queues). The engine-wide default session skips this —
+        # anonymous autocommit reads are the concurrent path.
+        self._mu = threading.RLock()
 
     # -- statement entry ---------------------------------------------------
 

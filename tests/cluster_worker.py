@@ -1,0 +1,66 @@
+"""Worker process for the cluster-router test: one engine, one shard.
+
+Usage: python tests/cluster_worker.py WID NWORKERS SF PORT_FILE
+
+Loads the deterministic TPC-H dataset (same seed as the test's oracle),
+keeps every `lineitem` row with index % NWORKERS == WID (the sharded
+fact), replicates the other tables (co-located joins), serves the
+ordinary gRPC front and writes the bound port to PORT_FILE.
+"""
+
+import os
+import sys
+import time
+
+# BEFORE importing ydb_tpu: the env var (not just jax.config) is what
+# disables the shared TPU jit cache for forced-CPU processes
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    wid, nw, sf, port_file = (int(sys.argv[1]), int(sys.argv[2]),
+                              float(sys.argv[3]), sys.argv[4])
+    from ydb_tpu.bench.tpch_gen import TPCH_SCHEMAS, TpchData
+    from ydb_tpu.core.block import HostBlock
+    from ydb_tpu.query import QueryEngine
+    from ydb_tpu.server import serve
+    from ydb_tpu.storage.mvcc import WriteVersion
+
+    eng = QueryEngine(block_rows=1 << 12)
+    data = TpchData(sf)
+    for tname, (schema, keys) in TPCH_SCHEMAS.items():
+        table = eng.catalog.create_table(tname, schema, keys, shards=1,
+                                         portion_rows=1 << 12)
+        arrays = data.tables[tname]
+        n = len(arrays[schema.names[0]])
+        idx = np.arange(n) if tname != "lineitem" \
+            else np.nonzero(np.arange(n) % nw == wid)[0]
+        enc = {}
+        for c in schema:
+            a = np.asarray(arrays[c.name])[idx]
+            if c.dtype.is_string:
+                enc[c.name] = table.dictionaries[c.name].encode_bulk(
+                    np.asarray(a, dtype=object))
+            else:
+                enc[c.name] = np.asarray(a, dtype=c.dtype.np)
+        block = HostBlock.from_arrays(schema, enc,
+                                      dictionaries=dict(table.dictionaries))
+        writes = table.write(block)
+        table.commit(writes, WriteVersion(1, 1))
+        table.indexate()
+
+    server, port = serve(eng, port=0)
+    with open(port_file, "w") as f:
+        f.write(str(port))
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

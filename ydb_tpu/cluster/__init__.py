@@ -1,0 +1,1 @@
+from ydb_tpu.cluster.router import ShardedCluster  # noqa: F401

@@ -1,0 +1,283 @@
+"""Multi-node seam: a shard-aware SQL router over worker engine processes.
+
+The minimal cross-host story SURVEY §5.8 calls for ("ICI intra-pod, gRPC
+across"): N independent engine processes each own a shard of every
+sharded table's rows; a router scatters rewritten SQL over the workers'
+ordinary gRPC front (DCN seam — `ydb/core/grpc_services` +
+TxProxy/Hive routing, radically simplified) and gathers:
+
+  * DDL broadcasts to every worker;
+  * INSERT routes each VALUES row by primary-key hash (the DataShard
+    key-range analog, hash instead of ranges);
+  * aggregating SELECTs decompose into per-worker PARTIAL queries
+    (sum→sum, count→count, avg→sum+count, min/max→min/max) merged by a
+    local merge query over the gathered partials — the same
+    partial/final split the in-process mesh path uses, with SQL text as
+    the wire format instead of pickled plans;
+  * non-aggregating SELECTs push limit+offset down and re-sort the
+    union.
+
+Dimension tables can be created replicated (`replicated=` in
+create_table/ShardedCluster.execute routing): every worker holds a full
+copy, so joins against them stay worker-local (broadcast-join
+co-location, as the reference expects for reference tables).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+
+from ydb_tpu.sql import ast, parse, render
+
+AGGS = ("sum", "count", "min", "max", "avg")
+
+
+class ClusterError(Exception):
+    pass
+
+
+class _AggCollector:
+    """Collect distinct aggregate calls in an expression tree and the
+    substitution from each call to its merge-side expression."""
+
+    def __init__(self):
+        self.partial_items: list = []     # [(alias, ast expr)]
+        self.merge_map: dict = {}         # FuncCall -> merge expr (ast)
+        self._n = 0
+
+    def _alias(self) -> str:
+        self._n += 1
+        return f"__a{self._n}"
+
+    def visit(self, e):
+        if isinstance(e, ast.FuncCall) and e.name in AGGS:
+            if e in self.merge_map:
+                return
+            if e.distinct:
+                raise ClusterError(
+                    "DISTINCT aggregates are not distributable over "
+                    "shards yet")
+            if e.name == "avg":
+                a_s, a_c = self._alias(), self._alias()
+                self.partial_items.append(
+                    (a_s, ast.FuncCall("sum", e.args)))
+                self.partial_items.append(
+                    (a_c, ast.FuncCall("count", e.args)))
+                self.merge_map[e] = ast.BinOp(
+                    "/",
+                    ast.FuncCall("sum", (ast.Name((a_s,)),)),
+                    ast.FuncCall("sum", (ast.Name((a_c,)),)))
+                return
+            a = self._alias()
+            self.partial_items.append((a, e))
+            merge_fn = {"sum": "sum", "count": "sum",
+                        "min": "min", "max": "max"}[e.name]
+            self.merge_map[e] = ast.FuncCall(merge_fn, (ast.Name((a,)),))
+            return
+        for f in getattr(e, "__dataclass_fields__", ()):
+            v = getattr(e, f)
+            if isinstance(v, tuple):
+                for x in v:
+                    if hasattr(x, "__dataclass_fields__"):
+                        self.visit(x)
+            elif hasattr(v, "__dataclass_fields__"):
+                self.visit(v)
+
+
+def _substitute(e, mapping: dict):
+    """Replace subtrees by the mapping (dataclass equality), recursively."""
+    if e in mapping:
+        return mapping[e]
+    if not hasattr(e, "__dataclass_fields__"):
+        return e
+
+    def rw(v):
+        if isinstance(v, tuple):
+            return tuple(rw(x) for x in v)
+        if hasattr(v, "__dataclass_fields__"):
+            return _substitute(v, mapping)
+        return v
+    try:
+        return dataclasses.replace(
+            e, **{f: rw(getattr(e, f)) for f in e.__dataclass_fields__})
+    except TypeError:
+        return e
+
+
+def _has_agg(sel: ast.Select) -> bool:
+    c = _AggCollector()
+    for it in sel.items:
+        c.visit(it.expr)
+    if sel.having is not None:
+        c.visit(sel.having)
+    return bool(c.merge_map) or bool(sel.group_by)
+
+
+class ShardedCluster:
+    """Router over worker gRPC endpoints (one engine process per shard)."""
+
+    def __init__(self, endpoints: list, merge_engine=None):
+        from ydb_tpu.query import QueryEngine
+        from ydb_tpu.server import Client
+        self.workers = [Client(ep) for ep in endpoints]
+        # local engine used for the merge stage (schema-free: merge runs
+        # over the gathered partial frame registered as a temp table)
+        self.engine = merge_engine or QueryEngine(block_rows=1 << 16)
+        self.replicated: set = set()        # table names on every worker
+        self.key_columns: dict = {}         # table -> [pk col]
+
+    # -- DDL / DML ----------------------------------------------------------
+
+    def execute(self, sql: str, replicated: bool = False):
+        """DDL: broadcast. INSERT ... VALUES: route rows by pk hash
+        (replicated tables broadcast rows instead)."""
+        stmt = parse(sql)
+        if isinstance(stmt, ast.Insert):
+            return self._route_insert(stmt, sql)
+        for w in self.workers:
+            w.execute(sql)
+        if isinstance(stmt, ast.CreateTable):
+            # remember pk for insert routing
+            self.key_columns[stmt.name] = list(stmt.primary_key)
+            if replicated:
+                self.replicated.add(stmt.name)
+        return {"ok": True}
+
+    def _route_insert(self, stmt: ast.Insert, sql: str):
+        import zlib
+
+        from ydb_tpu.utils.hashing import splitmix64
+        if stmt.table in self.replicated or stmt.query is not None:
+            for w in self.workers:
+                w.execute(sql)
+            return {"ok": True}
+        pk = self.key_columns.get(stmt.table)
+        if not pk:
+            raise ClusterError(f"unknown sharded table {stmt.table!r}")
+        if not stmt.columns:
+            raise ClusterError("routed inserts need an explicit column "
+                               "list (INSERT INTO t (cols...) VALUES ...)")
+        ki = stmt.columns.index(pk[0])
+        nw = len(self.workers)
+        per: list = [[] for _ in range(nw)]
+        for row in stmt.rows:
+            v = row[ki].value if isinstance(row[ki], ast.Literal) else None
+            if v is None:
+                raise ClusterError("insert routing needs literal pk values")
+            # deterministic across router processes (builtin hash() is
+            # PYTHONHASHSEED-randomized)
+            h = zlib.crc32(v.encode()) if isinstance(v, str) \
+                else int(splitmix64(np, np.array([v], np.int64))[0])
+            per[h % nw].append(row)
+        cols = ", ".join(stmt.columns)
+        for w, rows in zip(self.workers, per):
+            if not rows:
+                continue
+            vals = ", ".join(
+                "(" + ", ".join(render.expr(v) for v in row) + ")"
+                for row in rows)
+            w.execute(f"{stmt.mode} into {stmt.table} ({cols}) "
+                      f"values {vals}")
+        return {"ok": True}
+
+    # -- SELECT -------------------------------------------------------------
+
+    def query(self, sql: str) -> pd.DataFrame:
+        from ydb_tpu.query.window import has_window
+        stmt = parse(sql)
+        if not isinstance(stmt, ast.Select):
+            raise ClusterError("the router distributes SELECT; use "
+                               "execute() for DDL/DML")
+        if has_window(stmt):
+            raise ClusterError("window functions are not distributable "
+                               "over shards yet (per-shard windows would "
+                               "be silently wrong)")
+        if _has_agg(stmt):
+            return self._scatter_agg(stmt)
+        return self._scatter_scan(stmt)
+
+    def _gather(self, worker_sql: str) -> pd.DataFrame:
+        """Scatter one SQL text over every worker CONCURRENTLY (they are
+        separate processes — a sequential loop would serialize the very
+        work the router distributes) and union the frames."""
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=len(self.workers)) as pool:
+            resps = list(pool.map(lambda w: w.execute(worker_sql),
+                                  self.workers))
+        frames = [pd.DataFrame(r["rows"], columns=r["columns"])
+                  for r in resps]
+        return pd.concat(frames, ignore_index=True)
+
+    def _scatter_scan(self, sel: ast.Select) -> pd.DataFrame:
+        from ydb_tpu.query.window import apply_order_limit
+        lim = None if sel.limit is None else sel.limit + (sel.offset or 0)
+        worker_sel = dataclasses.replace(sel, limit=lim, offset=None)
+        df = self._gather(render.select(worker_sel))
+        if sel.distinct:
+            # per-shard DISTINCT leaves cross-shard duplicates
+            df = df.drop_duplicates(ignore_index=True)
+        return apply_order_limit(df, sel.order_by, sel.limit, sel.offset)
+
+    def _scatter_agg(self, sel: ast.Select) -> pd.DataFrame:
+        if sel.distinct or sel.ctes:
+            raise ClusterError("DISTINCT/CTE SELECTs are not "
+                               "distributable over shards yet")
+        col = _AggCollector()
+        for it in sel.items:
+            col.visit(it.expr)
+        if sel.having is not None:
+            col.visit(sel.having)
+        for o in sel.order_by:
+            col.visit(o.expr)
+
+        # group keys become named partial columns
+        gmap = {}
+        gitems = []
+        for i, g in enumerate(sel.group_by):
+            a = f"__g{i}"
+            gmap[g] = ast.Name((a,))
+            gitems.append(ast.SelectItem(g, a))
+        items = gitems + [ast.SelectItem(e, a)
+                          for (a, e) in col.partial_items]
+        worker_sel = ast.Select(
+            items=items, relation=sel.relation, where=sel.where,
+            group_by=list(sel.group_by), ctes=list(sel.ctes))
+        partial = self._gather(render.select(worker_sel))
+
+        # merge locally: substitute agg calls and group exprs, run over
+        # the gathered frame as a temp table
+        sub = {**col.merge_map, **gmap}
+        def _label(it, i):
+            if it.alias:
+                return it.alias
+            if isinstance(it.expr, ast.Name):     # single-node naming
+                return it.expr.parts[-1]
+            return f"column{i}"
+
+        mitems = [ast.SelectItem(_substitute(it.expr, sub), _label(it, i))
+                  for i, it in enumerate(sel.items)]
+        morder = [dataclasses.replace(o, expr=_substitute(o.expr, sub))
+                  for o in sel.order_by]
+        mhaving = _substitute(sel.having, sub) \
+            if sel.having is not None else None
+        mgroup = [gmap[g] for g in sel.group_by]
+
+        from ydb_tpu.core.block import HostBlock
+        eng = self.engine
+        block = HostBlock.from_pandas(partial)
+        temps: list = []
+        try:
+            tname = eng._register_temp(block, temps)
+            merge_sel = ast.Select(
+                items=mitems, relation=ast.TableRef(tname),
+                group_by=mgroup, having=mhaving, order_by=morder,
+                limit=sel.limit, offset=sel.offset)
+            return eng.query(render.select(merge_sel))
+        finally:
+            for tn in temps:
+                if eng.catalog.has(tn):
+                    eng.catalog.drop_table(tn)

@@ -31,10 +31,11 @@ _jax.config.update("jax_enable_x64", True)
 # compile costs seconds-to-minutes; a cache hit costs ~0.1s. Opt out with
 # YDB_TPU_JIT_CACHE=0, relocate with YDB_TPU_JIT_CACHE=/path.
 _cache_dir = _os.environ.get("YDB_TPU_JIT_CACHE", "")
-# forced-CPU processes (tests, virtual meshes) skip it: CPU compiles are
-# fast, and XLA:CPU AOT entries warn about host-feature mismatches across
-# processes (SIGILL risk) — the cache's value is the remote TPU compiler
-if _os.environ.get("JAX_PLATFORMS", "") == "cpu":
+# forced-CPU processes (tests, virtual meshes) skip it BY DEFAULT: CPU
+# compiles are fast, and XLA:CPU AOT entries warn about host-feature
+# mismatches across processes (SIGILL risk) — the cache's value is the
+# remote TPU compiler. An explicit YDB_TPU_JIT_CACHE path still wins.
+if not _cache_dir and _os.environ.get("JAX_PLATFORMS", "") == "cpu":
     _cache_dir = "0"
 if _cache_dir != "0":
     if not _cache_dir:

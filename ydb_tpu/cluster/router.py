@@ -117,6 +117,37 @@ def _has_agg(sel: ast.Select) -> bool:
     return bool(c.merge_map) or bool(sel.group_by)
 
 
+def _contains_subquery(node) -> bool:
+    """Any nested SELECT (CTE, derived table, IN/EXISTS/scalar subquery):
+    shipping those verbatim would compute their aggregates shard-locally
+    — silently wrong — so the router refuses them."""
+    if isinstance(node, (ast.InSubquery, ast.Exists, ast.ScalarSubquery,
+                         ast.SubqueryRef)):
+        return True
+    if isinstance(node, ast.Select) and node.ctes:
+        return True
+    for fname in getattr(node, "__dataclass_fields__", ()):
+        v = getattr(node, fname)
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vs:
+            if isinstance(x, tuple):
+                if any(_contains_subquery(y) for y in x
+                       if hasattr(y, "__dataclass_fields__")):
+                    return True
+            elif hasattr(x, "__dataclass_fields__") \
+                    and _contains_subquery(x):
+                return True
+    return False
+
+
+def _table_names(rel) -> list:
+    if isinstance(rel, ast.TableRef):
+        return [rel.name]
+    if isinstance(rel, ast.Join):
+        return _table_names(rel.left) + _table_names(rel.right)
+    return []
+
+
 class ShardedCluster:
     """Router over worker gRPC endpoints (one engine process per shard)."""
 
@@ -151,7 +182,11 @@ class ShardedCluster:
         import zlib
 
         from ydb_tpu.utils.hashing import splitmix64
-        if stmt.table in self.replicated or stmt.query is not None:
+        if stmt.query is not None and stmt.table not in self.replicated:
+            raise ClusterError(
+                "INSERT ... SELECT into a sharded table is not supported "
+                "(broadcasting would duplicate every row per worker)")
+        if stmt.table in self.replicated:
             for w in self.workers:
                 w.execute(sql)
             return {"ok": True}
@@ -196,6 +231,20 @@ class ShardedCluster:
             raise ClusterError("window functions are not distributable "
                                "over shards yet (per-shard windows would "
                                "be silently wrong)")
+        if _contains_subquery(stmt):
+            raise ClusterError("CTEs/subqueries are not distributable "
+                               "over shards yet (their aggregates would "
+                               "compute shard-locally)")
+        # at most one sharded table per query: a join between two sharded
+        # tables on non-co-hashed keys would silently drop cross-shard
+        # matches (replicated dims join worker-locally)
+        sharded = [n for n in _table_names(stmt.relation)
+                   if n not in self.replicated and n in self.key_columns]
+        if len(set(sharded)) > 1:
+            raise ClusterError(
+                f"joining multiple sharded tables ({sorted(set(sharded))}) "
+                "is not supported — create dimensions with "
+                "replicated=True")
         if _has_agg(stmt):
             return self._scatter_agg(stmt)
         return self._scatter_scan(stmt)
@@ -220,7 +269,15 @@ class ShardedCluster:
         if sel.distinct:
             # per-shard DISTINCT leaves cross-shard duplicates
             df = df.drop_duplicates(ignore_index=True)
-        return apply_order_limit(df, sel.order_by, sel.limit, sel.offset)
+        # ORDER BY the pre-alias expression: rewrite to the output alias
+        # (the merge sorts the gathered frame by column name)
+        alias_of = {it.expr: it.alias for it in sel.items if it.alias}
+        order = [dataclasses.replace(o, expr=ast.Name((alias_of[o.expr],)))
+                 if o.expr in alias_of else o for o in sel.order_by]
+        try:
+            return apply_order_limit(df, order, sel.limit, sel.offset)
+        except ValueError as e:
+            raise ClusterError(str(e)) from e
 
     def _scatter_agg(self, sel: ast.Select) -> pd.DataFrame:
         if sel.distinct or sel.ctes:

@@ -22,6 +22,9 @@ class ScanSpec:
     table: str
     columns: list                    # [(storage_name, internal_name)]
     prune: list = field(default_factory=list)   # [(storage_col, op, value)]
+    # CBO estimate: post-local-predicate cardinality (query/stats.py);
+    # -1 = not estimated
+    est_rows: float = -1.0
 
 
 @dataclass
@@ -88,6 +91,8 @@ def explain(plan: QueryPlan, indent: int = 0) -> str:
     def pipe(p: Pipeline, d: int):
         pp = "  " * d
         lines.append(f"{pp}Scan {p.scan.table} cols={[c[1] for c in p.scan.columns]}"
+                     + (f" est_rows={p.scan.est_rows:g}"
+                        if p.scan.est_rows >= 0 else "")
                      + (f" prune={p.scan.prune}" if p.scan.prune else ""))
         if p.pre_program:
             lines.append(f"{pp}  pre: {_prog(p.pre_program)}")

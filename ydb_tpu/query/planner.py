@@ -168,11 +168,21 @@ class _Rel:
 
 class Planner:
     def __init__(self, catalog):
+        import threading
         self.catalog = catalog
+        # planning keeps per-query working state on the instance (scope,
+        # sub-spec lists, eff map); concurrent lock-free SELECTs must not
+        # interleave plans. RLock: correlated subqueries re-enter via
+        # _plan_inner. Planning is microseconds; execution runs outside.
+        self._mu = threading.RLock()
 
     # -- entry -------------------------------------------------------------
 
     def plan_select(self, sel: ast.Select) -> QueryPlan:
+        with self._mu:
+            return self._plan_select_locked(sel)
+
+    def _plan_select_locked(self, sel: ast.Select) -> QueryPlan:
         if sel.relation is None:
             raise PlanError("SELECT without FROM is not supported yet")
         pool = B.ParamPool()
@@ -332,18 +342,41 @@ class Planner:
         # fact table and join spanning tree (PK edges preferred: MapJoin
         # needs unique build keys; leftover edges become residual filters).
         # Try every candidate fact and keep the tree with the fewest
-        # non-PK build sides, largest-table tie-break (a micro-CBO; the
-        # DPhyp-style join-order search of `dq_opt_join_cost_based.cpp`
-        # replaces this later).
+        # non-PK build sides, ranked by ESTIMATED post-predicate
+        # cardinality (query/stats.py — selectivity-aware effective rows,
+        # the statistics-fed cost model of `dq_opt_join_cost_based.cpp`
+        # over this executor's star-shaped plan space): the biggest
+        # surviving row stream drives the scan, well-filtered relations
+        # become broadcast builds however large their raw tables are.
+        from ydb_tpu.query import stats as S
+        eff = {a: S.effective_rows(a, r.table, r.local_preds)
+               for a, r in rels.items()}
+        # cost of a candidate tree: every relation is scanned whichever
+        # orientation we pick, so orientations differ only in their BUILD
+        # terms — each build side pays its EFFECTIVE rows (host transfer +
+        # table construction), non-PK-unique builds penalized (expanding
+        # probes, fused-path decline). Minimizing the build sum puts the
+        # largest surviving row stream in the driving scan and strongly
+        # filtered relations in tiny builds, whatever their raw sizes.
+        # The non-unique penalty is steep: such builds force expanding
+        # probes onto the portioned path, losing whole-query fusion — on
+        # this platform a constant-factor cliff, not a linear cost.
+        _BAD_MULT = 32.0
         best = None
         for cand in rels:
-            children_c, in_tree_c, leftovers_c, bad = self._spanning_tree(
-                cand, rels, edges)
+            children_c, in_tree_c, leftovers_c, scores = \
+                self._spanning_tree(cand, rels, edges, eff)
             unreachable = set(rels) - in_tree_c
-            rank = (len(unreachable), bad, -rels[cand].table.num_rows)
+            cost = 0.0
+            for a in in_tree_c:
+                if a != cand:
+                    cost += eff[a] * (1.0 if scores.get(a, 0) >= 2
+                                      else _BAD_MULT)
+            rank = (len(unreachable), cost)
             if best is None or rank < best[0]:
                 best = (rank, cand, children_c, in_tree_c, leftovers_c)
         (rank, fact, children, in_tree, leftovers) = best
+        self._eff_map = eff          # reused by _build_pipeline (EXPLAIN)
         unreachable = set(rels) - in_tree
         if unreachable:
             raise PlanError(f"no join path to {sorted(unreachable)} "
@@ -457,11 +490,15 @@ class Planner:
 
     # -- join tree ---------------------------------------------------------
 
-    def _spanning_tree(self, fact: str, rels, edges):
+    def _spanning_tree(self, fact: str, rels, edges, eff=None):
         """Prim-style tree from the fact outward over alias-pair edge
         GROUPS (all equi-conditions between a pair join together — composite
         keys). Prefer groups whose child columns cover the child table's
-        primary key, so the broadcast-join build side has unique keys."""
+        primary key, so the broadcast-join build side has unique keys;
+        among candidates, attach the smallest ESTIMATED child first
+        (`eff`: effective-cardinality map from query/stats.py)."""
+        if eff is None:
+            eff = {a: r.table.num_rows for a, r in rels.items()}
         groups: dict[tuple, list] = {}
         for (la, lname, ra, rname) in edges:
             key = (la, ra) if la <= ra else (ra, la)
@@ -472,7 +509,7 @@ class Planner:
         in_tree = {fact}
         children: dict[str, list] = {a: [] for a in rels}
         used = [False] * len(group_list)
-        bad = 0   # attachments whose build side is not PK-unique
+        scores: dict = {}   # child alias -> PK-coverage score (2 = unique)
         while True:
             best = None
             for i, ((a1, a2), pairs) in enumerate(group_list):
@@ -486,15 +523,14 @@ class Planner:
                         pk = set(rels[ca].table.key_columns)
                         score = 2 if pk <= child_cols \
                             else (1 if child_cols & pk else 0)
-                        cand = (score, -rels[ca].table.num_rows, -i,
+                        cand = (score, -eff[ca], -i,
                                 pa, ca, flip)
                         if best is None or cand[:3] > best[:3]:
                             best = cand
             if best is None:
                 break
             _s, _r, neg_i, pa, ca, flip = best
-            if _s < 2:
-                bad += 1
+            scores[ca] = _s
             used[-neg_i] = True
             in_tree.add(ca)
             pairs = group_list[-neg_i][1]
@@ -505,7 +541,7 @@ class Planner:
             if not used[i]:
                 for (lname, rname) in pairs:
                     leftovers.append((a1, lname, a2, rname))
-        return children, in_tree, leftovers, bad
+        return children, in_tree, leftovers, scores
 
     def _build_pipeline(self, alias: str, rels, children, needed,
                         binder, top: bool) -> Pipeline:
@@ -598,6 +634,11 @@ class Planner:
             if a == alias:
                 storage_cols.append((col, internal))
         scan = ScanSpec(r.table.name, storage_cols)
+        est = getattr(self, "_eff_map", {}).get(alias)
+        if est is None:              # single-relation plans skip the tree
+            from ydb_tpu.query import stats as S
+            est = S.effective_rows(alias, r.table, r.local_preds)
+        scan.est_rows = round(est, 1)
         self._extract_prune(pre, scan, r.table)
 
         out_names = sorted(own_cols)

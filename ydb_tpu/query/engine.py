@@ -1279,10 +1279,6 @@ class QueryEngine:
         table = self._table(stmt.table)
         if tx is not None:
             tx.lock(table)
-            if getattr(table, "store_kind", "column") != "row":
-                raise QueryError("UPDATE of column tables inside a "
-                                 "transaction is not supported (portion "
-                                 "rewrite is non-transactional)")
         set_cols = [c for (c, _e) in stmt.assignments]
         for c in set_cols:
             if c in table.key_columns:
@@ -1322,7 +1318,9 @@ class QueryEngine:
             self._apply_row_ops(table, ops, tx)
             self.last_rows_affected = len(ops)
             return _unit_block()
-        # column table: select full updated rows, drop originals, re-insert
+        # column table: select full updated rows at the snapshot, mark the
+        # originals deleted (MVCC delete marks — historical snapshots keep
+        # the old rows), re-insert the new versions at the same commit
         items = [ast.SelectItem(ast.Name((c,)), c)
                  for c in table.schema.names]
         items += [ast.SelectItem(e, f"__set_{c}") for (c, e) in computed]
@@ -1333,10 +1331,45 @@ class QueryEngine:
             df[c] = df.pop(f"__set_{c}")
         for c, v in const_vals.items():
             df[c] = v
-        self._column_delete(table, stmt.where)
-        if len(df):
-            table.bulk_upsert(df[list(table.schema.names)],
-                              self._next_version())
+        hits = self._column_delete_hits(table, stmt.where, snap)
+        n_hits = sum(len(rows) for (_s, _p, rows) in hits)
+        if tx is not None:
+            if n_hits != len(df):
+                # portion hits only cover indexed rows: a mismatch means
+                # the predicate matched rows STAGED by this same open tx
+                # (indexation cannot convert them) — marking would miss
+                # them and the re-insert would duplicate
+                raise QueryError(
+                    "UPDATE of rows inserted in the same transaction is "
+                    "not supported yet (commit the insert first)")
+            if not len(df):
+                self.last_rows_affected = 0
+                return _unit_block()
+            handles = table.stage_deletes(hits, tx.tx_id)
+            if handles:
+                tx.note_self_bump(table)      # stage_deletes bump
+                tx.col_deletes.append((table, handles))
+            block = HostBlock.from_pandas(
+                df[list(table.schema.names)], schema=table.schema,
+                dictionaries=table.dictionaries)
+            writes = table.write(block, tx=tx.tx_id)
+            tx.col_writes.append((table, writes))
+            tx.note_self_bump(table)  # staged write bump
+        else:
+            if not len(df):
+                self.last_rows_affected = 0
+                return _unit_block()
+            version = self._next_version()
+            block = HostBlock.from_pandas(
+                df[list(table.schema.names)], schema=table.schema,
+                dictionaries=table.dictionaries)
+            writes = table.write(block)
+            # marks + new rows in ONE commit (one intent record): a crash
+            # must never leave a pure delete or a duplicating insert
+            table.commit(writes, version, deletes=hits)
+            table.indexate(self._maintenance_watermark(),
+                           compact=self.config.flag(
+                               "enable_auto_compaction"))
         self.last_rows_affected = len(df)
         return _unit_block()
 
@@ -1344,10 +1377,6 @@ class QueryEngine:
         table = self._table(stmt.table)
         if tx is not None:
             tx.lock(table)
-            if getattr(table, "store_kind", "column") != "row":
-                raise QueryError("DELETE from column tables inside a "
-                                 "transaction is not supported (portion "
-                                 "rewrite is non-transactional)")
         if getattr(table, "store_kind", "column") == "row":
             items = [ast.SelectItem(ast.Name((k,)), k)
                      for k in table.key_columns]
@@ -1360,49 +1389,58 @@ class QueryEngine:
             self._apply_row_ops(table, ops, tx)
             self.last_rows_affected = len(ops)
             return _unit_block()
-        self.last_rows_affected = self._column_delete(table, stmt.where)
+        hits = self._column_delete_hits(table, stmt.where, snap)
+        n = sum(len(rows) for (_s, _p, rows) in hits)
+        if tx is not None:
+            cnt = int(self._run_select(ast.Select(
+                items=[ast.SelectItem(
+                    ast.FuncCall("count", (), star=True), "c")],
+                relation=ast.TableRef(stmt.table),
+                where=stmt.where), snap).to_pandas().iloc[0, 0])
+            if n != cnt:
+                raise QueryError(
+                    "DELETE of rows inserted in the same transaction is "
+                    "not supported yet (commit the insert first)")
+            handles = table.stage_deletes(hits, tx.tx_id)
+            if handles:
+                tx.note_self_bump(table)
+                tx.col_deletes.append((table, handles))
+        elif hits:
+            table.apply_deletes(hits, self._next_version())
+        self.last_rows_affected = n
         return _unit_block()
 
-    def _column_delete(self, table, where) -> int:
-        """Delete by predicate on a column table via portion rewrite."""
-        import pandas as pd
-
+    def _column_delete_hits(self, table, where, snap=None) -> list:
+        """Matching rows per portion at the snapshot: [(shard, portion,
+        row indices)] — the input of the MVCC delete-mark path (the r3
+        portion-rewrite delete destroyed time travel; marks preserve it)."""
         keys = table.key_columns
         pks = self._run_select(ast.Select(
             items=[ast.SelectItem(ast.Name((k,)), k) for k in keys],
             relation=ast.TableRef(table.name),
-            where=where)).to_pandas().drop_duplicates()
+            where=where), snap).to_pandas().drop_duplicates()
         if pks.empty:
-            return 0
-        from ydb_tpu.storage.portion import Portion
-        # inserts → portions first: the WAL must
+            return []
+        # inserts → portions first: marks attach to portions (staged
+        # inserts are transient; indexation makes them markable)
         table.indexate(self._maintenance_watermark(),
                        compact=self.config.flag("enable_auto_compaction"))
-        #                           never resurrect rewritten rows
-        removed = 0
+        snap = snap or self.snapshot()
+        hits = []
         for shard in table.shards:
-            new_portions = []
-            changed = False
             for p in shard.portions:
+                if not snap.includes(p.version):
+                    continue
                 kdf = p.block.select(keys).to_pandas()
                 kdf["__pos"] = np.arange(len(kdf))
-                hit = kdf.merge(pks, on=keys, how="inner")["__pos"]
-                if not len(hit):
-                    new_portions.append(p)
-                    continue
-                changed = True
-                removed += len(hit)
-                keep = np.setdiff1d(np.arange(p.num_rows),
-                                    hit.to_numpy())
-                if len(keep):
-                    new_portions.append(
-                        Portion.from_block(p.block.take(keep), p.version))
-            if changed:
-                shard.portions = new_portions
-                if table.store is not None:
-                    table.store.save_indexation(table, shard)
-        table.data_version += 1   # invalidate plan/superblock caches
-        return removed
+                hit = kdf.merge(pks, on=keys, how="inner")["__pos"] \
+                         .to_numpy()
+                dead = p.visible_dead(snap)
+                if dead is not None:
+                    hit = np.setdiff1d(hit, dead)
+                if len(hit):
+                    hits.append((shard, p, hit))
+        return hits
 
     def _insert_select(self, stmt: ast.Insert, table, snap=None,
                        tx=None) -> HostBlock:

@@ -1067,6 +1067,20 @@ class Executor:
             portions, insert_entries = shard.scan_sources(
                 snapshot, pipe.scan.prune or None)
             for p in portions:
+                if p.deletes and p.delete_sig(snapshot):
+                    # MVCC delete marks: scan the filtered view uncached
+                    # (the view is snapshot-specific; the mark set is in
+                    # the superblock cache key on the fused path)
+                    hb = _rename_block(
+                        p.visible_block(snapshot).select(storage_names),
+                        rename)
+                    if devices is None:
+                        yield to_device(hb)
+                    else:
+                        di = i % len(devices)
+                        i += 1
+                        yield di, to_device(hb, device=devices[di])
+                    continue
                 if devices is None:
                     yield self.device_cache.device_block(p, storage_names,
                                                          rename)

@@ -31,13 +31,20 @@ DEFAULT_BUDGET = int(_os.environ.get("YDB_TPU_HBM_BUDGET", 10 << 30))
 def enumerate_scan_sources(table, snapshot, prune):
     """Every visible scan source of a table: (HostBlocks, source ids).
     Source ids key superblock cache entries (write id, not list position:
-    two snapshots seeing different insert subsets must not collide)."""
+    two snapshots seeing different insert subsets must not collide).
+    Portions with MVCC delete marks visible at the snapshot contribute
+    their filtered view under an id that carries the visible mark set."""
     sources, src_ids = [], []
     for shard in table.shards:
         portions, insert_entries = shard.scan_sources(snapshot, prune)
         for p in portions:
-            sources.append(p.block)
-            src_ids.append(("p", p.id))
+            sig = p.delete_sig(snapshot) if p.deletes else ()
+            if sig:
+                sources.append(p.visible_block(snapshot))
+                src_ids.append(("pv", p.id, sig))
+            else:
+                sources.append(p.block)
+                src_ids.append(("p", p.id))
         for e in insert_entries:
             sources.append(e.block)
             src_ids.append(("i", shard.shard_id, e.write_id))

@@ -35,6 +35,8 @@ import json
 import os
 from typing import Optional
 
+import numpy as np
+
 from ydb_tpu.core.block import HostBlock
 from ydb_tpu.core.dictionary import Dictionary
 from ydb_tpu.core.dtypes import DType, Kind
@@ -162,23 +164,35 @@ class Store:
                           "tx_id": version.tx_id})
 
     def commit_table(self, table: str, shard_wids: dict,
-                     version: WriteVersion) -> None:
-        """Atomic multi-shard commit: an INTENT record covering every
-        shard's write ids lands (fsynced) BEFORE the per-shard commit
-        records, and a DONE record after. A crash between shard commits
-        is healed at boot by re-applying intents without a matching DONE
-        — the coordinator plan-step + readset-confirmation shape of the
-        reference, collapsed to one durable journal
-        (`ydb/core/tx/coordinator/coordinator__plan_step.cpp`)."""
-        if len(shard_wids) > 1:
+                     version: WriteVersion,
+                     deletes: Optional[list] = None) -> None:
+        """Atomic multi-part commit: an INTENT record covering every
+        shard's write ids AND delete marks lands (fsynced) BEFORE the
+        per-shard records, and a DONE record after. A crash between the
+        records is healed at boot by re-applying intents without a
+        matching DONE — the coordinator plan-step + readset-confirmation
+        shape of the reference, collapsed to one durable journal
+        (`ydb/core/tx/coordinator/coordinator__plan_step.cpp`).
+        `deletes`: [(shard_id, portion_id, row index list)] — an UPDATE's
+        marks and re-inserts must never be durable separately."""
+        deletes = deletes or []
+        need_intent = len(shard_wids) > 1 \
+            or (bool(deletes) and bool(shard_wids)) or len(deletes) > 1
+        if need_intent:
             self._intent_append(table, {
                 "op": "intent", "plan_step": version.plan_step,
                 "tx_id": version.tx_id,
                 "shards": {str(sid): wids
-                           for sid, wids in shard_wids.items()}})
+                           for sid, wids in shard_wids.items()},
+                "deletes": [[int(sid), int(pid), rows]
+                            for (sid, pid, rows) in deletes]})
         for sid, wids in shard_wids.items():
             self.wal_commit(table, sid, wids, version)
-        if len(shard_wids) > 1:
+        for (sid, pid, rows) in deletes:
+            # always fsynced: compact_intents may drop a delete-bearing
+            # intent before the next manifest persists the marks
+            self.wal_delete(table, sid, pid, version, rows)
+        if need_intent:
             # losing the DONE is harmless (healing re-applies the commit
             # idempotently) — skip the second fsync on the commit path
             self._intent_append(table, {
@@ -220,6 +234,16 @@ class Store:
                 keep.append(rec)
         B.wal_rewrite(path, keep)
 
+    def wal_delete(self, table: str, shard: int, portion_id: int,
+                   version: WriteVersion, rows, sync: bool = True) -> None:
+        """Durable MVCC delete mark (fsynced before the statement acks,
+        unless an intent record already covers the outcome)."""
+        B.wal_append(os.path.join(self._sdir(table, shard), "wal.bin"),
+                     {"op": "delete", "portion": portion_id,
+                      "plan_step": version.plan_step,
+                      "tx_id": version.tx_id,
+                      "rows": [int(r) for r in rows]}, sync=sync)
+
     def wal_abort(self, table: str, shard: int, wids: list) -> None:
         self._wal_append(self._sdir(table, shard),
                          {"op": "abort", "wids": wids})
@@ -238,9 +262,18 @@ class Store:
             path = os.path.join(sdir, f"portion_{p.id}.ydbp")
             if not os.path.exists(path):
                 B.write_portion(path, p.block)
-            live.append({"id": p.id, "rows": p.num_rows,
-                         "plan_step": p.version.plan_step,
-                         "tx_id": p.version.tx_id})
+            entry = {"id": p.id, "rows": p.num_rows,
+                     "plan_step": p.version.plan_step,
+                     "tx_id": p.version.tx_id}
+            committed_marks = [m for m in p.deletes
+                               if m.version is not None]
+            if committed_marks:
+                entry["deletes"] = [
+                    {"plan_step": m.version.plan_step,
+                     "tx_id": m.version.tx_id,
+                     "rows": [int(r) for r in m.rows]}
+                    for m in committed_marks]
+            live.append(entry)
         # a write id is replayable iff still pending here, or newer than
         # anything this manifest knew about (a single high-water mark would
         # be wrong when an old uncommitted write outlives newer consumed
@@ -390,6 +423,11 @@ class Store:
                     p = Portion.from_block(
                         block, WriteVersion(e["plan_step"], e["tx_id"]),
                         id=e["id"])
+                    for dm in e.get("deletes", []):
+                        p.add_delete(np.array(dm["rows"], np.int64),
+                                     version=WriteVersion(dm["plan_step"],
+                                                          dm["tx_id"]))
+                        seen_step = max(seen_step, dm["plan_step"])
                     shard.portions.append(p)
                     _portion_ids.ensure_above(e["id"])
                     seen_step = max(seen_step, e["plan_step"])
@@ -436,6 +474,18 @@ class Store:
                     elif rec["op"] == "abort":
                         for wid in rec["wids"]:
                             staged.pop(wid, None)
+                    elif rec["op"] == "delete":
+                        # MVCC delete mark landed after the last manifest;
+                        # duplicate application (manifest + WAL) is
+                        # harmless — visibility unions row sets
+                        ver = WriteVersion(rec["plan_step"], rec["tx_id"])
+                        seen_step = max(seen_step, ver.plan_step)
+                        for p in shard.portions:
+                            if p.id == rec["portion"]:
+                                p.add_delete(np.array(rec["rows"],
+                                                      np.int64),
+                                             version=ver)
+                                break
                 for wid in sorted(staged):
                     shard.inserts.append(staged[wid])
                     if staged[wid].committed_version:
@@ -455,6 +505,15 @@ class Store:
                             e.committed_version = ver
                             e.tx = None
                             sh.rows_written += e.block.length
+                # heal the commit's delete marks too (idempotent: the
+                # mark union makes duplicate application harmless)
+                for (sid, pid, rows) in rec.get("deletes", []):
+                    sh = t.shards[int(sid)]
+                    for p in sh.portions:
+                        if p.id == pid:
+                            p.add_delete(np.array(rows, np.int64),
+                                         version=ver)
+                            break
             # re-arm durability: post-recovery writes must persist too
             t.store = self
         # heal serial counters against data maxima: the catalog save can

@@ -115,12 +115,15 @@ class ColumnShard:
         with the coordinator keep only per-portion granularity (the
         reference tracks per-row versions inside portions — a later
         refinement here)."""
+        folded = self._fold_deletes(watermark)
         small = [p for p in self.portions
                  if p.num_rows < self.portion_rows // 2
+                 and not p.deletes      # freshly marked portions wait for
+                 #                        their marks to pass the watermark
                  and (watermark is None
                       or p.version.plan_step <= watermark)]
         if len(small) < COMPACT_MIN_PORTIONS:
-            return 0
+            return folded
         ids = {p.id for p in small}
         merged = HostBlock.concat([p.block for p in small])
         ver = max(p.version for p in small)
@@ -139,7 +142,38 @@ class ColumnShard:
         # past the watermark (the eligibility gate above)
         self.portions = [p for p in self.portions
                          if p.id not in ids] + new_portions
-        return len(small)
+        return len(small) + folded
+
+    def _fold_deletes(self, watermark: Optional[int]) -> int:
+        """Reclaim delete-marked rows: a portion whose every mark is
+        committed at or below the watermark rewrites without the dead
+        rows (new portion at the newest involved version) — TTL/DELETE
+        must eventually free memory and disk, and every reader at or past
+        the watermark sees identical data either way."""
+        if watermark is None:
+            return 0
+        replaced, removed_ids = [], set()
+        for p in self.portions:
+            if not p.deletes or p.version.plan_step > watermark:
+                continue
+            if not all(m.version is not None
+                       and m.version.plan_step <= watermark
+                       for m in p.deletes):
+                continue
+            dead = np.unique(np.concatenate([m.rows for m in p.deletes]))
+            ver = max([p.version] + [m.version for m in p.deletes])
+            removed_ids.add(p.id)
+            keep = np.setdiff1d(np.arange(p.num_rows, dtype=np.int64),
+                                dead)
+            if len(keep):
+                p2 = Portion.from_block(p.block.take(keep), ver)
+                p2.src_write_ids = getattr(p, "src_write_ids", frozenset())
+                replaced.append(p2)
+        if not removed_ids:
+            return 0
+        self.portions = [p for p in self.portions
+                         if p.id not in removed_ids] + replaced
+        return len(removed_ids)
 
     # -- read path --------------------------------------------------------
 
@@ -204,8 +238,8 @@ class ColumnShard:
 
         portions, insert_entries = self.scan_sources(snapshot,
                                                      prune_predicates)
-        sources = [p.block for p in portions] + [e.block
-                                                 for e in insert_entries]
+        sources = [p.visible_block(snapshot) for p in portions] \
+            + [e.block for e in insert_entries]
 
         for src in sources:
             blk = src.select(columns)

@@ -104,18 +104,66 @@ class ColumnTable:
                 self.store.wal_abort(self.name, sid, wids)
         self.data_version += 1
 
-    def commit(self, writes: list[tuple[int, int]], version: WriteVersion) -> None:
+    def commit(self, writes: list[tuple[int, int]],
+               version: WriteVersion, deletes: Optional[list] = None) -> None:
+        """Commit staged writes and/or MVCC delete marks ATOMICALLY:
+        `deletes` = [(shard, portion, row indices)]. One intent-journal
+        record covers both — a crash mid-commit heals to all-or-nothing
+        (an UPDATE is delete marks + new rows; losing one half would be
+        a data-losing pure delete or a duplicating pure insert)."""
         by_shard: dict[int, list[int]] = {}
         for sid, wid in writes:
             by_shard.setdefault(sid, []).append(wid)
+        hits = deletes or []
+        if self.store is not None and (by_shard or hits):
+            # durable FIRST: the in-memory state below must never be
+            # acknowledged unless it can be recovered
+            self.store.commit_table(
+                self.name, by_shard, version,
+                deletes=[(s.shard_id, p.id, [int(r) for r in rows])
+                         for (s, p, rows) in hits])
         for sid, wids in by_shard.items():
             self.shards[sid].commit(wids, version)
+        for (_shard, portion, rows) in hits:
+            portion.add_delete(rows, version=version)
         self.data_version += 1
         if self.store is not None:
-            # atomic across shards: intent journal + per-shard records
-            self.store.commit_table(self.name, by_shard, version)
             self.store.save_dictionaries(self)
             self.store.save_state(version.plan_step)
+
+    # -- MVCC deletes (transactional column DML) ---------------------------
+
+    def apply_deletes(self, hits: list, version: WriteVersion) -> int:
+        """Commit delete marks: `hits` = [(shard, portion, row indices)].
+        Historical snapshots keep seeing the rows (time travel preserved —
+        the r3 portion-rewrite path destroyed it)."""
+        hits = [h for h in hits if len(h[2])]
+        if not hits:
+            return 0                   # no-op: no bump, no WAL — a match-
+        #                                nothing DELETE must not abort
+        #                                concurrent optimistic txs
+        self.commit([], version, deletes=hits)
+        return sum(len(rows) for (_s, _p, rows) in hits)
+
+    def stage_deletes(self, hits: list, tx: int) -> list:
+        """Stage delete marks for an open tx (visible only through its
+        tx_view); returns handles for commit/rollback."""
+        handles = []
+        for (shard, portion, rows) in hits:
+            if not len(rows):
+                continue
+            handles.append((shard, portion,
+                            portion.add_delete(rows, tx=tx)))
+        if handles:
+            self.data_version += 1   # own snapshot changes; re-fingerprint
+        return handles
+
+    def rollback_deletes(self, handles: list) -> None:
+        if not handles:
+            return
+        for (_shard, portion, mark) in handles:
+            portion.drop_delete(mark)
+        self.data_version += 1
 
     def indexate(self, watermark: Optional[int] = None,
                  compact: bool = True) -> int:

@@ -53,6 +53,7 @@ class Transaction:
         self.locks: dict = {}
         self.row_writes: list = []     # (table, ops) in apply order
         self.col_writes: list = []     # (table, [(shard, wid)])
+        self.col_deletes: list = []    # (table, [delete-mark handles])
 
     def lock(self, table) -> None:
         if table.uid not in self.locks:
@@ -116,8 +117,22 @@ class Session:
         version = self.engine.coordinator.propose(tx.tx_id)
         for table, ops in tx.row_writes:
             table.stamp_tx(tx.tx_id, version, ops_for_wal=ops)
+        # group column writes + delete marks PER TABLE: one commit call
+        # carries both through one intent-journal record (an UPDATE's
+        # deletes and re-inserts must survive a crash together)
+        col_tables: dict = {}
         for table, writes in tx.col_writes:
-            table.commit(writes, version)
+            ent = col_tables.setdefault(id(table), [table, [], []])
+            ent[1].extend(writes)
+        for table, handles in tx.col_deletes:
+            ent = col_tables.setdefault(id(table), [table, [], []])
+            ent[2].extend(handles)
+        for (table, writes, handles) in col_tables.values():
+            hits = [(shard, portion, mark.rows)
+                    for (shard, portion, mark) in handles]
+            for (_shard, portion, mark) in handles:
+                portion.drop_delete(mark)      # replaced by committed marks
+            table.commit(writes, version, deletes=hits)
             table.indexate()
         if self.engine.catalog.store is not None:
             self.engine.catalog.store.save_state(version.plan_step)
@@ -131,6 +146,8 @@ class Session:
     def _abort(self, tx: Transaction) -> None:
         for table, _ops in tx.row_writes:
             table.rollback_tx(tx.tx_id)
+        for table, handles in tx.col_deletes:
+            table.rollback_deletes(handles)
         for table, writes in tx.col_writes:
             table.rollback(writes)
         self.engine.coordinator.unpin_snapshot(tx.tx_id)

@@ -85,6 +85,68 @@ class PgClient:
             raise RuntimeError(fields.get("M", "pg error"))
         return cols, rows, tag
 
+    # -- extended protocol --------------------------------------------------
+
+    def _send(self, tag: bytes, body: bytes):
+        self.sock.sendall(tag + struct.pack("!I", len(body) + 4) + body)
+
+    def prepare(self, name: str, sql: str, oids=()):
+        body = name.encode() + b"\0" + sql.encode() + b"\0"
+        body += struct.pack("!H", len(oids))
+        for o in oids:
+            body += struct.pack("!I", o)
+        self._send(b"P", body)
+
+    def bind(self, portal: str, name: str, params):
+        body = portal.encode() + b"\0" + name.encode() + b"\0"
+        body += struct.pack("!H", 1) + struct.pack("!H", 0)  # all text
+        body += struct.pack("!H", len(params))
+        for p in params:
+            if p is None:
+                body += struct.pack("!i", -1)
+            else:
+                enc = str(p).encode()
+                body += struct.pack("!i", len(enc)) + enc
+        body += struct.pack("!H", 0)
+        self._send(b"B", body)
+
+    def execute_portal(self, portal: str = ""):
+        self._send(b"D", b"P" + portal.encode() + b"\0")
+        self._send(b"E", portal.encode() + b"\0" + struct.pack("!i", 0))
+        self._send(b"S", b"")
+        msgs = self._drain_until_ready()
+        cols, rows, tag, err = [], [], None, None
+        for t, payload in msgs:
+            if t == b"T":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                for _ in range(n):
+                    end = payload.index(b"\0", off)
+                    cols.append(payload[off:end].decode())
+                    off = end + 1 + 18
+            elif t == b"D":
+                (n,) = struct.unpack("!H", payload[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack("!i", payload[off:off + 4])
+                    off += 4
+                    if ln == -1:
+                        row.append(None)
+                    else:
+                        row.append(payload[off:off + ln].decode())
+                        off += ln
+                rows.append(row)
+            elif t == b"C":
+                tag = payload.rstrip(b"\0").decode()
+            elif t == b"E":
+                err = payload
+        if err is not None:
+            fields = {chr(p[0]): p[1:].decode()
+                      for p in err.split(b"\0") if p}
+            raise RuntimeError(fields.get("M", "pg error"))
+        return cols, rows, tag
+
     def close(self):
         self.sock.sendall(b"X" + struct.pack("!I", 4))
         self.sock.close()
@@ -186,3 +248,79 @@ def test_ddl_command_tags(pg):
     _c, _r, tag = c.query("drop table dt")
     assert tag == "DROP TABLE"
     c.close()
+
+
+def test_extended_protocol_typed_params(pg):
+    """Parse/Bind/Execute with $n placeholders and typed TEXT params —
+    the extended-protocol flow psycopg-style clients drive."""
+    c = PgClient(pg.port)
+    c.prepare("s1", "select id, name from t where id = $1 and ok = $2",
+              oids=(20, 16))
+    c.bind("", "s1", [1, "t"])
+    cols, rows, tag = c.execute_portal("")
+    assert cols == ["id", "name"]
+    assert rows == [["1", "alpha"]]
+    # rebind the SAME prepared statement with different params
+    c.bind("", "s1", [2, "f"])
+    _c, rows, _t = c.execute_portal("")
+    assert rows == [["2", None]]
+    c.close()
+
+
+def test_extended_protocol_null_string_date(pg):
+    c = PgClient(pg.port)
+    c.prepare("s2", "select count(*) as n from t where name = $1")
+    c.bind("", "s2", ["alpha"])
+    _c, rows, _t = c.execute_portal("")
+    assert rows == [["1"]]
+    c.prepare("s3", "select count(*) as n from t where d < $1",
+              oids=(1082,))
+    c.bind("", "s3", ["2021-01-01"])
+    _c, rows, _t = c.execute_portal("")
+    assert rows == [["1"]]
+    c.close()
+
+
+def test_extended_protocol_dml_and_injection(pg):
+    c = PgClient(pg.port)
+    c.query("create table ep (k Int64 not null, s Utf8, "
+            "primary key (k))")
+    c.prepare("ins", "insert into ep (k, s) values ($1, $2)")
+    c.bind("", "ins", [7, "it''s; drop table ep"])
+    _c, _r, tag = c.execute_portal("")
+    assert tag == "INSERT 0 1"
+    # NULL parameter lands as SQL NULL
+    c.bind("", "ins", [8, None])
+    _c, _r, tag = c.execute_portal("")
+    assert tag == "INSERT 0 1"
+    _c, rows, _t = c.query("select count(*) as n from ep where s is null")
+    assert rows == [["1"]]
+    c.query("delete from ep where k = 8")
+    _c, rows, _t = c.query("select s from ep where k = 7")
+    assert rows == [["it''s; drop table ep"]]
+    # malformed numeric param for an int oid refuses instead of splicing
+    c.prepare("bad", "select * from ep where k = $1", oids=(20,))
+    c.bind("", "bad", ["1; drop table ep"])
+    with pytest.raises(RuntimeError):
+        c.execute_portal("")
+    _c, rows, _t = c.query("select count(*) as n from ep")
+    assert rows == [["1"]]
+    c.query("drop table ep")
+    c.close()
+
+
+def test_grpc_token_auth():
+    pytest.importorskip("grpc")
+    from ydb_tpu.server import Client, serve
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table au (k Int64 not null, primary key (k))")
+    server, port = serve(eng, port=0, token="sekrit")
+    try:
+        bad = Client(f"127.0.0.1:{port}")
+        with pytest.raises(RuntimeError, match="Unauthenticated"):
+            bad.execute("select 1 as x")
+        good = Client(f"127.0.0.1:{port}", token="sekrit")
+        assert good.execute("select 1 as x")["rows"] == [[1]]
+        assert good.ping()          # probes stay open
+    finally:
+        server.stop(0)

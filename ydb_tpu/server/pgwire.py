@@ -1,4 +1,4 @@
-"""PostgreSQL wire-protocol front (v3, simple query flow).
+"""PostgreSQL wire-protocol front (v3, simple + extended query flow).
 
 The reference serves the PG wire protocol next to gRPC
 (`ydb/core/local_pgwire/`, `ydb/apps/pgwire` — startup/auth handshake,
@@ -11,11 +11,14 @@ Supported flow:
     ParameterStatus + BackendKeyData + ReadyForQuery
   * 'Q' (simple query) → RowDescription / DataRow* / CommandComplete /
     ReadyForQuery — text format, one statement per message
+  * extended protocol: Parse ('P') with $n placeholders and optional
+    param type oids, Bind ('B') with TEXT-format params (validated and
+    inlined as typed literals — the proxy-style parameterization),
+    Describe ('D'→ NoData; row descriptions ride Execute), Execute
+    ('E'), Close ('C'), Sync ('S'), Flush ('H')
   * BEGIN/COMMIT/ROLLBACK ride the per-connection session, and the
     ReadyForQuery status byte tracks it ('I' idle / 'T' in tx)
   * 'X' terminate; errors → ErrorResponse (severity/code/message)
-Extended-protocol messages (Parse/Bind/Execute) answer with a clear
-ErrorResponse — clients in simple-query mode (psql) work.
 """
 
 from __future__ import annotations
@@ -57,6 +60,79 @@ def _oid_and_enc(kind: str):
 
 def _msg(tag: bytes, payload: bytes) -> bytes:
     return tag + struct.pack("!I", len(payload) + 4) + payload
+
+
+_INT_OIDS = (20, 21, 23, 26)
+_FLOAT_OIDS = (700, 701, 1700)
+
+
+def _substitute_params(sql: str, params: list, oids: list) -> str:
+    """Inline TEXT-format parameters as validated typed literals (the
+    proxy-style parameterization: the engine's own planner re-binds them
+    as runtime params where it can). Numerics are parsed — a malformed
+    value raises instead of splicing into the SQL text."""
+    import re
+
+    def lit(m):
+        i = int(m.group(1)) - 1
+        if i < 0 or i >= len(params):
+            raise ValueError(f"parameter ${i + 1} not bound")
+        v = params[i]
+        if v is None:
+            return "NULL"
+        oid = oids[i] if i < len(oids) else 0
+        if oid in _INT_OIDS:
+            return str(int(v))
+        if oid in _FLOAT_OIDS:
+            return repr(float(v))
+        if oid == 16:
+            return "TRUE" if v.lower() in ("t", "true", "1", "on") \
+                else "FALSE"
+        if oid == 1082:
+            if not re.fullmatch(r"\d{4}-\d{2}-\d{2}", v):
+                raise ValueError(f"bad date parameter {v!r}")
+            return f"date '{v}'"
+        if oid in (0, 705):              # unspecified: sniff the text
+            if re.fullmatch(r"[+-]?\d+", v):
+                return v
+            if re.fullmatch(r"[+-]?\d*\.\d+([eE][+-]?\d+)?", v):
+                return v
+            if re.fullmatch(r"\d{4}-\d{2}-\d{2}", v):
+                return f"date '{v}'"
+        s = v.replace("'", "''")
+        return f"'{s}'"
+
+    # quote-aware scan: $n inside a '...' literal is literal text, not a
+    # placeholder (re.sub over the whole text would rewrite it)
+    out = []
+    i, n = 0, len(sql)
+    in_str = False
+    while i < n:
+        ch = sql[i]
+        if in_str:
+            out.append(ch)
+            if ch == "'":
+                if i + 1 < n and sql[i + 1] == "'":
+                    out.append("'")
+                    i += 1
+                else:
+                    in_str = False
+            i += 1
+            continue
+        if ch == "'":
+            in_str = True
+            out.append(ch)
+            i += 1
+            continue
+        if ch == "$":
+            m = re.match(r"\$(\d+)", sql[i:])
+            if m:
+                out.append(lit(m))
+                i += m.end()
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
 
 
 def _cstr(s: str) -> bytes:
@@ -112,6 +188,17 @@ class _Handler(socketserver.BaseRequestHandler):
 
             session = srv.engine.session()
             self._aborted = False      # PG aborted-transaction state
+            self._stmts: dict = {}     # name -> (sql, [oid])
+            self._portals: dict = {}   # name -> bound sql
+            pending = b""              # extended-flow replies batch to Sync
+            skip = False               # error → ignore until Sync (v3 rule)
+
+            def step(reply: bytes) -> bytes:
+                nonlocal skip
+                if reply[:1] == b"E":
+                    skip = True
+                return reply
+
             while True:
                 tag = f.read(1)
                 if not tag or tag == b"X":
@@ -121,15 +208,127 @@ class _Handler(socketserver.BaseRequestHandler):
                 if tag == b"Q":
                     sql = payload.rstrip(b"\0").decode()
                     sock.sendall(self._run(srv, session, sql))
+                elif tag == b"S":                       # Sync
+                    if session.tx is None:
+                        # portals survive Sync inside a tx block (spec)
+                        self._portals.clear()
+                    skip = False
+                    sock.sendall(pending
+                                 + _ready(self._status(session)))
+                    pending = b""
+                elif skip and tag in (b"P", b"B", b"D", b"E", b"C",
+                                      b"H"):
+                    continue    # discard until Sync after an error
+                elif tag == b"P":
+                    pending += step(self._parse_msg(payload))
+                elif tag == b"B":
+                    pending += step(self._bind_msg(payload))
+                elif tag == b"D":
+                    pending += step(self._describe_msg(payload))
+                elif tag == b"E":
+                    pending += step(self._execute_msg(srv, session,
+                                                      payload))
+                elif tag == b"C":
+                    kind, rest = payload[:1], payload[1:].rstrip(b"\0")
+                    store = self._stmts if kind == b"S" else self._portals
+                    store.pop(rest.decode(), None)
+                    pending += _msg(b"3", b"")          # CloseComplete
+                elif tag == b"H":                       # Flush
+                    sock.sendall(pending)
+                    pending = b""
                 else:
                     sock.sendall(_error(
                         f"message {tag.decode(errors='replace')!r} not "
-                        "supported (simple query protocol only)")
-                        + _ready(self._status(session)))
+                        "supported") + _ready(self._status(session)))
         except (ConnectionError, BrokenPipeError, struct.error):
             pass
         finally:
             sock.close()
+
+    def _parse_msg(self, payload: bytes) -> bytes:
+        try:
+            z1 = payload.index(b"\0")
+            name = payload[:z1].decode()
+            z2 = payload.index(b"\0", z1 + 1)
+            sql = payload[z1 + 1:z2].decode()
+            off = z2 + 1
+            (noids,) = struct.unpack_from("!H", payload, off)
+            off += 2
+            oids = list(struct.unpack_from(f"!{noids}I", payload, off)) \
+                if noids else []
+            self._stmts[name] = (sql, oids)
+            return _msg(b"1", b"")                      # ParseComplete
+        except (ValueError, struct.error) as e:
+            return _error(f"malformed Parse: {e}", code="08P01")
+
+    def _bind_msg(self, payload: bytes) -> bytes:
+        try:
+            z1 = payload.index(b"\0")
+            portal = payload[:z1].decode()
+            z2 = payload.index(b"\0", z1 + 1)
+            stmt_name = payload[z1 + 1:z2].decode()
+            off = z2 + 1
+            (nfmt,) = struct.unpack_from("!H", payload, off)
+            off += 2
+            fmts = list(struct.unpack_from(f"!{nfmt}H", payload, off))
+            off += 2 * nfmt
+            (nparams,) = struct.unpack_from("!H", payload, off)
+            off += 2
+            params = []
+            for i in range(nparams):
+                (plen,) = struct.unpack_from("!i", payload, off)
+                off += 4
+                if plen < 0:
+                    params.append(None)
+                else:
+                    fmt = fmts[i] if i < len(fmts) \
+                        else (fmts[0] if len(fmts) == 1 else 0)
+                    if fmt != 0:
+                        return _error("binary-format parameters are not "
+                                      "supported (send text format)")
+                    params.append(payload[off:off + plen].decode())
+                    off += plen
+            if stmt_name not in self._stmts:
+                return _error(f"unknown prepared statement "
+                              f"{stmt_name!r}", code="26000")
+            sql, oids = self._stmts[stmt_name]
+            self._portals[portal] = _substitute_params(sql, params, oids)
+            return _msg(b"2", b"")                      # BindComplete
+        except (ValueError, struct.error) as e:
+            return _error(f"malformed Bind: {e}", code="08P01")
+
+    def _describe_msg(self, payload: bytes) -> bytes:
+        """Describe: statement variant answers ParameterDescription +
+        NoData (row descriptions ride the Execute response — we cannot
+        derive an output schema without executing); portal variant
+        answers NoData."""
+        kind, rest = payload[:1], payload[1:].rstrip(b"\0")
+        if kind == b"S":
+            ent = self._stmts.get(rest.decode())
+            if ent is None:
+                return _error(f"unknown prepared statement "
+                              f"{rest.decode()!r}", code="26000")
+            _sql, oids = ent
+            body = struct.pack("!H", len(oids))
+            for o in oids:
+                body += struct.pack("!I", o)
+            return _msg(b"t", body) + _msg(b"n", b"")
+        return _msg(b"n", b"")
+
+    def _execute_msg(self, srv, session, payload: bytes) -> bytes:
+        try:
+            z1 = payload.index(b"\0")
+            portal = payload[:z1].decode()
+        except ValueError:
+            return _error("malformed Execute", code="08P01")
+        sql = self._portals.get(portal)
+        if sql is None:
+            return _error(f"unknown portal {portal!r}", code="34000")
+        # reuse the simple-query runner minus its trailing ReadyForQuery
+        # (extended flow defers that to Sync)
+        out = self._run(srv, session, sql)
+        z = _ready(self._status(session))
+        return out[:-len(z)] if out.endswith(z) else out
 
     def _status(self, session) -> bytes:
         if session.tx is None:

@@ -72,7 +72,9 @@ _STARTED = _time.monotonic()
 
 
 class QueryServicer:
-    def __init__(self, engine, max_sessions: int = MAX_SESSIONS):
+    def __init__(self, engine, max_sessions: int = MAX_SESSIONS,
+                 token: str = ""):
+        import os
         import threading
         from collections import OrderedDict
         self.engine = engine
@@ -82,6 +84,14 @@ class QueryServicer:
         self._lock = threading.Lock()
         self._sessions: "OrderedDict" = OrderedDict()
         self._max_sessions = max_sessions
+        # minimal bearer auth (ydb/core/security token check, radically
+        # simplified): empty = open access; Ping/Health stay open (probes)
+        self._token = token or os.environ.get("YDB_TPU_AUTH_TOKEN", "")
+
+    def _authed(self, request) -> bool:
+        import hmac
+        return not self._token or hmac.compare_digest(
+            str(request.get("token", "")), self._token)
 
     def _session(self, session_id):
         if not session_id:
@@ -110,6 +120,8 @@ class QueryServicer:
         return {"ok": True}
 
     def execute_query(self, request, context):
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
         sql = request.get("sql", "")
         try:
             with self._lock:
@@ -121,6 +133,8 @@ class QueryServicer:
             return {"error": f"{type(e).__name__}: {e}"}
 
     def counters(self, request, context):
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
         return {"counters": self.engine.counters()}
 
     def ping(self, request, context):
@@ -156,11 +170,13 @@ class QueryServicer:
         }
 
 
-def serve(engine, port: int = 2136, max_workers: int = 8):
-    """Start the gRPC server; returns (server, bound_port)."""
+def serve(engine, port: int = 2136, max_workers: int = 8,
+          token: str = ""):
+    """Start the gRPC server; returns (server, bound_port). `token`
+    (or $YDB_TPU_AUTH_TOKEN): require it on query/counters calls."""
     import grpc
 
-    servicer = QueryServicer(engine)
+    servicer = QueryServicer(engine, token=token)
     handlers = {
         "ExecuteQuery": grpc.unary_unary_rpc_method_handler(
             servicer.execute_query, request_deserializer=_deser,
@@ -189,9 +205,11 @@ def serve(engine, port: int = 2136, max_workers: int = 8):
 class Client:
     """Minimal SDK client (the ydb-sdk QueryClient analog)."""
 
-    def __init__(self, endpoint: str, session_id: str = ""):
+    def __init__(self, endpoint: str, session_id: str = "",
+                 token: str = ""):
         import grpc
 
+        self.token = token
         self._channel = grpc.insecure_channel(endpoint)
         self._exec = self._channel.unary_unary(
             f"/{SERVICE}/ExecuteQuery", request_serializer=_ser,
@@ -208,7 +226,8 @@ class Client:
         self.session_id = session_id
 
     def execute(self, sql: str) -> dict:
-        resp = self._exec({"sql": sql, "session_id": self.session_id})
+        resp = self._exec({"sql": sql, "session_id": self.session_id,
+                           "token": self.token})
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp
@@ -221,7 +240,10 @@ class Client:
         return pd.DataFrame(resp["rows"], columns=resp["columns"])
 
     def counters(self) -> dict:
-        return self._counters({})["counters"]
+        resp = self._counters({"token": self.token})
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["counters"]
 
     def ping(self) -> bool:
         return bool(self._ping({}).get("ok"))

@@ -1248,7 +1248,19 @@ class QueryEngine:
         self.last_rows_affected = block.length
         table.indexate(self._maintenance_watermark(),
                        compact=self.config.flag("enable_auto_compaction"))
+        self._maybe_split(table)
         return _unit_block()
+
+    def _maybe_split(self, table) -> None:
+        """Auto-split trigger at commit points (the table-stats split of
+        `schemeshard__table_stats.cpp`, collapsed to a row threshold)."""
+        if not getattr(table, "maybe_split", None):
+            return
+        if table.maybe_split(self.config.shard_split_rows):
+            from ydb_tpu.utils.metrics import GLOBAL
+            GLOBAL.inc("engine/shard_splits")
+            if self.catalog.store is not None:
+                self.catalog.store.save_catalog(self.catalog)
 
     def _apply_row_ops(self, table, ops, tx) -> None:
         """Row-table mutation: immediate at a fresh version (autocommit)
@@ -1269,11 +1281,10 @@ class QueryEngine:
     # query path, then apply point mutations on the version chains — MVCC
     # snapshots keep seeing the old rows.
     #
-    # Column tables: evaluated the same way, then applied by rewriting the
-    # affected portions (copy-on-write minus deleted rows). This matches
-    # the reference's bulk semantics in spirit but, unlike the row path,
-    # does NOT preserve time travel — historical snapshots see the
-    # post-delete state (the distributed-tx layer can tighten this later).
+    # Column tables: evaluated the same way, then applied as MVCC delete
+    # marks on immutable portions (storage/portion.py DeleteMark) — time
+    # travel preserved, transactional staging supported; UPDATE commits
+    # its marks and re-inserts through one intent-journal record.
 
     def _update(self, stmt: ast.Update, snap=None, tx=None) -> HostBlock:
         table = self._table(stmt.table)

@@ -41,6 +41,7 @@ class Catalog:
             t = ColumnTable(name, schema, key_columns, shards, portion_rows,
                             partition_by)
         t.transient = transient
+        t.catalog = self            # back-ref: split/merge re-save metadata
         self.tables[name] = t
         if self.store is not None and not transient:
             t.store = self.store

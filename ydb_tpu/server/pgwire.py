@@ -86,19 +86,25 @@ def _substitute_params(sql: str, params: list, oids: list) -> str:
         if oid in _FLOAT_OIDS:
             return repr(float(v))
         if oid == 16:
-            return "TRUE" if v.lower() in ("t", "true", "1", "on") \
-                else "FALSE"
+            lv = v.lower()
+            if lv in ("t", "true", "1", "on", "y", "yes"):
+                return "TRUE"
+            if lv in ("f", "false", "0", "off", "n", "no"):
+                return "FALSE"
+            raise ValueError(f"bad boolean parameter {v!r}")
         if oid == 1082:
             if not re.fullmatch(r"\d{4}-\d{2}-\d{2}", v):
                 raise ValueError(f"bad date parameter {v!r}")
             return f"date '{v}'"
-        if oid in (0, 705):              # unspecified: sniff the text
+        if oid in (0, 705):
+            # unspecified type: numeric-looking text inlines as a number
+            # (drivers comparing int columns need this); clients that
+            # mean the STRING '123' must send oid 25 — date-shaped text
+            # stays a string (no sniffing into date literals)
             if re.fullmatch(r"[+-]?\d+", v):
                 return v
             if re.fullmatch(r"[+-]?\d*\.\d+([eE][+-]?\d+)?", v):
                 return v
-            if re.fullmatch(r"\d{4}-\d{2}-\d{2}", v):
-                return f"date '{v}'"
         s = v.replace("'", "''")
         return f"'{s}'"
 

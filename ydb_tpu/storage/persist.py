@@ -96,6 +96,7 @@ class Store:
                 "key_columns": t.key_columns,
                 "partition_by": t.partition_by,
                 "shards": len(t.shards),
+                "buckets": list(getattr(t, "buckets", [])),
                 "portion_rows": t.shards[0].portion_rows,
                 "store_kind": getattr(t, "store_kind", "column"),
                 "indexes": dict(getattr(t, "indexes", {})),
@@ -257,6 +258,7 @@ class Store:
         """Persist a shard's portion set after indexate()/compact() and
         truncate the consumed WAL prefix."""
         sdir = self._sdir(table.name, shard.shard_id)
+        os.makedirs(sdir, exist_ok=True)   # split-born shards are new dirs
         live = []
         for p in shard.portions:
             path = os.path.join(sdir, f"portion_{p.id}.ydbp")
@@ -265,6 +267,10 @@ class Store:
             entry = {"id": p.id, "rows": p.num_rows,
                      "plan_step": p.version.plan_step,
                      "tx_id": p.version.tx_id}
+            if getattr(p, "split_src", None) is not None:
+                # split child: authoritative only once the parent portion
+                # is gone from its shard's manifest (crash-window marker)
+                entry["split_src"] = p.split_src
             committed_marks = [m for m in p.deletes
                                if m.version is not None]
             if committed_marks:
@@ -301,6 +307,14 @@ class Store:
                              "plan_step": e.committed_version.plan_step,
                              "tx_id": e.committed_version.tx_id})
         B.wal_rewrite(os.path.join(sdir, "wal.bin"), recs)
+
+    def drop_shard_dir(self, table: str, shard_id: int) -> None:
+        """Remove a merged-away shard's directory (portions already
+        persisted under the target shard)."""
+        import shutil
+        sdir = os.path.join(self._tdir(table), f"shard_{shard_id}")
+        if os.path.isdir(sdir):
+            shutil.rmtree(sdir)
 
     def rewrite_row_wal(self, table) -> None:
         """Compact a row table's mutation log to its current committed
@@ -382,6 +396,8 @@ class Store:
             for c in schema:
                 if c.dtype.is_string and c.name not in t.dictionaries:
                     t.dictionaries[c.name] = Dictionary()
+            if tm.get("buckets"):
+                t.buckets = [int(b) for b in tm["buckets"]]
             if tm.get("ttl"):
                 t.ttl = (tm["ttl"][0], int(tm["ttl"][1]))
             if tm.get("serial_next"):
@@ -409,12 +425,17 @@ class Store:
             for rec in open_intents.values():
                 for sid, wids in rec["shards"].items():
                     intent_wids.setdefault(int(sid), set()).update(wids)
+            loaded_pids: set = set()     # merge crash window: a moved
+            split_children: list = []    # portion can be in two manifests
             for shard in t.shards:
                 sdir = self._sdir(name, shard.shard_id)
                 man = _read_json(os.path.join(sdir, "manifest.json"),
                                  {"portions": [], "pending_wids": None,
                                   "max_wid": 0})
                 for e in man["portions"]:
+                    if e["id"] in loaded_pids:
+                        continue         # duplicate from a torn merge
+                    loaded_pids.add(e["id"])
                     block = B.read_portion(
                         os.path.join(sdir, f"portion_{e['id']}.ydbp"),
                         schema, t.dictionaries)
@@ -428,6 +449,9 @@ class Store:
                                      version=WriteVersion(dm["plan_step"],
                                                           dm["tx_id"]))
                         seen_step = max(seen_step, dm["plan_step"])
+                    if e.get("split_src") is not None:
+                        p.split_src = e["split_src"]
+                        split_children.append((shard, p))
                     shard.portions.append(p)
                     _portion_ids.ensure_above(e["id"])
                     seen_step = max(seen_step, e["plan_step"])
@@ -491,6 +515,28 @@ class Store:
                     if staged[wid].committed_version:
                         shard.rows_written += staged[wid].block.length
                 shard._next_write_id = max([max_wid] + list(staged)) + 1
+            # split crash healing: a child portion whose PARENT still
+            # exists (the keep-shard purge never landed) is not
+            # authoritative — drop it; the split rolls back whole
+            for (shard, child) in split_children:
+                if child.split_src in loaded_pids:
+                    shard.portions = [p for p in shard.portions
+                                      if p is not child]
+            # shard dirs beyond the catalog's count are crash leftovers of
+            # a split that never reached its catalog save: children there
+            # were just dropped (parents present); remove residue
+            tdir = self._tdir(name)
+            if os.path.isdir(tdir):
+                for fn in os.listdir(tdir):
+                    if fn.startswith("shard_"):
+                        try:
+                            k = int(fn[len("shard_"):])
+                        except ValueError:
+                            continue
+                        if k >= len(t.shards):
+                            import shutil
+                            shutil.rmtree(os.path.join(tdir, fn),
+                                          ignore_errors=True)
             # heal torn multi-shard commits: an INTENT without its DONE
             # means the crash hit between shard commit records — re-apply
             # the commit to every shard it covers (idempotent)

@@ -23,6 +23,12 @@ from ydb_tpu.utils.hashing import splitmix64
 
 _table_uids = iter(range(1, 2 ** 62))
 
+# virtual routing buckets per table: rows hash into a fixed bucket space
+# and a bucket->shard map places them — splits reassign buckets instead
+# of re-hashing the world (consistent-hashing-style, the splittable
+# analog of the reference's key-range partitions)
+VBUCKETS = 64
+
 
 class ColumnTable:
     def __init__(self, name: str, schema: Schema, key_columns: list[str],
@@ -38,6 +44,7 @@ class ColumnTable:
         self.key_columns = key_columns
         self.partition_by = partition_by or [key_columns[0]]
         self.shards = [ColumnShard(schema, i, portion_rows) for i in range(shards)]
+        self.buckets = [i % shards for i in range(VBUCKETS)]
         self.dictionaries: dict[str, Dictionary] = {
             c.name: Dictionary() for c in schema if c.dtype.is_string}
         # data_version: bumped on every commit — cached plans snapshot
@@ -65,8 +72,13 @@ class ColumnTable:
     def _route(self, block: HostBlock) -> np.ndarray:
         col = self.partition_by[0]
         cd = block.columns[col]
-        h = splitmix64(np, cd.data)
-        return (h % np.uint64(len(self.shards))).astype(np.int64)
+        b = self._bucket_of(cd.data)
+        return np.asarray(self.buckets, np.int64)[b]
+
+    @staticmethod
+    def _bucket_of(data: np.ndarray) -> np.ndarray:
+        h = splitmix64(np, data)
+        return (h % np.uint64(VBUCKETS)).astype(np.int64)
 
     def write(self, block: HostBlock,
               tx: Optional[int] = None) -> list[tuple[int, int]]:
@@ -192,6 +204,114 @@ class ColumnTable:
             if self.store is not None and n:
                 self.store.save_indexation(self, s)
         return merged
+
+    # -- shard split / merge -----------------------------------------------
+
+    def split_shard(self, sid: int) -> bool:
+        """Split a hot/large shard: half its routing buckets move to a new
+        shard and every portion's rows redistribute by bucket — the
+        SchemeShard split trigger (`schemeshard__table_stats.cpp`)
+        collapsed onto hash-bucket routing. Readers see the swap
+        atomically (one shards-list rebind of copy-on-write shard
+        objects); versions are preserved, so MVCC snapshots are unmoved.
+
+        Returns False when the shard cannot split yet (single bucket,
+        pending uncommitted inserts, or live delete marks — fold first)."""
+        from ydb_tpu.storage.portion import Portion
+        shard = self.shards[sid]
+        mine = [b for b, s in enumerate(self.buckets) if s == sid]
+        if len(mine) < 2 or any(e.committed_version is None
+                                for e in shard.inserts):
+            return False
+        if any(p.deletes for p in shard.portions):
+            return False               # marks hold row indices; fold first
+        shard.indexate()               # committed inserts -> portions
+        moving = set(mine[len(mine) // 2:])
+        new_sid = len(self.shards)
+        keep_shard = ColumnShard(self.schema, sid, shard.portion_rows)
+        keep_shard._next_write_id = shard._next_write_id
+        new_shard = ColumnShard(self.schema, new_sid, shard.portion_rows)
+        col = self.partition_by[0]
+        for p in shard.portions:
+            b = self._bucket_of(p.block.columns[col].data)
+            mv = np.isin(b, list(moving))
+            if not mv.any():
+                keep_shard.portions.append(p)      # untouched: same object
+                continue
+            stay = np.nonzero(~mv)[0]
+            go = np.nonzero(mv)[0]
+            if len(stay):
+                keep_shard.portions.append(
+                    Portion.from_block(p.block.take(stay), p.version))
+            child = Portion.from_block(p.block.take(go), p.version)
+            # crash-recovery marker: while the parent portion still exists
+            # in the keep shard's manifest, these children are NOT yet
+            # authoritative — load() drops them (split is all-or-nothing)
+            child.split_src = p.id
+            new_shard.portions.append(child)
+        new_buckets = [new_sid if b in moving else s
+                       for b, s in enumerate(self.buckets)]
+        # ONE rebind each: lock-free readers see old or new state whole
+        self.buckets = new_buckets
+        self.shards = self.shards[:sid] + [keep_shard] \
+            + self.shards[sid + 1:] + [new_shard]
+        self.data_version += 1
+        if self.store is not None:
+            # durable ORDER is the crash-safety argument:
+            # 1. the new shard's children land (additive; parents still
+            #    authoritative → a crash here rolls the split back),
+            # 2. the catalog learns the new shard count + bucket map,
+            # 3. the keep shard's purge removes the parents — from here
+            #    the children are authoritative.
+            self.store.save_indexation(self, new_shard)
+            if getattr(self, "catalog", None) is not None:
+                self.store.save_catalog(self.catalog)
+            self.store.save_indexation(self, keep_shard)
+        return True
+
+    def merge_last_shard(self) -> bool:
+        """Merge the last shard into the one owning the fewest rows:
+        whole portions move (reads scan every shard; routing only places
+        new writes), its buckets reassign, and the shard list shrinks."""
+        if len(self.shards) < 2:
+            return False
+        src = self.shards[-1]
+        if any(e.committed_version is None for e in src.inserts):
+            return False
+        src.indexate()
+        sid = src.shard_id
+        target = min(range(len(self.shards) - 1),
+                     key=lambda i: self.shards[i].num_rows)
+        tgt = self.shards[target]
+        merged = ColumnShard(self.schema, target, tgt.portion_rows)
+        merged._next_write_id = max(tgt._next_write_id,
+                                    src._next_write_id)
+        merged.portions = tgt.portions + src.portions
+        merged.inserts = list(tgt.inserts)
+        self.buckets = [target if s == sid else s for s in self.buckets]
+        self.shards = self.shards[:target] + [merged] \
+            + self.shards[target + 1:-1]
+        self.data_version += 1
+        if self.store is not None:
+            # moved portions keep their ids, so until the source dir is
+            # dropped they exist in BOTH manifests — load() dedups by
+            # portion id, making every crash window read-consistent
+            self.store.save_indexation(self, merged)
+            if getattr(self, "catalog", None) is not None:
+                self.store.save_catalog(self.catalog)
+            self.store.drop_shard_dir(self.name, sid)
+        return True
+
+    def maybe_split(self, threshold_rows: int) -> bool:
+        """Auto-split check (called at commit points): split the biggest
+        shard once it crosses the threshold."""
+        if not threshold_rows:
+            return False
+        sid = max(range(len(self.shards)),
+                  key=lambda i: self.shards[i].num_rows)
+        if self.shards[sid].num_rows <= threshold_rows:
+            return False
+        return self.split_shard(sid)
 
     # -- schema evolution (ALTER TABLE) ------------------------------------
 

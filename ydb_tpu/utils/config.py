@@ -46,6 +46,8 @@ class Config:
     # above this many rows — a silent single-core pandas job over a huge
     # frame is a perf trap; raise the limit explicitly to accept it
     host_lane_max_rows: int = 8 << 20
+    # auto-split threshold for column shards (rows); 0 = disabled
+    shard_split_rows: int = 0
     feature_flags: dict = field(default_factory=lambda: dict(DEFAULT_FLAGS))
 
     def flag(self, name: str) -> bool:
@@ -75,7 +77,7 @@ class Config:
         if unknown:
             raise ValueError(f"unknown feature flags: {sorted(unknown)}")
         known = {"block_rows", "grace_budget_bytes", "data_dir",
-                 "server_port", "host_lane_max_rows"}
+                 "server_port", "host_lane_max_rows", "shard_split_rows"}
         bad = set(merged) - known
         if bad:
             raise ValueError(f"unknown config keys: {sorted(bad)}")

@@ -65,7 +65,13 @@ def content_hash(s: str) -> int:
                           "little") >> 1
 
 
-def gen_hits(n_rows: int, seed: int = 20260729) -> dict:
+def gen_hits(n_rows: int, seed: int = 20260729,
+             url_cardinality: int = 0) -> dict:
+    """`url_cardinality` > 0: URL and Referer gain random path suffixes
+    drawn from that many values (distinct combinations multiply with the
+    word pools) — the real dataset's URL column is near-unique, the
+    dictionary-degeneracy case the string lane must survive (VERDICT r3
+    item 6)."""
     rng = np.random.default_rng(seed)
     n = n_rows
     zipf = lambda k, size: np.minimum(  # noqa: E731
@@ -83,12 +89,18 @@ def gen_hits(n_rows: int, seed: int = 20260729) -> dict:
         phrases)
     urls = np.char.add("http://example.com/",
                        _WORDS[zipf(len(_WORDS) - 1, n)].astype(str))
+    if url_cardinality:
+        suffix = (rng.integers(0, url_cardinality, n)).astype("U10")
+        urls = np.char.add(np.char.add(urls, "/p"), suffix)
     titles = np.char.add(np.char.capitalize(
         _WORDS[zipf(len(_WORDS) - 1, n)].astype(str)), " page")
     ref_host = _REF_HOSTS[zipf(len(_REF_HOSTS), n)]
     ref_path = _WORDS[zipf(len(_WORDS) - 1, n)]
     referers = np.char.add(np.char.add(np.char.add(
         "https://", ref_host.astype(str)), "/"), ref_path.astype(str))
+    if url_cardinality:
+        rsuf = (rng.integers(0, url_cardinality, n)).astype("U10")
+        referers = np.char.add(np.char.add(referers, "/r"), rsuf)
     referers = np.where(rng.random(n) < 0.4, "", referers)
     def _hashes(arr):
         uniq, inv = np.unique(arr, return_inverse=True)
@@ -132,12 +144,13 @@ def gen_hits(n_rows: int, seed: int = 20260729) -> dict:
 
 
 def load_hits(catalog, n_rows: int = 100_000, shards: int = 1,
-              portion_rows: int = 1 << 20, seed: int = 20260729) -> dict:
+              portion_rows: int = 1 << 20, seed: int = 20260729,
+              url_cardinality: int = 0) -> dict:
     """Create and fill the `hits` table; returns the raw numpy arrays."""
     import pandas as pd
 
     from ydb_tpu.storage.mvcc import WriteVersion
-    raw = gen_hits(n_rows, seed)
+    raw = gen_hits(n_rows, seed, url_cardinality=url_cardinality)
     table = catalog.create_table("hits", HITS_SCHEMA, ["WatchID"],
                                  shards=shards, portion_rows=portion_rows)
     table.bulk_upsert(pd.DataFrame(raw), WriteVersion(1, 1))

@@ -93,8 +93,10 @@ class HostBlock:
                 dtype = dt.from_numpy(s.dtype)
             if dtype.is_string:
                 d = dictionaries.setdefault(name, Dictionary())
-                vals = [None if pd.isna(v) else str(v) for v in s.tolist()]
-                data = d.encode(vals)
+                arr = s.to_numpy(dtype=object, copy=True)
+                if valid is not None:
+                    arr[~valid] = None
+                data = d.encode_bulk(arr)   # factorize, not 1 lookup/row
                 cols[name] = ColumnData(data, valid, d)
             else:
                 data = s.to_numpy(dtype=dtype.np, na_value=0) if valid is not None \

@@ -17,11 +17,12 @@ import numpy as np
 class Dictionary:
     """Append-only value dictionary: value <-> int32 code."""
 
-    __slots__ = ("_map", "_values")
+    __slots__ = ("_map", "_values", "_ranks")
 
     def __init__(self):
         self._map: dict[str, int] = {}
         self._values: list[str] = []
+        self._ranks = None          # (len, ranks) memo — see sort_ranks
 
     def __len__(self) -> int:
         return len(self._values)
@@ -52,7 +53,12 @@ class Dictionary:
         codes, uniques = pd.factorize(values, use_na_sentinel=True)
         if hasattr(uniques, "to_numpy"):
             uniques = uniques.to_numpy(dtype=object)
-        lut = self.encode(list(uniques))
+        # str-coerce at the UNIQUES level (small): non-str objects (a
+        # numeric-looking column pandas inferred as int) must enter the
+        # dictionary as strings, or lookups/sorts break; equal-after-str
+        # values collapse to one code via the encode map
+        lut = self.encode([u if isinstance(u, str) else str(u)
+                           for u in uniques])
         lut = np.concatenate([lut, np.array([-1], np.int32)])  # -1 slot
         return lut[codes].astype(np.int32)
 
@@ -73,6 +79,26 @@ class Dictionary:
         # codes are stable) must not grow the list mid-conversion
         vals = self._values
         return np.asarray(vals[:len(vals)], dtype=object)
+
+    def sort_ranks(self) -> np.ndarray:
+        """code → lexicographic rank (int32), memoized per dictionary
+        length: sort keys recompute this per query, and at URL-scale
+        cardinality a fresh double-argsort over millions of strings costs
+        seconds. Append-only dictionaries make the (len, ranks) memo
+        exact."""
+        vals = self._values
+        n = len(vals)
+        cached = self._ranks
+        if cached is not None and cached[0] == n:
+            return cached[1]
+        if not n:
+            ranks = np.zeros(1, np.int32)
+        else:
+            arr = np.asarray(vals[:n], dtype=object)
+            ranks = np.argsort(np.argsort(arr, kind="stable")) \
+                .astype(np.int32)
+        self._ranks = (n, ranks)
+        return ranks
 
     def lut(self, predicate) -> np.ndarray:
         """Evaluate `predicate(value) -> bool` over all dictionary entries.

@@ -138,9 +138,7 @@ def host_sort_limit(block: HostBlock, sort: list, limit, offset,
             data = cd.data
             dic = dicts.get(sk.name) or cd.dictionary
             if dic is not None and block.schema.dtype(sk.name).is_string:
-                vals = dic.values_array()
-                ranks = (np.argsort(np.argsort(vals)).astype(np.int64)
-                         if len(vals) else np.zeros(1, np.int64))
+                ranks = dic.sort_ranks().astype(np.int64)
                 safe = np.clip(data.astype(np.int64), 0, len(ranks) - 1)
                 data = ranks[safe]
             k = data.astype(np.float64) \

@@ -333,6 +333,24 @@ _STR_UNARY: dict[str, Callable] = {
 }
 
 
+def _lut_pred_vec(binding: ColumnBinding, series_pred: Callable,
+                  pool: ParamPool) -> ir.Expr:
+    """Vectorized bool-LUT over a RAW dictionary column: `series_pred`
+    maps a pandas Series of the value set to a bool mask in one C-engine
+    pass — the dictionary-degeneracy answer for URL-cardinality columns
+    (reference: hyperscan/re2 UDFs, `ydb/library/yql/udfs/common/`)."""
+    import pandas as pd
+    d = binding.dictionary
+    vals = d.values_array()
+    if len(vals):
+        m = series_pred(pd.Series(vals, dtype=object))
+        lut = m.fillna(False).to_numpy(dtype=np.bool_)
+    else:
+        lut = np.zeros(1, dtype=np.bool_)
+    p = pool.add(lut, dt.DType(dt.Kind.BOOL, False), is_array=True)
+    return ir.call("take_lut", ir.Col(binding.internal), p)
+
+
 def _lut_pred(binding: ColumnBinding, fn: Callable, pool: ParamPool) -> ir.Expr:
     """bool-LUT gather over a dictionary column."""
     d = binding.dictionary
@@ -408,10 +426,19 @@ class ExprBinder:
             if sf is None:
                 raise BindError("LIKE on a non-string expression")
             b, fn = sf
-            rx = re.compile(like_to_regex(e.pattern), re.DOTALL)
-            pred = _lut_pred(
-                b, lambda s: s is not None and fn(s) is not None
-                and rx.fullmatch(fn(s)) is not None, self.pool)
+            if isinstance(e.arg, ast.Name) and b.dictionary is not None:
+                # identity transform: evaluate the pattern over the whole
+                # dictionary VECTORIZED (pandas str engine) — the Python
+                # per-value loop is minutes at URL-scale cardinality
+                rx_s = like_to_regex(e.pattern)
+                pred = _lut_pred_vec(
+                    b, lambda s: s.str.fullmatch(rx_s, flags=re.DOTALL),
+                    self.pool)
+            else:
+                rx = re.compile(like_to_regex(e.pattern), re.DOTALL)
+                pred = _lut_pred(
+                    b, lambda s: s is not None and fn(s) is not None
+                    and rx.fullmatch(fn(s)) is not None, self.pool)
             return ir.call("not", pred) if e.negated else pred
 
         if isinstance(e, ast.Between):
@@ -692,6 +719,14 @@ class ExprBinder:
                 raise BindError(f"{name} needs a string column and literal")
             b, fn = sf
             tgt = lit.value
+            if isinstance(e.args[0], ast.Name) and b.dictionary is not None:
+                # raw column: vectorized over the whole dictionary
+                vec = {"startswith":
+                       lambda s: s.str.startswith(tgt),
+                       "endswith": lambda s: s.str.endswith(tgt),
+                       "contains_string":
+                       lambda s: s.str.contains(tgt, regex=False)}[name]
+                return _lut_pred_vec(b, vec, self.pool)
             test = {"startswith": lambda s: s.startswith(tgt),
                     "endswith": lambda s: s.endswith(tgt),
                     "contains_string": lambda s: tgt in s}[name]

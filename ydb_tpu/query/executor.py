@@ -370,9 +370,7 @@ class Executor:
             dtype = schema.dtype(sk.name)
             dic = dicts.get(sk.name)
             if dtype.is_string and dic is not None:
-                vals = dic.values_array()
-                ranks = np.argsort(np.argsort(vals)).astype(np.int32) \
-                    if len(vals) else np.zeros(1, np.int32)
+                ranks = dic.sort_ranks()
                 pname = f"__rank{j}"
                 sort_params[pname] = ranks
                 rank_col = f"__sortrank{j}"
@@ -1202,9 +1200,7 @@ class Executor:
             dtype = schema.dtype(sk.name)
             dic = dicts.get(sk.name)
             if dtype.is_string and dic is not None:
-                vals = dic.values_array()
-                ranks = np.argsort(np.argsort(vals)).astype(np.int32) \
-                    if len(vals) else np.zeros(1, np.int32)
+                ranks = dic.sort_ranks()
                 pname = f"__rank{j}"
                 sort_params[pname] = ranks
                 rank_col = f"__sortrank{j}"

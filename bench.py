@@ -3,39 +3,38 @@
 Runs the full SQL path (parse → plan → pushdown → fused/tiled device
 programs) over generated TPC-H data — the measured analog of the
 reference's `ydb workload tpch run` (no published numbers exist in-repo;
-see BASELINE.md):
+see BASELINE.md). Suites at each scale factor in BENCH_SUITE_SFS
+(default "1,10"): best-of-N per query, geomean reported; at SF ≤ 1 every
+query is oracle-gated, above that a fast subset gates.
 
-  * headline: Q1 at BENCH_SF (default 1) — scan+agg rows/s vs a pandas
-    CPU baseline over the same data (continuity with earlier rounds);
-  * suites: all 22 queries at each scale factor in BENCH_SUITE_SFS
-    (default "1,10"), best-of-2 per query, geomean reported. At SF ≤ 1
-    every query is correctness-gated against the pandas oracle; above
-    that a fast subset gates (full-oracle joins at SF10 cost minutes of
-    single-core pandas each — the suite stays within BENCH_BUDGET_S).
+HANG-PROOF ORCHESTRATION: this platform's remote compile service can
+wedge indefinitely on a cold shape. The parent process NEVER touches the
+device; each suite runs in a child process that appends one JSON line
+per finished query to a progress file. If the child makes no progress
+for BENCH_QUERY_TIMEOUT seconds it is killed, the query it was stuck on
+is blacklisted, and the child respawns to continue with the remaining
+queries (completed results are kept). The persistent XLA compile cache
+(`.jax_cache`) makes respawns cheap for everything already compiled.
 
-Prints a per-phase breakdown to stderr and ONE JSON line to stdout:
+Prints ONE JSON line to stdout:
   {"metric": "tpch_q1_rows_per_sec", "value": N, "unit": "rows/s",
    "vs_baseline": ratio, "suites": {"sf1": {...}, "sf10": {...}}}
 """
 
 from __future__ import annotations
 
-import gc
 import json
 import math
 import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
-SF = float(os.environ.get("BENCH_SF", "1"))
-REPEATS = int(os.environ.get("BENCH_REPEATS", "5"))
 SUITE_SFS = [float(s) for s in
              os.environ.get("BENCH_SUITE_SFS", "1,10").split(",") if s]
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "2400"))
+QUERY_TIMEOUT = float(os.environ.get("BENCH_QUERY_TIMEOUT", "600"))
 SUITE_REPEATS = int(os.environ.get("BENCH_SUITE_REPEATS", "2"))
-# oracle-gated queries at SF > 1 (fast single-table oracles)
 GATE_BIG = ("q1", "q6", "q12", "q14")
 
 _T0 = time.perf_counter()
@@ -47,162 +46,228 @@ def log(msg: str) -> None:
 
 
 def geomean(xs):
+    xs = [x for x in xs if x and x > 0]
     return math.exp(sum(math.log(x) for x in xs) / len(xs)) if xs else 0.0
 
 
-def run_headline():
+# ---------------------------------------------------------------------------
+# child: runs ONE suite, appending a JSON line per query to the progress
+# file; the parent watches mtime and kills on stall
+# ---------------------------------------------------------------------------
+
+
+def child_main(sf: float, progress_path: str, skip: list) -> None:
+    import numpy as np
+
     from ydb_tpu.bench.tpch_gen import load_tpch
     from ydb_tpu.query import QueryEngine
-    from tests.tpch_util import QUERIES, oracle
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.tpch_util import QUERIES, assert_frames_match, oracle
+
+    def emit(rec: dict) -> None:
+        with open(progress_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
 
     t0 = time.perf_counter()
     eng = QueryEngine(block_rows=1 << 20)
-    data = load_tpch(eng.catalog, sf=SF)
+    data = load_tpch(eng.catalog, sf=sf)
     n_rows = eng.catalog.table("lineitem").num_rows
-    log(f"generate+load sf={SF} ({n_rows} lineitem rows): "
-        f"{time.perf_counter() - t0:.1f}s")
+    load_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    warm = eng.prewarm()
-    log(f"prewarm: {warm / 1e9:.2f}GB in HBM, "
-        f"{time.perf_counter() - t0:.1f}s")
+    eng.prewarm()
+    emit({"kind": "meta", "lineitem_rows": int(n_rows),
+          "load_s": round(load_s, 1),
+          "prewarm_s": round(time.perf_counter() - t0, 1)})
 
-    q1 = QUERIES["q1"]
-    t0 = time.perf_counter()
-    eng.query(q1)          # warm-up: compile + HBM upload
-    log(f"q1 first run (compile + HBM upload): "
-        f"{time.perf_counter() - t0:.1f}s")
-    times = []
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        got = eng.query(q1)
-        times.append(time.perf_counter() - t0)
-    device_t = min(times)
-    log(f"q1 per-iteration ms: {[round(t * 1000, 1) for t in times]} "
-        f"(path: {eng.executor.last_path})")
-
-    t0 = time.perf_counter()
-    want = oracle("q1", data)
-    cpu_t = time.perf_counter() - t0
-    log(f"pandas q1 oracle: {cpu_t:.2f}s ({n_rows / cpu_t / 1e6:.2f} Mrows/s)")
-
-    # correctness gate: a fast wrong answer scores zero
-    want_sorted = want.sort_values(["l_returnflag", "l_linestatus"])
-    np.testing.assert_allclose(
-        got["sum_charge"].to_numpy(dtype=np.float64),
-        want_sorted["sum_charge"].to_numpy(dtype=np.float64), rtol=1e-9)
-    np.testing.assert_array_equal(
-        got["count_order"].to_numpy(dtype=np.int64),
-        want_sorted["count_order"].to_numpy(dtype=np.int64))
-
-    value = n_rows / device_t
-    log(f"q1: {device_t * 1000:.1f}ms best ({value / 1e6:.2f} Mrows/s, "
-        f"{value / (n_rows / cpu_t):.1f}x pandas)")
-    return eng, data, value, value / (n_rows / cpu_t)
-
-
-def run_suite(sf: float, eng=None, data=None) -> dict:
-    from ydb_tpu.bench.tpch_gen import load_tpch
-    from ydb_tpu.query import QueryEngine
-    from tests.tpch_util import (
-        QUERIES, assert_frames_match, frames, oracle,
-    )
-
-    if eng is None:
-        t0 = time.perf_counter()
-        eng = QueryEngine(block_rows=1 << 20)
-        data = load_tpch(eng.catalog, sf=sf)
-        log(f"suite sf={sf}: load {time.perf_counter() - t0:.1f}s")
-        t0 = time.perf_counter()
-        warm = eng.prewarm()
-        log(f"suite sf={sf}: prewarm {warm / 1e9:.2f}GB, "
-            f"{time.perf_counter() - t0:.1f}s")
-    n_rows = eng.catalog.table("lineitem").num_rows
-
-    per_ms, ratios, paths, skipped = {}, {}, {}, []
-    checked = []
+    deadline = _T0 + BUDGET_S
     for name in QUERIES:
-        if time.perf_counter() - _T0 > BUDGET_S:
-            skipped.append(name)
+        if name in skip:
             continue
+        if time.perf_counter() > deadline:
+            emit({"kind": "skip", "query": name, "reason": "budget"})
+            continue
+        emit({"kind": "start", "query": name})
         sql = QUERIES[name]
         try:
             t0 = time.perf_counter()
-            got = eng.query(sql)            # compile + first run
-            first = time.perf_counter() - t0
-            times = [first]
+            got = eng.query(sql)                 # compile + first run
+            times = [time.perf_counter() - t0]
             for _ in range(SUITE_REPEATS):
                 t0 = time.perf_counter()
                 got = eng.query(sql)
                 times.append(time.perf_counter() - t0)
             best = min(times)
-            per_ms[name] = round(best * 1000, 1)
-            paths[name] = eng.executor.last_path
-            gate = sf <= 1 or name in GATE_BIG
-            if gate:
+            rec = {"kind": "result", "query": name,
+                   "ms": round(best * 1000, 1),
+                   "path": eng.executor.last_path}
+            if sf <= 1 or name in GATE_BIG:
                 t0 = time.perf_counter()
                 want = oracle(name, data)
                 cpu_t = time.perf_counter() - t0
                 want.columns = list(got.columns)
-                ordered = True
-                assert_frames_match(got, want, ordered=ordered,
+                assert_frames_match(got, want, ordered=True,
                                     rtol=1e-6 if sf > 1 else 1e-9)
-                checked.append(name)
-                ratios[name] = round(cpu_t / best, 1)
-            log(f"sf={sf} {name}: {per_ms[name]}ms "
-                f"[{paths[name]}]"
-                + (f" oracle ok, {ratios[name]}x" if name in ratios else ""))
-        except Exception as e:                          # noqa: BLE001
-            log(f"sf={sf} {name}: FAILED {type(e).__name__}: {str(e)[:120]}")
-            per_ms[name] = None
-    ok = [v for v in per_ms.values() if v]
-    out = {
+                rec["oracle"] = "ok"
+                rec["vs_pandas"] = round(cpu_t / best, 1)
+            emit(rec)
+        except Exception as e:                   # noqa: BLE001
+            emit({"kind": "result", "query": name, "ms": None,
+                  "error": f"{type(e).__name__}: {str(e)[:160]}"})
+    emit({"kind": "done"})
+
+
+# ---------------------------------------------------------------------------
+# parent: orchestration only (no jax import — the device belongs to the
+# child; two processes sharing the tunnel wedge it)
+# ---------------------------------------------------------------------------
+
+
+def run_suite(sf: float) -> dict:
+    progress = f"/tmp/bench_suite_sf{sf:g}_{os.getpid()}.jsonl"
+    if os.path.exists(progress):
+        os.unlink(progress)
+    skip: list = []
+    results: dict = {}
+    meta: dict = {}
+    skipped_budget: list = []
+    hung: list = []
+
+    while True:
+        if time.perf_counter() - _T0 > BUDGET_S:
+            break
+        cmd = [sys.executable, os.path.abspath(__file__), "--suite-child",
+               str(sf), progress, ",".join(skip)]
+        child = subprocess.Popen(cmd)
+        pos = 0
+        current = None
+        last_progress = time.monotonic()
+        done = False
+        while child.poll() is None:
+            time.sleep(2)
+            try:
+                with open(progress) as f:
+                    f.seek(pos)
+                    new = f.read()
+                    pos += len(new)
+            except FileNotFoundError:
+                new = ""
+            for line in new.splitlines():
+                rec = json.loads(line)
+                last_progress = time.monotonic()
+                if rec["kind"] == "meta":
+                    meta = rec
+                elif rec["kind"] == "start":
+                    current = rec["query"]
+                elif rec["kind"] == "result":
+                    results[rec["query"]] = rec
+                    current = None
+                    log(f"sf={sf:g} {rec['query']}: "
+                        + (f"{rec['ms']}ms [{rec.get('path', '')}]"
+                           + (f" oracle ok, {rec['vs_pandas']}x"
+                              if "vs_pandas" in rec else "")
+                           if rec["ms"] is not None
+                           else f"FAILED {rec.get('error', '')}"))
+                elif rec["kind"] == "skip":
+                    skipped_budget.append(rec["query"])
+                elif rec["kind"] == "done":
+                    done = True
+            # stall watchdog: the load+prewarm phase gets one timeout
+            # window too (current is None then — generous stall window)
+            window = QUERY_TIMEOUT if current else max(QUERY_TIMEOUT, 900)
+            if time.monotonic() - last_progress > window:
+                log(f"sf={sf:g}: no progress for {window:.0f}s"
+                    + (f" (stuck on {current})" if current else "")
+                    + " — killing child")
+                child.kill()
+                child.wait()
+                if current is not None:
+                    hung.append(current)
+                    skip.append(current)
+                    current = None
+                else:
+                    done = True      # stuck outside a query: give up
+                break
+        else:
+            # child exited by itself; read any tail lines
+            try:
+                with open(progress) as f:
+                    f.seek(pos)
+                    for line in f.read().splitlines():
+                        rec = json.loads(line)
+                        if rec["kind"] == "result":
+                            results[rec["query"]] = rec
+                        elif rec["kind"] == "meta":
+                            meta = rec
+                        elif rec["kind"] == "skip":
+                            skipped_budget.append(rec["query"])
+                        elif rec["kind"] == "done":
+                            done = True
+            except FileNotFoundError:
+                pass
+            if not done and child.returncode != 0:
+                # crashed mid-query: blacklist the in-flight one
+                if current is not None:
+                    hung.append(current)
+                    skip.append(current)
+                else:
+                    done = True
+        if done:
+            break
+
+    ok = {q: r["ms"] for q, r in results.items() if r.get("ms")}
+    ratios = {q: r["vs_pandas"] for q, r in results.items()
+              if "vs_pandas" in r}
+    return {
         "sf": sf,
-        "lineitem_rows": int(n_rows),
+        "lineitem_rows": meta.get("lineitem_rows"),
+        "load_s": meta.get("load_s"),
         "completed": len(ok),
-        "failed": sorted(k for k, v in per_ms.items() if v is None),
-        "skipped_for_budget": skipped,
-        "geomean_ms": round(geomean(ok), 1),
-        "per_query_ms": per_ms,
-        "paths": paths,
-        "oracle_checked": checked,
+        "failed": sorted(q for q, r in results.items() if not r.get("ms")),
+        "hung": hung,
+        "skipped_for_budget": sorted(set(skipped_budget) - set(ok)),
+        "geomean_ms": round(geomean(list(ok.values())), 1),
+        "per_query_ms": ok,
+        "paths": {q: r.get("path", "") for q, r in results.items()},
+        "oracle_checked": sorted(ratios),
         "vs_pandas": ratios,
         "vs_pandas_geomean": round(geomean(list(ratios.values())), 1)
         if ratios else None,
     }
-    log(f"suite sf={sf}: {len(ok)}/22 ok, geomean {out['geomean_ms']}ms"
-        + (f", {out['vs_pandas_geomean']}x pandas geomean"
-           if out["vs_pandas_geomean"] else ""))
-    return out
 
 
 def main() -> None:
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    eng, data, q1_value, q1_ratio = run_headline()
-
     suites = {}
     for sf in SUITE_SFS:
         if time.perf_counter() - _T0 > BUDGET_S:
-            log(f"budget exhausted before sf={sf} suite")
+            log(f"budget exhausted before sf={sf:g} suite")
             continue
-        if sf == SF:
-            suites[f"sf{sf:g}"] = run_suite(sf, eng, data)
-        else:
-            if sf > SF:
-                # free the smaller dataset before loading the big one
-                from tests import tpch_util
-                tpch_util._FRAMES_MEMO.clear()
-                eng = data = None
-                gc.collect()
-            suites[f"sf{sf:g}"] = run_suite(sf)
+        out = run_suite(sf)
+        suites[f"sf{sf:g}"] = out
+        log(f"suite sf={sf:g}: {out['completed']}/22 ok, "
+            f"geomean {out['geomean_ms']}ms"
+            + (f", {out['vs_pandas_geomean']}x pandas geomean"
+               if out["vs_pandas_geomean"] else ""))
 
+    # headline: Q1 throughput from the SF1 suite (continuity with r1-r3)
+    sf1 = suites.get("sf1", {})
+    q1_ms = sf1.get("per_query_ms", {}).get("q1")
+    rows = sf1.get("lineitem_rows") or 0
+    value = rows / (q1_ms / 1000) if q1_ms else 0.0
+    ratio = sf1.get("vs_pandas", {}).get("q1", 0.0)
     print(json.dumps({
         "metric": "tpch_q1_rows_per_sec",
-        "value": round(q1_value, 1),
+        "value": round(value, 1),
         "unit": "rows/s",
-        "vs_baseline": round(q1_ratio, 3),
+        "vs_baseline": ratio,
         "suites": suites,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--suite-child":
+        sf = float(sys.argv[2])
+        skip = [s for s in sys.argv[4].split(",") if s] \
+            if len(sys.argv) > 4 else []
+        child_main(sf, sys.argv[3], skip)
+    else:
+        main()

@@ -56,7 +56,8 @@ def geomean(xs):
 # ---------------------------------------------------------------------------
 
 
-def child_main(sf: float, progress_path: str, skip: list) -> None:
+def child_main(sf: float, progress_path: str, skip: list,
+               budget_s: float) -> None:
     import numpy as np
 
     from ydb_tpu.bench.tpch_gen import load_tpch
@@ -79,7 +80,7 @@ def child_main(sf: float, progress_path: str, skip: list) -> None:
           "load_s": round(load_s, 1),
           "prewarm_s": round(time.perf_counter() - t0, 1)})
 
-    deadline = _T0 + BUDGET_S
+    deadline = _T0 + budget_s        # the parent passes REMAINING budget
     for name in QUERIES:
         if name in skip:
             continue
@@ -135,8 +136,12 @@ def run_suite(sf: float) -> dict:
     while True:
         if time.perf_counter() - _T0 > BUDGET_S:
             break
+        remaining = max(BUDGET_S - (time.perf_counter() - _T0), 60)
+        # completed queries are skipped too: a respawn must CONTINUE, not
+        # redo minutes of timed runs + oracles per already-done query
         cmd = [sys.executable, os.path.abspath(__file__), "--suite-child",
-               str(sf), progress, ",".join(skip)]
+               str(sf), progress, ",".join(skip + sorted(results)),
+               str(remaining)]
         child = subprocess.Popen(cmd)
         pos = 0
         current = None
@@ -148,11 +153,18 @@ def run_suite(sf: float) -> dict:
                 with open(progress) as f:
                     f.seek(pos)
                     new = f.read()
+                    # consume only whole lines: a partially flushed
+                    # record must not crash the parser
+                    cut = new.rfind("\n") + 1
+                    new = new[:cut]
                     pos += len(new)
             except FileNotFoundError:
                 new = ""
             for line in new.splitlines():
-                rec = json.loads(line)
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
                 last_progress = time.monotonic()
                 if rec["kind"] == "meta":
                     meta = rec
@@ -171,6 +183,15 @@ def run_suite(sf: float) -> dict:
                     skipped_budget.append(rec["query"])
                 elif rec["kind"] == "done":
                     done = True
+            # global budget is a REAL ceiling: a running child is killed
+            # once the parent's budget (+ one stall window of grace for
+            # the in-flight query) is gone
+            if time.perf_counter() - _T0 > BUDGET_S + QUERY_TIMEOUT:
+                log(f"sf={sf:g}: global budget exceeded — killing child")
+                child.kill()
+                child.wait()
+                done = True
+                break
             # stall watchdog: the load+prewarm phase gets one timeout
             # window too (current is None then — generous stall window)
             window = QUERY_TIMEOUT if current else max(QUERY_TIMEOUT, 900)
@@ -188,14 +209,23 @@ def run_suite(sf: float) -> dict:
                     done = True      # stuck outside a query: give up
                 break
         else:
-            # child exited by itself; read any tail lines
+            # child exited by itself; read any tail lines (mirror the
+            # polling loop's record handling — 'start' must update
+            # `current` and 'result' must clear it, or crash handling
+            # would blame the wrong query)
             try:
                 with open(progress) as f:
                     f.seek(pos)
                     for line in f.read().splitlines():
-                        rec = json.loads(line)
+                        try:
+                            rec = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
                         if rec["kind"] == "result":
                             results[rec["query"]] = rec
+                            current = None
+                        elif rec["kind"] == "start":
+                            current = rec["query"]
                         elif rec["kind"] == "meta":
                             meta = rec
                         elif rec["kind"] == "skip":
@@ -268,6 +298,7 @@ if __name__ == "__main__":
         sf = float(sys.argv[2])
         skip = [s for s in sys.argv[4].split(",") if s] \
             if len(sys.argv) > 4 else []
-        child_main(sf, sys.argv[3], skip)
+        budget = float(sys.argv[5]) if len(sys.argv) > 5 else BUDGET_S
+        child_main(sf, sys.argv[3], skip, budget)
     else:
         main()

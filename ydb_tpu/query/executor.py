@@ -993,9 +993,24 @@ class Executor:
         elif isinstance(step.build, QueryPlan):
             built = self.execute(step.build, snapshot)
         else:
-            built = HostBlock.concat(
-                [to_host(d) for d in
-                 self._run_pipeline(step.build, params, snapshot)])
+            # route the build PIPELINE through the fused machinery too:
+            # its scan gets the single-dispatch path (and the superblock
+            # cache) instead of a dispatch per portion — q2/q9-class
+            # queries spend most of their time in builds. Empty output =
+            # keep every column (composite-key builds carry internal
+            # hash columns a projection would drop).
+            bplan = QueryPlan(pipeline=step.build, params=dict(params))
+            fused = self._try_execute_fused(bplan, params, snapshot) \
+                if self.enable_fused else None
+            if isinstance(fused, tuple):
+                built = fused[1]
+            elif isinstance(fused, HostBlock):
+                built = fused
+            else:
+                built = HostBlock.concat(
+                    [to_host(d) for d in
+                     self._run_pipeline(step.build, params, snapshot,
+                                        builds=fused)])
         kcd = built.columns.get(step.build_key)
         if kcd is not None and kcd.dictionary is not None \
                 and probe_dict is not None \

@@ -135,7 +135,13 @@ def test_optimistic_lock_under_real_threads():
     mu = threading.Lock()
 
     def actor(n):
-        for _ in range(6):
+        done = 0
+        attempts = 0
+        # retry on abort: under heavy CPU contention pure optimism can
+        # livelock every actor — the invariant under test is NO LOST
+        # UPDATES, not wait-freedom
+        while done < 4 and attempts < 60:
+            attempts += 1
             s = eng.session()
             try:
                 s.execute("begin")
@@ -145,6 +151,7 @@ def test_optimistic_lock_under_real_threads():
                 s.execute("commit")
                 with mu:
                     committed.append(n)
+                done += 1
             except QueryError:
                 try:
                     s.execute("rollback")

@@ -249,19 +249,25 @@ def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,
 
 
 def build_inputs_sig(bt) -> tuple:
-    """Shape signature of a BuildTable's traced inputs."""
-    return (bt.lut.shape[0],
+    """Shape signature of a BuildTable's traced inputs. keys_sorted is
+    ALWAYS traced (bsearch probes), so its capacity is always part of
+    the signature."""
+    return (bt.lut.shape[0] if bt.lut is not None else "bs",
+            bt.keys_sorted.shape[0],
             next(iter(bt.payload.values())).shape[0] if bt.payload else 0,
             tuple(sorted(bt.payload)), tuple(sorted(bt.payload_valid)))
 
 
 def build_traced_inputs(bt) -> dict:
     """The traced-input pytree for one BuildTable."""
-    return {
-        "lut": bt.lut,
+    out = {
         "lut_base": jnp.int64(bt.lut_base),
         "n": jnp.int32(bt.n),
         "has_null": jnp.bool_(bt.anti_has_null),
+        "keys": bt.keys_sorted,      # bsearch probes (sparse/float keys)
         "payload": dict(bt.payload),
         "pvalid": dict(bt.payload_valid),
     }
+    if bt.lut is not None:           # pytree shape is part of the jit sig
+        out["lut"] = bt.lut
+    return out

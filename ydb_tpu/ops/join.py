@@ -176,37 +176,64 @@ def build_partitioned(block: HostBlock, key: str, payload_names: list[str],
     return PartitionedBuild(tables, nparts, key)
 
 
+def bsearch_traced(keys_sorted, enc):
+    """Branchless lower_bound as log2(cap) UNROLLED gathers — the fused
+    replacement for `jnp.searchsorted`, which lowers to a serializing
+    scan loop on this platform (~4s for 6M probes, PERF.md). keys_sorted
+    must be padded to a power-of-two capacity with a +inf/INT64_MAX
+    sentinel (what `build()` produces)."""
+    cap = keys_sorted.shape[0]
+    assert cap & (cap - 1) == 0, "bsearch needs a pow2-padded build"
+    pos = jnp.zeros(enc.shape, jnp.int32)
+    step = cap >> 1
+    while step:
+        kv = keys_sorted[pos + (step - 1)]
+        pos = jnp.where(kv < enc, pos + step, pos)
+        step >>= 1
+    return pos
+
+
 def probe_lut_traced(env: dict, sel, bt_arrays: dict, meta: dict):
-    """LUT probe, callable inside a fused query trace (`ops/fused.py`).
+    """Build-probe inside a fused query trace (`ops/fused.py`): a
+    direct-address LUT gather when the build has one, an unrolled
+    binary search otherwise (sparse key spans, float keys).
 
     env: {name: (data, valid|None)}; sel: bool selection mask — REQUIRED,
     and must already include the row-activity mask (`iota < length`; the
     fused pipeline threads it instead of compressing, so there is no
     separate length here); bt_arrays: traced build inputs {lut, lut_base,
-    n, payload.<name>, pvalid.<name>}; meta (static): probe_key, kind,
-    payload_names (post-rename), src_names, mark_col, not_in.
+    n, keys, payload.<name>, pvalid.<name>}; meta (static): probe_key,
+    kind, payload_names (post-rename), src_names, mark_col, not_in,
+    bsearch.
 
     Returns (env', sel'). Selection semantics match `_probe`: matched rows
     selected for inner/semi, unmatched for anti, all for left/mark."""
     if sel is None:
         raise ValueError("probe_lut_traced needs the row-activity mask")
     d, v = env[meta["probe_key"]]
-    if np.issubdtype(np.dtype(d.dtype), np.floating):
-        # LUTs address integer keys; truncating a float probe would
-        # mis-match (10.5 → 10). The executor declines fusion for float
-        # probe keys — this is the backstop.
-        raise TypeError("LUT probe requires an integral probe key")
-    enc = d.astype(jnp.int64)
     active = sel
     matchable = active if v is None else (active & v)
-
-    lut = bt_arrays["lut"]
-    span = lut.shape[0]
-    off = enc - bt_arrays["lut_base"]
-    inb = (off >= 0) & (off < span)
-    idx = lut[jnp.clip(off, 0, span - 1).astype(jnp.int32)]
-    found = inb & (idx >= 0) & matchable
     kind = meta["kind"]
+
+    if meta.get("bsearch"):
+        keys = bt_arrays["keys"]
+        enc = _probe_enc(d)
+        pos = bsearch_traced(keys, enc)
+        idx = jnp.clip(pos, 0, keys.shape[0] - 1)
+        found = (keys[idx] == enc) & matchable \
+            & (idx < bt_arrays["n"])
+    else:
+        if np.issubdtype(np.dtype(d.dtype), np.floating):
+            # LUTs address integer keys; truncating a float probe would
+            # mis-match (10.5 → 10) — floats must take the bsearch path
+            raise TypeError("LUT probe requires an integral probe key")
+        enc = d.astype(jnp.int64)
+        lut = bt_arrays["lut"]
+        span = lut.shape[0]
+        off = enc - bt_arrays["lut_base"]
+        inb = (off >= 0) & (off < span)
+        idx = lut[jnp.clip(off, 0, span - 1).astype(jnp.int32)]
+        found = inb & (idx >= 0) & matchable
 
     pcap = next(iter(bt_arrays["payload"].values())).shape[0] \
         if bt_arrays["payload"] else d.shape[0]

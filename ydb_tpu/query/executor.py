@@ -190,10 +190,11 @@ class Executor:
     def _try_execute_fused(self, plan: QueryPlan, params: dict,
                            snapshot: Snapshot):
         """Run the query as ONE fused device program (`ops/fused.py`) when
-        its shape allows: single device, all joins LUT-probeable (and
-        unique-keyed where payloads attach — expanding duplicate-key
-        probes need a data-dependent output capacity, so they stay on the
-        portioned path).
+        its shape allows: single device, joins unique-keyed where
+        payloads attach (expanding duplicate-key probes need a
+        data-dependent output capacity, so they stay on the portioned
+        path). Probes use a direct-address LUT when the build has one,
+        an unrolled binary search otherwise (sparse spans, float keys).
 
         Returns the merged HostBlock on success; on fallback, the list of
         prepared join BuildTables (for `_run_pipeline` to reuse) or None
@@ -211,17 +212,16 @@ class Executor:
         with self._span("join-builds", n=len(join_steps)):
             builds = self._prepare_builds(pipe, params, snapshot)
         for step, bt in zip(join_steps, builds):
-            if isinstance(bt, J.PartitionedBuild) or bt.lut is None or (
+            if isinstance(bt, J.PartitionedBuild) or (
                     not bt.unique and step.kind in ("inner", "left", "mark")):
-                return builds   # partitioned / un-LUT-able / expanding
+                return builds   # partitioned / expanding probe
 
         scan_cols = [Column(i, table.schema.dtype(s))
                      for (s, i) in pipe.scan.columns]
 
-        # one schema walk over the pipeline: collects join metas, rejects
-        # float probe keys (a truncating LUT probe would mis-match 10.5
-        # against 10), and lands on the final schema used for sort setup
-        # and output selection
+        # one schema walk over the pipeline: collects join metas (incl.
+        # the LUT-vs-bsearch probe choice per build) and lands on the
+        # final schema used for sort setup and output selection
         dicts = {}
         join_metas = []
         bi = 0
@@ -234,9 +234,6 @@ class Executor:
                 continue
             bt = builds[bi]
             bi += 1
-            if schema.dtype(step.probe_key).kind in (_K.FLOAT64,
-                                                     _K.FLOAT32):
-                return builds
             payload_cols = []
             for name in bt.schema.names:
                 payload_cols.append(
@@ -254,6 +251,12 @@ class Executor:
                 "mark_col": step.mark_col,
                 "not_in": step.not_in,
                 "payload_cols": payload_cols,
+                # sparse key spans have no LUT; float PROBES must not
+                # truncate through an integer LUT — both take the
+                # unrolled binary search in the trace
+                "bsearch": bt.lut is None
+                or schema.dtype(step.probe_key).kind in (_K.FLOAT64,
+                                                         _K.FLOAT32),
             })
             schema = F.apply_join_schema(schema, payload_cols)
         if pipe.partial is not None:

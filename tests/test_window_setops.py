@@ -215,3 +215,78 @@ def test_window_expression_nullable_and_aggregate():
     df = eng.query("select g, sum(v) * 100 / sum(sum(v)) over () as share "
                    "from w group by g order by g")
     assert np.allclose(df.share, [100 * 12 / 28, 100 * 16 / 28])
+
+
+def test_intersect_except():
+    e = QueryEngine(block_rows=1 << 10)
+    e.execute("create table sa (x Int64 not null, primary key (x))")
+    e.execute("create table sb (x Int64 not null, primary key (x))")
+    e.execute("insert into sa (x) values (1), (2), (3), (4), (5)")
+    e.execute("insert into sb (x) values (3), (4), (5), (6), (7)")
+    df = e.query("select x from sa intersect select x from sb")
+    assert sorted(df.x) == [3, 4, 5]
+    df = e.query("select x from sa except select x from sb")
+    assert sorted(df.x) == [1, 2]
+    # trailing ORDER BY binds to the whole set result
+    df = e.query("select x from sa except select x from sb order by x desc")
+    assert list(df.x) == [2, 1]
+    # precedence: INTERSECT binds tighter than EXCEPT/UNION
+    df = e.query("select x from sa except select x from sa "
+                 "intersect select x from sb")
+    assert sorted(df.x) == [1, 2]   # sa \ (sa ∩ sb)
+
+
+def test_intersect_except_all_multiplicity():
+    import pandas as pd
+    e = QueryEngine(block_rows=1 << 10)
+    e.execute("create table ma (id Int64 not null, v Int64 not null, "
+              "primary key (id))")
+    e.execute("insert into ma (id, v) values "
+              "(1,1),(2,1),(3,1),(4,2),(5,2),(6,3)")
+    e.execute("create table mb (id Int64 not null, v Int64 not null, "
+              "primary key (id))")
+    e.execute("insert into mb (id, v) values (1,1),(2,2),(3,2),(4,2),(5,4)")
+    # v-multisets: a = {1,1,1,2,2,3}, b = {1,2,2,2,4}
+    df = e.query("select v from ma intersect all select v from mb")
+    assert sorted(df.v) == [1, 2, 2]          # min multiplicities
+    df = e.query("select v from ma except all select v from mb")
+    assert sorted(df.v) == [1, 1, 3]          # count difference
+
+
+def test_window_rows_frames():
+    import pandas as pd
+    e = QueryEngine(block_rows=1 << 10)
+    e.execute("create table wf (id Int64 not null, g Int64 not null, "
+              "v Double not null, primary key (id))")
+    e.execute("insert into wf (id, g, v) values "
+              + ",".join(f"({i},{i % 2},{float(i)})" for i in range(12)))
+    df = pd.DataFrame({"id": range(12), "g": [i % 2 for i in range(12)],
+                       "v": [float(i) for i in range(12)]})
+    # moving sum: 2 preceding .. current row, per partition by id order
+    got = e.query(
+        "select id, sum(v) over (partition by g order by id "
+        "rows between 2 preceding and current row) as s from wf "
+        "order by id")
+    want = df.sort_values("id").groupby("g").v.transform(
+        lambda s: s.rolling(3, min_periods=1).sum())
+    assert list(got.s) == list(want)
+    # centered moving average: 1 preceding .. 1 following
+    got = e.query(
+        "select id, avg(v) over (order by id rows between 1 preceding "
+        "and 1 following) as a from wf order by id")
+    want = df.v.rolling(3, min_periods=1, center=True).mean()
+    import numpy as np
+    np.testing.assert_allclose(got.a, want)
+    # max over a FOLLOWING-only frame
+    got = e.query(
+        "select id, max(v) over (order by id rows between 1 following "
+        "and 2 following) as m from wf order by id")
+    exp = [max([x for x in (i + 1, i + 2) if x < 12], default=None)
+           for i in range(12)]
+    assert [None if pd.isna(x) else x for x in got.m] == \
+        [None if x is None else float(x) for x in exp]
+    # unbounded preceding .. current row == running sum (cross-check)
+    got = e.query(
+        "select id, sum(v) over (order by id rows between unbounded "
+        "preceding and current row) as s from wf order by id")
+    np.testing.assert_allclose(got.s, df.v.cumsum())

@@ -691,14 +691,45 @@ class QueryEngine:
             if len(left.columns) != len(right.columns):
                 raise QueryError("UNION arms have different arity")
             right.columns = left.columns
-            out = pd.concat([left, right], ignore_index=True)
             # the combined frame is the actual host job — guard it too
-            # (N arms each under the limit can still concat over it);
+            # (N arms each under the limit can still combine over it);
             # count=False: rows were already counted at their leaf arms
-            self._host_lane_guard(len(out), "setop", count=False)
-            if node.op == "union":
-                out = out.drop_duplicates(ignore_index=True)
-            return out
+            self._host_lane_guard(len(left) + len(right), "setop",
+                                  count=False)
+            if node.op in ("union", "union_all"):
+                out = pd.concat([left, right], ignore_index=True)
+                if node.op == "union":
+                    out = out.drop_duplicates(ignore_index=True)
+                return out
+            cols = list(left.columns)
+
+            def counts(lf, rf, how):
+                """Per-distinct-row multiplicities of both arms."""
+                lc = lf.groupby(cols, dropna=False).size() \
+                       .rename("__l").reset_index()
+                rc = rf.groupby(cols, dropna=False).size() \
+                       .rename("__r").reset_index()
+                return lc.merge(rc, on=cols, how=how)
+
+            if node.op == "intersect":
+                return left.drop_duplicates().merge(
+                    right.drop_duplicates(), on=cols, how="inner") \
+                    .reset_index(drop=True)
+            if node.op == "intersect_all":
+                m = counts(left, right, "inner")
+                reps = np.minimum(m["__l"], m["__r"]).to_numpy()
+            elif node.op == "except":
+                m = left.drop_duplicates().merge(
+                    right.drop_duplicates(), on=cols, how="left",
+                    indicator=True)
+                return m[m["_merge"] == "left_only"][cols] \
+                    .reset_index(drop=True)
+            else:                    # except_all: multiplicity difference
+                m = counts(left, right, "left")
+                reps = np.maximum(m["__l"] - m["__r"].fillna(0), 0) \
+                    .astype(int).to_numpy()
+            return m[cols].loc[m.index.repeat(reps)] \
+                          .reset_index(drop=True)
         arm = self._run_select(node, snap)
         self._host_lane_guard(arm.length, "setop")
         return arm.to_pandas()
@@ -732,7 +763,10 @@ class QueryEngine:
             raise QueryError(str(e)) from e
         inner_block = self._run_select(inner, snap)
         self._host_lane_guard(inner_block.length, "window")
-        df = W.compute_windows(inner_block.to_pandas(), outer)
+        try:
+            df = W.compute_windows(inner_block.to_pandas(), outer)
+        except ValueError as e:
+            raise QueryError(str(e)) from e
         if post is not None:
             # window results used INSIDE expressions: evaluate the
             # rewritten items as a second pass over the computed frame.

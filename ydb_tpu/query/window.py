@@ -67,7 +67,7 @@ def split_windowed(sel: ast.Select):
             raise ValueError(
                 "DISTINCT inside a window function is not supported")
         spec = {"func": e.func, "args": [], "part": [], "order": [],
-                "asc": [], "alias": alias}
+                "asc": [], "alias": alias, "frame": e.frame}
         for j, a in enumerate(e.args):
             al = f"__{tag}a{j}"
             inner_items.append(ast.SelectItem(a, al))
@@ -166,6 +166,62 @@ def split_windowed(sel: ast.Select):
     return inner, outer, (post_items if any_nested else None)
 
 
+def _frame_agg_group(g: pd.Series, fn: str, frame: tuple) -> pd.Series:
+    """One partition's ROWS-BETWEEN aggregate, vectorized: sums/counts/
+    averages via prefix sums over the [i+lo, i+hi] row window; min/max
+    via sliding windows (bounded frames) or running accumulation
+    (UNBOUNDED PRECEDING .. CURRENT ROW)."""
+    _tag, lo, hi = frame
+    lo_unb = isinstance(lo, tuple)
+    hi_unb = isinstance(hi, tuple)
+    v = g.to_numpy(dtype=np.float64, na_value=np.nan)
+    L = len(v)
+    idx = np.arange(L)
+    start = np.zeros(L, np.int64) if lo_unb \
+        else np.clip(idx + lo, 0, L)
+    end1 = np.full(L, L, np.int64) if hi_unb \
+        else np.clip(idx + hi + 1, 0, L)
+    if fn in ("sum", "count", "avg"):
+        filled = np.nan_to_num(v)
+        nn = (~np.isnan(v)).astype(np.int64)
+        cs = np.concatenate([[0.0], np.cumsum(filled)])
+        cc = np.concatenate([[0], np.cumsum(nn)])
+        ssum = cs[end1] - cs[np.minimum(start, end1)]
+        scnt = cc[end1] - cc[np.minimum(start, end1)]
+        if fn == "count":
+            out = scnt.astype(np.float64)
+        elif fn == "sum":
+            out = np.where(scnt > 0, ssum, np.nan)
+        else:
+            out = np.where(scnt > 0, ssum / np.maximum(scnt, 1), np.nan)
+        return pd.Series(out, index=g.index)
+    # min / max
+    if lo_unb and not hi_unb and hi == 0:
+        acc = (np.fmin.accumulate if fn == "min"
+               else np.fmax.accumulate)(v)
+        return pd.Series(acc, index=g.index)
+    if not lo_unb and not hi_unb and hi >= lo:
+        # out[i] = agg(v[i+lo : i+hi+1]): pad with NaN so every window
+        # is the same width, then slide
+        w = hi - lo + 1
+        pad = np.concatenate([np.full(max(-lo, 0), np.nan), v,
+                              np.full(max(hi, 0), np.nan)])
+        sw = np.lib.stride_tricks.sliding_window_view(pad, w)
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")   # all-NaN windows
+            out = (np.nanmin(sw, axis=1) if fn == "min"
+                   else np.nanmax(sw, axis=1))
+        out = out[idx + max(lo, 0)]
+        empty = start >= end1                # frame fully out of range
+        out = np.where(empty, np.nan, out)
+        return pd.Series(out, index=g.index)
+    raise ValueError(
+        f"{fn} over this ROWS frame is not supported yet "
+        "(supported: bounded frames, or UNBOUNDED PRECEDING .. "
+        "CURRENT ROW)")
+
+
 def compute_windows(df: pd.DataFrame, outer: list) -> pd.DataFrame:
     """Evaluate the window specs over the inner result, returning the
     final frame with columns in the original item order."""
@@ -205,6 +261,17 @@ def compute_windows(df: pd.DataFrame, outer: list) -> pd.DataFrame:
                 vals = newkey.astype(np.int64).groupby(
                     [s[c] for c in part], sort=False, dropna=False).cumsum()
             vals = vals.astype(np.int64)
+        elif spec.get("frame"):
+            # explicit ROWS BETWEEN frame
+            arg = spec["args"][0] if spec["args"] else None
+            col = s[arg] if arg is not None \
+                else pd.Series(1.0, index=s.index)
+            keys = [s[c] for c in part]
+            pieces = [_frame_agg_group(g, fn, spec["frame"])
+                      for _k, g in col.groupby(keys, sort=False,
+                                               dropna=False)]
+            vals = pd.concat(pieces).reindex(s.index) if pieces \
+                else pd.Series(np.nan, index=s.index)
         else:
             arg = spec["args"][0] if spec["args"] else None
             running = bool(spec["order"])

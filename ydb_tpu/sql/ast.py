@@ -125,6 +125,9 @@ class WindowFunc:
     partition_by: tuple = ()       # tuple[Expr, ...]
     order_by: tuple = ()           # tuple[OrderItem, ...]
     distinct: bool = False         # parsed but rejected (explicit error)
+    # ROWS BETWEEN frame: ("rows", lo, hi); bounds are signed row offsets
+    # (0 = current row) or ("unbounded", ±1)
+    frame: tuple = None            # type: ignore[assignment]
 
 
 @dataclass(frozen=True)
@@ -199,7 +202,7 @@ class Select:
 class SetOp:
     """UNION / UNION ALL chain; trailing ORDER BY/LIMIT bind to the whole
     set result (the `yql_expr` Extend/UnionAll callables)."""
-    op: str                        # union | union_all
+    op: str          # union | union_all | intersect[_all] | except[_all]
     left: object                   # Select | SetOp
     right: object                  # Select
     order_by: list = field(default_factory=list)

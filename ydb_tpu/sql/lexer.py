@@ -27,7 +27,8 @@ KEYWORDS = {
     "replace", "into", "values", "delete", "update", "set", "if", "with",
     "union", "all", "escape", "substring", "for", "partition", "store",
     "extract", "begin", "commit", "rollback", "transaction", "explain",
-    "analyze", "over", "alter",
+    "analyze", "over", "alter", "intersect", "except",
+    "rows", "unbounded", "preceding", "following", "current", "row",
 }
 
 _OPS = ["<>", "!=", ">=", "<=", "||", "(", ")", ",", "+", "-", "*", "/", "%",

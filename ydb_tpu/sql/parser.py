@@ -57,13 +57,19 @@ class Parser:
             raise SqlError(f"expected {op!r}, got {self.peek().value!r} "
                            f"at {self.peek().pos}")
 
+    # keywords that stay usable as identifiers/column names (the window-
+    # frame words especially: schemas with a `rows` or `current` column
+    # predate their reservation)
+    _SOFT = ("date", "key", "first", "last", "store", "set", "values",
+             "rows", "row", "current", "unbounded", "preceding",
+             "following")
+
     def ident(self) -> str:
         t = self.peek()
         if t.kind == "ident":
             return self.next().value
         # allow non-reserved keywords as identifiers in safe spots
-        if t.kind == "kw" and t.value in ("date", "key", "first", "last",
-                                          "store", "set", "values"):
+        if t.kind == "kw" and t.value in self._SOFT:
             return self.next().value
         raise SqlError(f"expected identifier, got {t.value!r} at {t.pos}")
 
@@ -123,21 +129,36 @@ class Parser:
                 self.expect_op(")")
                 if not self.accept_op(","):
                     break
-        node = self.parse_select_core()
-        while self.at_kw("union"):
+        node = self._parse_intersect_chain()
+        while self.at_kw("union") or self.at_kw("except"):
+            kw = self.peek().value
             self.next()
-            op = "union_all" if self.accept_kw("all") else "union"
-            right = self.parse_select_core()
+            has_all = self.accept_kw("all")
+            op = f"{kw}_all" if has_all else kw
+            right = self._parse_intersect_chain()
             node = ast.SetOp(op, node, right)
         if isinstance(node, ast.SetOp):
             # the last arm grabbed the chain's trailing ORDER BY/LIMIT
+            # (the rightmost SELECT — the right child may itself be an
+            # intersect chain)
             last = node.right
+            while isinstance(last, ast.SetOp):
+                last = last.right
             node.order_by, node.limit, node.offset = \
                 last.order_by, last.limit, last.offset
             last.order_by, last.limit, last.offset = [], None, None
             node.ctes = ctes
         else:
             node.ctes = ctes
+        return node
+
+    def _parse_intersect_chain(self):
+        """INTERSECT binds tighter than UNION/EXCEPT (SQL precedence)."""
+        node = self.parse_select_core()
+        while self.at_kw("intersect"):
+            self.next()
+            op = "intersect_all" if self.accept_kw("all") else "intersect"
+            node = ast.SetOp(op, node, self.parse_select_core())
         return node
 
     def parse_select_core(self) -> ast.Select:
@@ -452,7 +473,8 @@ class Parser:
                     args.append(self.expr())
                 self.expect_op(")")
                 return ast.FuncCall(name, tuple(args))
-        if t.kind == "ident":
+        if t.kind == "ident" or (t.kind == "kw"
+                                 and t.value in self._SOFT):
             nxt = self.peek(1)
             if nxt.kind == "op" and nxt.value == "(":
                 return self.func_call()
@@ -516,9 +538,37 @@ class Parser:
             order.append(self.order_item())
             while self.accept_op(","):
                 order.append(self.order_item())
+        frame = None
+        if self.at_kw("rows"):
+            self.next()
+            self.expect_kw("between")
+            lo = self._frame_bound()
+            self.expect_kw("and")
+            hi = self._frame_bound()
+            frame = ("rows", lo, hi)
         self.expect_op(")")
         return ast.WindowFunc(call.name, call.args, tuple(partition),
-                              tuple(order), call.distinct)
+                              tuple(order), call.distinct, frame)
+
+    def _frame_bound(self):
+        """UNBOUNDED PRECEDING/FOLLOWING | N PRECEDING/FOLLOWING |
+        CURRENT ROW → signed offset (None = unbounded that direction)."""
+        if self.accept_kw("unbounded"):
+            if self.accept_kw("preceding"):
+                return ("unbounded", -1)
+            self.expect_kw("following")
+            return ("unbounded", 1)
+        if self.accept_kw("current"):
+            self.expect_kw("row")
+            return 0
+        tok = self.next()
+        if tok.kind != "number":
+            raise SqlError(f"expected frame bound at {tok.pos}")
+        n = int(tok.value)
+        if self.accept_kw("preceding"):
+            return -n
+        self.expect_kw("following")
+        return n
 
     def case_expr(self) -> ast.Expr:
         self.expect_kw("case")

@@ -99,6 +99,23 @@ def test_scan_across_processes(cluster):
     np.testing.assert_allclose(got.l_extendedprice, w.l_extendedprice)
 
 
+def test_count_distinct_across_processes(cluster):
+    # two-level distinct: workers SELECT DISTINCT, the merge counts —
+    # naive partial-count summation would overcount cross-shard dupes
+    got = cluster.query(
+        "select l_returnflag, count(distinct l_suppkey) as c "
+        "from lineitem group by l_returnflag order by l_returnflag")
+    import pandas as pd
+    li = pd.DataFrame(cluster.tpch_data.tables["lineitem"])
+    w = li.groupby("l_returnflag").l_suppkey.nunique().reset_index()
+    assert list(got.iloc[:, 0]) == list(w.l_returnflag)
+    assert list(got.c) == list(w.l_suppkey)
+    # global distinct count
+    got = cluster.query("select count(distinct l_partkey) as c "
+                        "from lineitem")
+    assert int(got.c[0]) == li.l_partkey.nunique()
+
+
 def test_insert_routing_shards_rows(cluster):
     cluster.execute("create table kv (id Int64 not null, v Int64 not null, "
                     "primary key (id))")

@@ -47,6 +47,7 @@ class _AggCollector:
     def __init__(self):
         self.partial_items: list = []     # [(alias, ast expr)]
         self.merge_map: dict = {}         # FuncCall -> merge expr (ast)
+        self.has_distinct = False         # seen a DISTINCT aggregate
         self._n = 0
 
     def _alias(self) -> str:
@@ -58,9 +59,10 @@ class _AggCollector:
             if e in self.merge_map:
                 return
             if e.distinct:
-                raise ClusterError(
-                    "DISTINCT aggregates are not distributable over "
-                    "shards yet")
+                # recorded, not raised: detection passes (_has_agg) walk
+                # the same tree; only actual decomposition refuses
+                self.has_distinct = True
+                return
             if e.name == "avg":
                 a_s, a_c = self._alias(), self._alias()
                 self.partial_items.append(
@@ -114,7 +116,7 @@ def _has_agg(sel: ast.Select) -> bool:
         c.visit(it.expr)
     if sel.having is not None:
         c.visit(sel.having)
-    return bool(c.merge_map) or bool(sel.group_by)
+    return bool(c.merge_map) or c.has_distinct or bool(sel.group_by)
 
 
 def _contains_subquery(node) -> bool:
@@ -283,6 +285,9 @@ class ShardedCluster:
         if sel.distinct or sel.ctes:
             raise ClusterError("DISTINCT/CTE SELECTs are not "
                                "distributable over shards yet")
+        cd = self._try_count_distinct(sel)
+        if cd is not None:
+            return cd
         col = _AggCollector()
         for it in sel.items:
             col.visit(it.expr)
@@ -290,6 +295,12 @@ class ShardedCluster:
             col.visit(sel.having)
         for o in sel.order_by:
             col.visit(o.expr)
+        if col.has_distinct:
+            # the distinct-only shape was handled above; mixtures of
+            # DISTINCT and plain aggregates need a per-agg shuffle plan
+            raise ClusterError(
+                "mixing DISTINCT aggregates with other aggregates is "
+                "not distributable over shards yet")
 
         # group keys become named partial columns
         gmap = {}
@@ -326,6 +337,59 @@ class ShardedCluster:
         from ydb_tpu.core.block import HostBlock
         eng = self.engine
         block = HostBlock.from_pandas(partial)
+        return self._merge_over_temp(block, sel, mitems, mgroup, mhaving,
+                                     morder)
+
+    def _try_count_distinct(self, sel: ast.Select):
+        """COUNT(DISTINCT x) distribution (the two-level distinct
+        shuffle): supported when every aggregate is a distinct count —
+        workers return SELECT DISTINCT keys+args, the merge counts.
+        Returns None when the shape doesn't apply."""
+        aggs = []
+        for it in sel.items:
+            if isinstance(it.expr, ast.FuncCall) \
+                    and it.expr.name in AGGS:
+                if not (it.expr.name == "count" and it.expr.distinct):
+                    return None
+                aggs.append(it)
+            elif it.expr not in sel.group_by:
+                return None
+        if not aggs:
+            return None
+        gitems = [ast.SelectItem(g, f"__g{i}")
+                  for i, g in enumerate(sel.group_by)]
+        ditems = [ast.SelectItem(a.expr.args[0], f"__d{k}")
+                  for k, a in enumerate(aggs)]
+        worker_sel = ast.Select(items=gitems + ditems,
+                                relation=sel.relation, where=sel.where,
+                                distinct=True)
+        partial = self._gather(render.select(worker_sel)) \
+            .drop_duplicates(ignore_index=True)     # cross-shard dups
+        gmap = {g: ast.Name((f"__g{i}",))
+                for i, g in enumerate(sel.group_by)}
+        mitems, k = [], 0
+        for i, it in enumerate(sel.items):
+            if it in aggs:
+                e = ast.FuncCall("count", (ast.Name((f"__d{k}",)),),
+                                 distinct=True)
+                k += 1
+            else:
+                e = _substitute(it.expr, gmap)
+            alias = it.alias or (it.expr.parts[-1]
+                                 if isinstance(it.expr, ast.Name)
+                                 else f"column{i}")
+            mitems.append(ast.SelectItem(e, alias))
+        morder = [dataclasses.replace(o, expr=_substitute(o.expr, gmap))
+                  for o in sel.order_by]
+        from ydb_tpu.core.block import HostBlock
+        block = HostBlock.from_pandas(partial)
+        return self._merge_over_temp(block, sel, mitems,
+                                     [gmap[g] for g in sel.group_by],
+                                     None, morder)
+
+    def _merge_over_temp(self, block, sel, mitems, mgroup, mhaving,
+                         morder) -> pd.DataFrame:
+        eng = self.engine
         temps: list = []
         try:
             tname = eng._register_temp(block, temps)

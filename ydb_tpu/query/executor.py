@@ -81,6 +81,11 @@ class Executor:
         # devices (shuffle join) instead of replicating to every device
         self.dist_broadcast_budget_bytes = int(
             _os.environ.get("YDB_TPU_DIST_BROADCAST_BUDGET", 256 << 20))
+        # fused-program complexity cap: plans with more join steps than
+        # this stream portioned — a 7-join whole-query program has been
+        # observed to SIGSEGV the platform's TPU compiler service
+        self.fuse_max_joins = int(
+            _os.environ.get("YDB_TPU_FUSE_MAX_JOINS", 6))
 
     @property
     def last_path(self) -> str:
@@ -209,6 +214,8 @@ class Executor:
         # the expensive part and must not run for plans that always take
         # the portioned path
         join_steps = [step for kind, step in pipe.steps if kind == "join"]
+        if len(join_steps) > self.fuse_max_joins:
+            return None                  # program-complexity cap
         with self._span("join-builds", n=len(join_steps)):
             builds = self._prepare_builds(pipe, params, snapshot)
         for step, bt in zip(join_steps, builds):

@@ -86,6 +86,17 @@ def expr(e) -> str:                                   # noqa: C901
         if e.order_by:
             over.append("order by " + ", ".join(_order(o)
                                                 for o in e.order_by))
+        if e.frame is not None:
+            (_tag, lo, hi) = e.frame
+
+            def bound(b):
+                if isinstance(b, tuple):
+                    return "unbounded preceding" if b[1] < 0 \
+                        else "unbounded following"
+                if b == 0:
+                    return "current row"
+                return f"{-b} preceding" if b < 0 else f"{b} following"
+            over.append(f"rows between {bound(lo)} and {bound(hi)}")
         return f"{e.func}({inner}) over ({' '.join(over)})"
     raise TypeError(f"cannot render {type(e).__name__}")
 

@@ -123,15 +123,41 @@ def child_main(sf: float, progress_path: str, skip: list,
 # ---------------------------------------------------------------------------
 
 
+_HUNG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench_hung.json")
+
+
+def _load_hung() -> dict:
+    try:
+        with open(_HUNG_PATH) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+
+
+def _save_hung(d: dict) -> None:
+    try:
+        with open(_HUNG_PATH, "w") as f:
+            json.dump(d, f)
+    except OSError:
+        pass
+
+
 def run_suite(sf: float) -> dict:
     progress = f"/tmp/bench_suite_sf{sf:g}_{os.getpid()}.jsonl"
     if os.path.exists(progress):
         os.unlink(progress)
-    skip: list = []
+    # queries whose COMPILE hung a previous run (a stuck remote compile
+    # burns a full watchdog window): pre-skip, they re-enter the pool
+    # only when the hung file is deleted
+    known_hung = _load_hung().get(f"sf{sf:g}", [])
+    skip: list = list(known_hung)
+    if known_hung:
+        log(f"sf={sf:g}: pre-skipping previously hung: {known_hung}")
     results: dict = {}
     meta: dict = {}
     skipped_budget: list = []
-    hung: list = []
+    hung: list = list(known_hung)
 
     while True:
         if time.perf_counter() - _T0 > BUDGET_S:
@@ -204,6 +230,11 @@ def run_suite(sf: float) -> dict:
                 if current is not None:
                     hung.append(current)
                     skip.append(current)
+                    d = _load_hung()
+                    d.setdefault(f"sf{sf:g}", [])
+                    if current not in d[f"sf{sf:g}"]:
+                        d[f"sf{sf:g}"].append(current)
+                        _save_hung(d)
                     current = None
                 else:
                     done = True      # stuck outside a query: give up
@@ -265,8 +296,36 @@ def run_suite(sf: float) -> dict:
     }
 
 
+def _emit(suites: dict) -> None:
+    sf1 = suites.get("sf1", {})
+    q1_ms = sf1.get("per_query_ms", {}).get("q1")
+    rows = sf1.get("lineitem_rows") or 0
+    value = rows / (q1_ms / 1000) if q1_ms else 0.0
+    ratio = sf1.get("vs_pandas", {}).get("q1", 0.0)
+    print(json.dumps({
+        "metric": "tpch_q1_rows_per_sec",
+        "value": round(value, 1),
+        "unit": "rows/s",
+        "vs_baseline": ratio,
+        "suites": suites,
+    }), flush=True)
+
+
 def main() -> None:
-    suites = {}
+    import threading
+    suites: dict = {}
+
+    def emergency():
+        # whatever happens — a wedged child, a wedged poll loop — the
+        # driver gets its one JSON line and the process exits
+        deadline = BUDGET_S + 3 * QUERY_TIMEOUT
+        time.sleep(deadline)
+        log(f"EMERGENCY deadline ({deadline:.0f}s) — emitting partial "
+            "results and exiting")
+        _emit(suites)
+        os._exit(0)
+
+    threading.Thread(target=emergency, daemon=True).start()
     for sf in SUITE_SFS:
         if time.perf_counter() - _T0 > BUDGET_S:
             log(f"budget exhausted before sf={sf:g} suite")
@@ -279,18 +338,7 @@ def main() -> None:
                if out["vs_pandas_geomean"] else ""))
 
     # headline: Q1 throughput from the SF1 suite (continuity with r1-r3)
-    sf1 = suites.get("sf1", {})
-    q1_ms = sf1.get("per_query_ms", {}).get("q1")
-    rows = sf1.get("lineitem_rows") or 0
-    value = rows / (q1_ms / 1000) if q1_ms else 0.0
-    ratio = sf1.get("vs_pandas", {}).get("q1", 0.0)
-    print(json.dumps({
-        "metric": "tpch_q1_rows_per_sec",
-        "value": round(value, 1),
-        "unit": "rows/s",
-        "vs_baseline": ratio,
-        "suites": suites,
-    }))
+    _emit(suites)
 
 
 if __name__ == "__main__":

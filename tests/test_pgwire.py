@@ -324,3 +324,44 @@ def test_grpc_token_auth():
         assert good.ping()          # probes stay open
     finally:
         server.stop(0)
+
+
+def test_describe_owns_row_description(pg):
+    """ADVICE r4: per the v3 spec the RowDescription must ride the
+    Describe reply (JDBC/psycopg decode result sets off it) and Execute
+    must emit only DataRow/CommandComplete."""
+    c = PgClient(pg.port)
+    c.prepare("dsc", "select id, name from t order by id")
+    c.bind("", "dsc", [])
+    # one extended round: Describe(portal) + Execute + Sync. Exactly ONE
+    # RowDescription (Describe's); Execute contributes DataRows + tag only.
+    c._send(b"D", b"P\0")
+    c._send(b"E", b"\0" + struct.pack("!i", 0))
+    c._send(b"S", b"")
+    msgs = c._drain_until_ready()
+    tags = [t for t, _p in msgs]
+    assert b"E" not in tags
+    assert tags.count(b"T") == 1 and tags.count(b"D") == 2
+    # the T precedes every DataRow (describe-then-execute ordering)
+    assert tags.index(b"T") < tags.index(b"D")
+    assert any(t == b"C" for t in tags)
+    c.close()
+
+
+def test_oid0_param_stays_string(pg):
+    """ADVICE r4: an unspecified-type (oid 0) digit-string parameter
+    compared against a STRING column must compare as the string, while
+    the same shape against an int column coerces to the number."""
+    c = PgClient(pg.port)
+    c.query("create table p0 (k Int64 not null, s Utf8, primary key (k))")
+    c.query("insert into p0 (k, s) values (123, '123'), (7, 'x')")
+    c.prepare("bys", "select k from p0 where s = $1")     # no oids
+    c.bind("", "bys", ["123"])
+    _c, rows, _t = c.execute_portal("")
+    assert rows == [["123"]]
+    c.prepare("byk", "select s from p0 where k = $1")     # no oids
+    c.bind("", "byk", ["7"])
+    _c, rows, _t = c.execute_portal("")
+    assert rows == [["x"]]
+    c.query("drop table p0")
+    c.close()

@@ -304,3 +304,31 @@ def test_compaction_respects_pinned_snapshots(eng):
     # with the tx gone, compaction proceeds on the next indexation
     eng.execute("insert into cc (id) values (99)")
     assert len(eng.catalog.table("cc").shards[0].portions) < 17
+
+
+def test_read_watermark_trails_apply(eng):
+    """Regression (ADVICE r4, high): propose() must not advance the READ
+    watermark before the commit finishes applying — a lock-free reader
+    snapshotting mid-commit would see a torn multi-shard apply."""
+    coord = eng.coordinator
+    before = coord.read_snapshot().plan_step
+    v = coord.propose(0)
+    # mid-apply: the granted step is NOT readable yet
+    assert coord.read_snapshot().plan_step == before
+    assert coord.safe_watermark() <= before
+    coord.publish(v.plan_step)
+    assert coord.read_snapshot().plan_step == v.plan_step
+
+
+def test_read_watermark_interleaved_publishes(eng):
+    """Two in-flight commits: the watermark advances only past the
+    contiguous published prefix (publishing the later step first must not
+    expose the earlier, still-applying one)."""
+    coord = eng.coordinator
+    base = coord.read_snapshot().plan_step
+    v1 = coord.propose(0)
+    v2 = coord.propose(0)
+    coord.publish(v2.plan_step)          # later step applies first
+    assert coord.read_snapshot().plan_step == base   # v1 still applying
+    coord.publish(v1.plan_step)
+    assert coord.read_snapshot().plan_step == v2.plan_step

@@ -206,9 +206,18 @@ class ShardedCluster:
             if v is None:
                 raise ClusterError("insert routing needs literal pk values")
             # deterministic across router processes (builtin hash() is
-            # PYTHONHASHSEED-randomized)
-            h = zlib.crc32(v.encode()) if isinstance(v, str) \
-                else int(splitmix64(np, np.array([v], np.int64))[0])
+            # PYTHONHASHSEED-randomized). Only int/str pk literals route:
+            # a float would silently truncate through the int64 hash
+            # (10.5 and 10 co-routing — ADVICE r4) and bool is almost
+            # certainly a mistyped pk.
+            if isinstance(v, str):
+                h = zlib.crc32(v.encode())
+            elif isinstance(v, int) and not isinstance(v, bool):
+                h = int(splitmix64(np, np.array([v], np.int64))[0])
+            else:
+                raise ClusterError(
+                    f"insert routing needs int or string pk literals, "
+                    f"got {type(v).__name__} ({v!r})")
             per[h % nw].append(row)
         cols = ", ".join(stmt.columns)
         for w, rows in zip(self.workers, per):

@@ -180,6 +180,49 @@ class _FoldedConst:
     hint: Optional[str] = None     # "date" | "interval_<unit>"
 
 
+_INT_KINDS = (dt.Kind.INT8, dt.Kind.INT16, dt.Kind.INT32, dt.Kind.INT64,
+              dt.Kind.UINT8, dt.Kind.UINT16, dt.Kind.UINT32, dt.Kind.UINT64,
+              dt.Kind.TIMESTAMP)
+
+
+def _coerce_text_literal(text: str, target: dt.DType):
+    """Re-type a text literal into a non-string column's domain (PG text
+    protocol: every bound parameter arrives as a string). None = the
+    text does not parse as the target type."""
+    k = target.kind
+    try:
+        if k in _INT_KINDS:
+            if re.fullmatch(r"[+-]?\d+", text.strip()):
+                return ast.Literal(int(text))
+        elif k in (dt.Kind.FLOAT64, dt.Kind.FLOAT32):
+            return ast.Literal(float(text))
+        elif k is dt.Kind.BOOL:
+            lv = text.strip().lower()
+            if lv in ("t", "true", "1", "on", "y", "yes"):
+                return ast.Literal(True)
+            if lv in ("f", "false", "0", "off", "n", "no"):
+                return ast.Literal(False)
+        elif k is dt.Kind.DATE32:
+            if re.fullmatch(r"\d{4}-\d{2}-\d{2}", text.strip()):
+                return ast.Literal(text.strip(), type_hint="date")
+    except ValueError:
+        return None
+    return None
+
+
+def _numify_folded(f: "_FoldedConst") -> "_FoldedConst":
+    """A folded STRING constant that parses as a number becomes that
+    number (arithmetic context only — comparisons coerce by column)."""
+    if not isinstance(f.value, str) or f.hint is not None:
+        return f
+    s = f.value.strip()
+    if re.fullmatch(r"[+-]?\d+", s):
+        return _FoldedConst(int(s), dt.DType(dt.Kind.INT64, False))
+    if re.fullmatch(r"[+-]?\d*\.\d+([eE][+-]?\d+)?", s):
+        return _FoldedConst(float(s), dt.DType(dt.Kind.FLOAT64, False))
+    return f
+
+
 def _try_fold(e: ast.Expr):
     """Literal / date / interval constant folding (host-side, bind time)."""
     if isinstance(e, ast.Literal):
@@ -230,6 +273,9 @@ def _try_fold(e: ast.Expr):
                 qty = b.value if e.op == "+" else -b.value
                 return _FoldedConst(shift_date(a.value, qty, unit),
                                     dt.DType(dt.Kind.DATE32, False), "date")
+        # text-protocol parameter in arithmetic ('5' + 1): a numeric-
+        # looking string operand participates as its number
+        lf, rf = _numify_folded(lf), _numify_folded(rf)
         if isinstance(lf.value, (int, float)) and isinstance(rf.value, (int, float)) \
                 and lf.hint is None and rf.hint is None:
             x, y = lf.value, rf.value
@@ -442,12 +488,16 @@ class ExprBinder:
             return ir.call("not", pred) if e.negated else pred
 
         if isinstance(e, ast.Between):
+            lo, hi = self._coerce_vs(e.arg, e.lo), self._coerce_vs(e.arg, e.hi)
             arg = self.bind(e.arg)
-            lo, hi = self.bind(e.lo), self.bind(e.hi)
+            lo, hi = self.bind(lo), self.bind(hi)
             expr = ir.call("and", ir.call("ge", arg, lo), ir.call("le", arg, hi))
             return ir.call("not", expr) if e.negated else expr
 
         if isinstance(e, ast.InList):
+            from dataclasses import replace as _dc_replace
+            e = _dc_replace(
+                e, items=tuple(self._coerce_vs(e.arg, it) for it in e.items))
             sf = _string_fn(e.arg, self.scope)
             if sf is not None:
                 b, fn = sf
@@ -532,6 +582,17 @@ class ExprBinder:
                         kern = "eq" if e.op == "=" else "ne"
                         return ir.call(kern, ir.Col(cb.internal),
                                        ir.Const(code, dt.DType(dt.Kind.STRING, False)))
+        # PG-driver literal coercion (ADVICE r4): text-protocol clients
+        # bind EVERY parameter as text (pgwire oid 0), so '123' compared
+        # against a numeric/date column means the value in the column's
+        # domain, not the string — re-type the literal before string
+        # binding sees it. Unparseable text against a non-string column
+        # is a clear bind error instead of a silent string comparison.
+        if e.op in ("=", "<>", "<", "<=", ">", ">="):
+            left = self._coerce_vs(e.right, e.left)
+            right = self._coerce_vs(e.left, e.right)
+            if left is not e.left or right is not e.right:
+                e = ast.BinOp(e.op, left, right)
         # string comparisons fold through the dictionary
         if e.op in ("=", "<>", "<", "<=", ">", ">="):
             for a, bexp, flip in ((e.left, e.right, False), (e.right, e.left, True)):
@@ -574,6 +635,25 @@ class ExprBinder:
         if kern is None:
             raise BindError(f"operator {e.op}")
         return ir.call(kern, self.bind(e.left), self.bind(e.right))
+
+    def _coerce_vs(self, col_expr: ast.Expr, lit_expr: ast.Expr) -> ast.Expr:
+        """Re-type a text literal compared against a non-string column
+        (PG text protocol sends every parameter as text). Returns the
+        rewritten literal, or `lit_expr` itself when no coercion applies."""
+        if not isinstance(col_expr, ast.Name):
+            return lit_expr
+        cb = self.scope.try_resolve(col_expr.parts)
+        lit = _try_fold(lit_expr)
+        if cb is None or cb.dtype.is_string or lit is None \
+                or not isinstance(lit.value, str) or lit.hint is not None:
+            return lit_expr
+        new = _coerce_text_literal(lit.value, cb.dtype)
+        if new is None:
+            raise BindError(
+                f"cannot compare column {col_expr.parts[-1]!r} "
+                f"({cb.dtype.kind.value}) with string literal "
+                f"{lit.value!r}")
+        return new
 
     def _maybe_string_col(self, e: ast.Expr) -> Optional[ColumnBinding]:
         if isinstance(e, ast.Name):

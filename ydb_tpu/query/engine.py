@@ -11,6 +11,7 @@ concurrent sessions over the shared engine.
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager as _contextmanager
 from typing import Optional
 
 import numpy as np
@@ -164,6 +165,19 @@ class QueryEngine:
         with self._reads_mu:
             self._active_reads[plan_step] += 1
 
+    def _register_read(self):
+        """Atomically take an autocommit read snapshot AND register it in
+        the active-read floor. Taking the snapshot first and registering
+        after (the r4 shape) left a gap where a commit + auto-compaction
+        could restamp portions the snapshot still needed (ADVICE r4):
+        under `_reads_mu`, any maintenance watermark computed before this
+        registration was bounded by an older published step, so portions
+        this snapshot sees are never restamped past it."""
+        with self._reads_mu:
+            snap = self.coordinator.read_snapshot()
+            self._active_reads[snap.plan_step] += 1
+        return snap
+
     def _exit_read(self, plan_step: int) -> None:
         with self._reads_mu:
             self._active_reads[plan_step] -= 1
@@ -187,7 +201,28 @@ class QueryEngine:
         return self.coordinator.last_plan_step
 
     def _next_version(self) -> WriteVersion:
-        return self.coordinator.propose(0)
+        """A plan step published immediately — for callers that commit to
+        storage directly (tests, loaders) with no reader able to observe
+        the mid-apply state they create. Statement paths use
+        `_commit_step` so the watermark trails the apply."""
+        version = self.coordinator.propose(0)
+        self.coordinator.publish(version.plan_step)
+        return version
+
+    @_contextmanager
+    def _commit_step(self, tx_id: int = 0):
+        """Propose→apply→publish envelope. The coordinator grants the plan
+        step on entry; the read watermark advances only when the body's
+        in-memory apply (stamps + delete marks) has finished, so lock-free
+        SELECTs snapshotting mid-commit never observe a torn multi-shard
+        apply. Publish runs in `finally` — a failed apply must not wedge
+        the watermark (storage-level intent journals own partial-failure
+        atomicity)."""
+        version = self.coordinator.propose(tx_id)
+        try:
+            yield version
+        finally:
+            self.coordinator.publish(version.plan_step)
 
     def snapshot(self) -> Snapshot:
         return self.coordinator.read_snapshot()
@@ -386,8 +421,14 @@ class QueryEngine:
                         if self.catalog.has(name):
                             tx.lock(self.catalog.table(name))
                 # register the snapshot: auto-compaction must not restamp
-                # portions this lock-free read still scans
-                self._enter_read(snap.plan_step)
+                # portions this lock-free read still scans. Autocommit
+                # reads re-take the snapshot ATOMICALLY with registration;
+                # tx snapshots are already coordinator-pinned, so their
+                # registration has no gap to race.
+                if tx is None:
+                    snap = self._register_read()
+                else:
+                    self._enter_read(snap.plan_step)
                 try:
                     return self._execute_read(stmt, sql, snap, stats, t)
                 finally:
@@ -1278,7 +1319,8 @@ class QueryEngine:
             self.last_rows_affected = block.length
             return _unit_block()
         writes = table.write(block)
-        table.commit(writes, self._next_version())
+        with self._commit_step() as version:
+            table.commit(writes, version)
         self.last_rows_affected = block.length
         table.indexate(self._maintenance_watermark(),
                        compact=self.config.flag("enable_auto_compaction"))
@@ -1306,7 +1348,8 @@ class QueryEngine:
             tx.row_writes.append((table, ops))
             tx.note_self_bump(table)
         else:
-            table.apply(ops, self._next_version())
+            with self._commit_step() as version:
+                table.apply(ops, version)
 
 
     # -- UPDATE / DELETE ---------------------------------------------------
@@ -1404,14 +1447,14 @@ class QueryEngine:
             if not len(df):
                 self.last_rows_affected = 0
                 return _unit_block()
-            version = self._next_version()
             block = HostBlock.from_pandas(
                 df[list(table.schema.names)], schema=table.schema,
                 dictionaries=table.dictionaries)
             writes = table.write(block)
             # marks + new rows in ONE commit (one intent record): a crash
             # must never leave a pure delete or a duplicating insert
-            table.commit(writes, version, deletes=hits)
+            with self._commit_step() as version:
+                table.commit(writes, version, deletes=hits)
             table.indexate(self._maintenance_watermark(),
                            compact=self.config.flag(
                                "enable_auto_compaction"))
@@ -1451,7 +1494,8 @@ class QueryEngine:
                 tx.note_self_bump(table)
                 tx.col_deletes.append((table, handles))
         elif hits:
-            table.apply_deletes(hits, self._next_version())
+            with self._commit_step() as version:
+                table.apply_deletes(hits, version)
         self.last_rows_affected = n
         return _unit_block()
 
@@ -1542,7 +1586,8 @@ class QueryEngine:
             tx.note_self_bump(table)   # staged write bumps data_version
             return _unit_block()
         if len(df):
-            table.bulk_upsert(df, self._next_version())
+            with self._commit_step() as version:
+                table.bulk_upsert(df, version)
         return _unit_block()
 
 

@@ -96,15 +96,11 @@ def _substitute_params(sql: str, params: list, oids: list) -> str:
             if not re.fullmatch(r"\d{4}-\d{2}-\d{2}", v):
                 raise ValueError(f"bad date parameter {v!r}")
             return f"date '{v}'"
-        if oid in (0, 705):
-            # unspecified type: numeric-looking text inlines as a number
-            # (drivers comparing int columns need this); clients that
-            # mean the STRING '123' must send oid 25 — date-shaped text
-            # stays a string (no sniffing into date literals)
-            if re.fullmatch(r"[+-]?\d+", v):
-                return v
-            if re.fullmatch(r"[+-]?\d*\.\d+([eE][+-]?\d+)?", v):
-                return v
+        # unspecified type (oid 0/705, what psycopg sends for all text
+        # params): inline as a STRING and let the binder's PG-style
+        # coercion re-type it against the compared column's domain
+        # (ADVICE r4 — sniffing digits into numbers here silently broke
+        # string-column comparisons like name = '123')
         s = v.replace("'", "''")
         return f"'{s}'"
 
@@ -230,7 +226,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 elif tag == b"B":
                     pending += step(self._bind_msg(payload))
                 elif tag == b"D":
-                    pending += step(self._describe_msg(payload))
+                    pending += step(self._describe_msg(srv, session,
+                                                       payload))
                 elif tag == b"E":
                     pending += step(self._execute_msg(srv, session,
                                                       payload))
@@ -298,16 +295,23 @@ class _Handler(socketserver.BaseRequestHandler):
                 return _error(f"unknown prepared statement "
                               f"{stmt_name!r}", code="26000")
             sql, oids = self._stmts[stmt_name]
-            self._portals[portal] = _substitute_params(sql, params, oids)
+            self._portals[portal] = {
+                "sql": _substitute_params(sql, params, oids)}
             return _msg(b"2", b"")                      # BindComplete
         except (ValueError, struct.error) as e:
             return _error(f"malformed Bind: {e}", code="08P01")
 
-    def _describe_msg(self, payload: bytes) -> bytes:
-        """Describe: statement variant answers ParameterDescription +
-        NoData (row descriptions ride the Execute response — we cannot
-        derive an output schema without executing); portal variant
-        answers NoData."""
+    _READ_KINDS = ("select", "setop", "explain")
+
+    def _describe_msg(self, srv, session, payload: bytes) -> bytes:
+        """Describe, per the v3 spec: the ROW DESCRIPTION belongs here,
+        not on Execute (ADVICE r4 — JDBC/psycopg decode result sets off
+        the Describe reply). Portal variant: read statements run NOW
+        (execute-on-describe — output schemas need the bound plan) and
+        the cached result rides the following Execute as DataRows only;
+        non-reads answer NoData without executing (Describe must never
+        mutate). Statement variant: ParameterDescription + NoData (the
+        SQL still holds unbound $n placeholders)."""
         kind, rest = payload[:1], payload[1:].rstrip(b"\0")
         if kind == b"S":
             ent = self._stmts.get(rest.decode())
@@ -319,20 +323,61 @@ class _Handler(socketserver.BaseRequestHandler):
             for o in oids:
                 body += struct.pack("!I", o)
             return _msg(b"t", body) + _msg(b"n", b"")
-        return _msg(b"n", b"")
+        portal = self._portals.get(rest.decode())
+        if portal is None:
+            return _error(f"unknown portal {rest.decode()!r}", code="34000")
+        first = portal["sql"].strip().split(None, 1)
+        head = first[0].lower().rstrip(";") if first else ""
+        if head not in ("select", "with", "values", "explain") \
+                or self._aborted:
+            return _msg(b"n", b"")
+        try:
+            block = srv.engine.execute(portal["sql"], session=session)
+            kind2 = srv.engine.last_stats.kind
+            if kind2 not in self._READ_KINDS:
+                # executed but not row-producing: remember the completion
+                # tag so the following Execute does NOT run it again
+                n = getattr(srv.engine, "last_rows_affected", 0)
+                portal["done_tag"] = {
+                    "insert": f"INSERT 0 {n}", "update": f"UPDATE {n}",
+                    "delete": f"DELETE {n}",
+                    **self._DDL_TAGS}.get(kind2, kind2.upper())
+                return _msg(b"n", b"")
+            portal["result"] = block
+            return self._row_desc(block)
+        except Exception as e:           # noqa: BLE001 — wire boundary
+            if session.tx is not None:
+                self._aborted = True
+            return _error(f"{type(e).__name__}: {e}")
 
     def _execute_msg(self, srv, session, payload: bytes) -> bytes:
         try:
             z1 = payload.index(b"\0")
-            portal = payload[:z1].decode()
+            portal_name = payload[:z1].decode()
         except ValueError:
             return _error("malformed Execute", code="08P01")
-        sql = self._portals.get(portal)
-        if sql is None:
-            return _error(f"unknown portal {portal!r}", code="34000")
+        portal = self._portals.get(portal_name)
+        if portal is None:
+            return _error(f"unknown portal {portal_name!r}", code="34000")
+        if self._aborted:
+            # a statement failed inside the tx AFTER this portal was
+            # described: its cached result must not leak past the
+            # aborted-transaction barrier. Drop the caches and let _run
+            # apply the 25P02 rule (which still honors ROLLBACK/COMMIT).
+            portal.pop("result", None)
+            portal.pop("done_tag", None)
+        done = portal.pop("done_tag", None)
+        if done is not None:
+            return _msg(b"C", _cstr(done))
+        block = portal.pop("result", None)
+        if block is not None:
+            # described portal: the result was produced at Describe time;
+            # Execute emits DataRows + CommandComplete only (spec shape)
+            return self._data_rows(block) \
+                + _msg(b"C", _cstr(f"SELECT {block.length}"))
         # reuse the simple-query runner minus its trailing ReadyForQuery
         # (extended flow defers that to Sync)
-        out = self._run(srv, session, sql)
+        out = self._run(srv, session, portal["sql"])
         z = _ready(self._status(session))
         return out[:-len(z)] if out.endswith(z) else out
 
@@ -389,14 +434,23 @@ class _Handler(socketserver.BaseRequestHandler):
         return _msg(b"C", _cstr(tag)) + _ready(self._status(session))
 
     @staticmethod
-    def _rows(block) -> bytes:
-        """Serialize a result block straight from its column arrays —
-        no pandas on this thread (pyarrow-backed DataFrame construction
-        is not safe off the main thread in this image)."""
-        cols, encs, series = [], [], []
+    def _row_desc(block) -> bytes:
+        """RowDescription ('T') for a result block."""
+        desc = struct.pack("!H", len(block.schema.columns))
         for c in block.schema.columns:
-            oid, enc = _oid_and_enc(c.dtype.kind.value)
-            cols.append((c.name, oid))
+            oid, _enc = _oid_and_enc(c.dtype.kind.value)
+            desc += _cstr(c.name) + struct.pack("!IHIhih", 0, 0, oid, -1,
+                                                -1, 0)
+        return _msg(b"T", desc)
+
+    @staticmethod
+    def _data_rows(block) -> bytes:
+        """DataRow ('D') stream, serialized straight from the column
+        arrays — no pandas on this thread (pyarrow-backed DataFrame
+        construction is not safe off the main thread in this image)."""
+        encs, series = [], []
+        for c in block.schema.columns:
+            _oid, enc = _oid_and_enc(c.dtype.kind.value)
             encs.append(enc)
             cd = block.columns[c.name]
             if c.dtype.is_string and cd.dictionary is not None:
@@ -404,12 +458,8 @@ class _Handler(socketserver.BaseRequestHandler):
             else:
                 vals = cd.data
             series.append((vals, cd.valid))
-        desc = struct.pack("!H", len(cols))
-        for (name, oid) in cols:
-            desc += _cstr(name) + struct.pack("!IHIhih", 0, 0, oid, -1,
-                                              -1, 0)
-        chunks = [_msg(b"T", desc)]      # list + join: linear, not O(n^2)
-        ncols_hdr = struct.pack("!H", len(cols))
+        chunks = []                      # list + join: linear, not O(n^2)
+        ncols_hdr = struct.pack("!H", len(series))
         null_cell = struct.pack("!i", -1)
         for i in range(block.length):
             body = [ncols_hdr]
@@ -424,8 +474,13 @@ class _Handler(socketserver.BaseRequestHandler):
                     text = enc(v).encode()
                     body.append(struct.pack("!I", len(text)) + text)
             chunks.append(_msg(b"D", b"".join(body)))
-        chunks.append(_msg(b"C", _cstr(f"SELECT {block.length}")))
         return b"".join(chunks)
+
+    @classmethod
+    def _rows(cls, block) -> bytes:
+        """Simple-query result: RowDescription + DataRows + tag."""
+        return cls._row_desc(block) + cls._data_rows(block) \
+            + _msg(b"C", _cstr(f"SELECT {block.length}"))
 
 
 class PgServer:

@@ -193,6 +193,11 @@ class Parser:
 
     def number_token(self) -> str:
         t = self.peek()
+        # a digit STRING is accepted where a count is required (LIMIT/
+        # OFFSET): PG text-protocol clients bind every parameter as text,
+        # and pgwire inlines unspecified-type params as string literals
+        if t.kind == "string" and t.value.strip().isdigit():
+            return self.next().value.strip()
         if t.kind != "number":
             raise SqlError(f"expected number at {t.pos}")
         return self.next().value

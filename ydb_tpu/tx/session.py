@@ -114,26 +114,32 @@ class Session:
         except TxAborted:
             self._abort(tx)
             raise
-        version = self.engine.coordinator.propose(tx.tx_id)
-        for table, ops in tx.row_writes:
-            table.stamp_tx(tx.tx_id, version, ops_for_wal=ops)
-        # group column writes + delete marks PER TABLE: one commit call
-        # carries both through one intent-journal record (an UPDATE's
-        # deletes and re-inserts must survive a crash together)
-        col_tables: dict = {}
-        for table, writes in tx.col_writes:
-            ent = col_tables.setdefault(id(table), [table, [], []])
-            ent[1].extend(writes)
-        for table, handles in tx.col_deletes:
-            ent = col_tables.setdefault(id(table), [table, [], []])
-            ent[2].extend(handles)
-        for (table, writes, handles) in col_tables.values():
-            hits = [(shard, portion, mark.rows)
-                    for (shard, portion, mark) in handles]
-            for (_shard, portion, mark) in handles:
-                portion.drop_delete(mark)      # replaced by committed marks
-            table.commit(writes, version, deletes=hits)
-            table.indexate()
+        coord = self.engine.coordinator
+        version = coord.propose(tx.tx_id)
+        try:
+            for table, ops in tx.row_writes:
+                table.stamp_tx(tx.tx_id, version, ops_for_wal=ops)
+            # group column writes + delete marks PER TABLE: one commit call
+            # carries both through one intent-journal record (an UPDATE's
+            # deletes and re-inserts must survive a crash together)
+            col_tables: dict = {}
+            for table, writes in tx.col_writes:
+                ent = col_tables.setdefault(id(table), [table, [], []])
+                ent[1].extend(writes)
+            for table, handles in tx.col_deletes:
+                ent = col_tables.setdefault(id(table), [table, [], []])
+                ent[2].extend(handles)
+            for (table, writes, handles) in col_tables.values():
+                hits = [(shard, portion, mark.rows)
+                        for (shard, portion, mark) in handles]
+                for (_shard, portion, mark) in handles:
+                    portion.drop_delete(mark)  # replaced by committed marks
+                table.commit(writes, version, deletes=hits)
+                table.indexate()
+        finally:
+            # read watermark advances only once every shard's apply landed
+            # (lock-free readers must never see a torn cross-table commit)
+            coord.publish(version.plan_step)
         if self.engine.catalog.store is not None:
             self.engine.catalog.store.save_state(version.plan_step)
         self.engine.coordinator.unpin_snapshot(tx.tx_id)

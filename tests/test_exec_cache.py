@@ -1,0 +1,132 @@
+"""Executable-cache lifecycle: the process-wide live-executable budget
+(`ops/exec_cache.py`) and the cross-query build cache
+(`query/build_cache.py`).
+
+The r4 suite segfaulted the XLA client by accumulating compiled
+executables and worked around it by clearing every cache between
+queries; these tests pin the real fix — one LRU budget over all
+compiled-program caches — and the soak proves a long-lived engine holds
+a flat working set across many distinct query shapes.
+"""
+
+import numpy as np
+import pytest
+
+from ydb_tpu.ops.exec_cache import ExecCache, _Budget
+
+
+def test_lru_within_one_cache():
+    b = _Budget(3)
+    c = ExecCache("t", b)
+    c["a"], c["b"], c["c"] = 1, 2, 3
+    assert c.get("a") == 1             # refresh a
+    c["d"] = 4                         # evicts b (globally oldest)
+    assert "b" not in c and "a" in c and "c" in c and "d" in c
+    assert c.evictions == 1
+
+
+def test_budget_spans_caches_globally_lru():
+    b = _Budget(3)
+    c1, c2 = ExecCache("one", b), ExecCache("two", b)
+    c1["x"] = 1
+    c2["y"] = 2
+    c1["z"] = 3
+    c2["w"] = 4                        # evicts c1["x"] — oldest anywhere
+    assert "x" not in c1 and "y" in c2 and "z" in c1 and "w" in c2
+    assert b.total() == 3
+
+
+def test_get_refresh_protects_across_caches():
+    b = _Budget(2)
+    c1, c2 = ExecCache("one", b), ExecCache("two", b)
+    c1["x"] = 1
+    c2["y"] = 2
+    assert c1.get("x") == 1            # x newer than y now
+    c1["z"] = 3                        # evicts y, not x
+    assert "x" in c1 and "y" not in c2
+
+
+def test_engine_soak_live_executables_bounded():
+    """Many distinct query shapes through ONE engine: the live-executable
+    count stays under the global budget and results stay correct (the
+    r4 segfault scenario, minus the segfault)."""
+    from ydb_tpu.ops.exec_cache import GLOBAL_BUDGET, live_executables
+    from ydb_tpu.query import QueryEngine
+
+    eng = QueryEngine(block_rows=1 << 12)
+    eng.execute("create table s (k Int64 not null, a Int64, b Double, "
+                "c Int64, primary key (k))")
+    rows = ", ".join(f"({i}, {i % 7}, {i * 0.5}, {i % 3})"
+                     for i in range(200))
+    eng.execute(f"insert into s (k, a, b, c) values {rows}")
+
+    old_max = GLOBAL_BUDGET.max_entries
+    GLOBAL_BUDGET.max_entries = 24
+    try:
+        # every distinct literal is a distinct program fingerprint →
+        # a distinct compiled executable per query shape
+        for i in range(60):
+            n = eng.query(
+                f"select count(*) as n from s where a = {i % 11} "
+                f"and k >= {i}").n[0]
+            expect = sum(1 for k in range(200)
+                         if k % 7 == i % 11 and k >= i)
+            assert n == expect, (i, n, expect)
+            assert live_executables() <= 24
+    finally:
+        GLOBAL_BUDGET.max_entries = old_max
+
+
+def test_build_cache_hit_and_invalidation():
+    from ydb_tpu.query import QueryEngine
+
+    eng = QueryEngine(block_rows=1 << 12)
+    eng.execute("create table f (k Int64 not null, d Int64, v Double, "
+                "primary key (k))")
+    eng.execute("create table d (d Int64 not null, tag Utf8, "
+                "primary key (d))")
+    eng.execute("insert into d (d, tag) values (0, 'x'), (1, 'y')")
+    eng.execute("insert into f (k, d, v) values "
+                + ", ".join(f"({i}, {i % 2}, {i * 1.0})" for i in range(50)))
+    sql = ("select tag, sum(v) as s from f join d on f.d = d.d "
+           "group by tag order by tag")
+    bc = eng.executor.build_cache
+    df1 = eng.query(sql)
+    m0, h0 = bc.misses, bc.hits
+    df2 = eng.query(sql)
+    assert bc.hits > h0, "second run must hit the build cache"
+    assert list(df1.s) == list(df2.s)
+    # a write to the BUILD table invalidates (src-id keying)
+    eng.execute("insert into d (d, tag) values (2, 'z')")
+    eng.execute("insert into f (k, d, v) values (100, 2, 10.0)")
+    df3 = eng.query(sql)
+    assert bc.misses > m0
+    assert list(df3.tag) == ["x", "y", "z"]
+    # pandas oracle for the final state
+    import pandas as pd
+    f = pd.DataFrame({"d": [i % 2 for i in range(50)] + [2],
+                      "v": [i * 1.0 for i in range(50)] + [10.0]})
+    dd = pd.DataFrame({"d": [0, 1, 2], "tag": ["x", "y", "z"]})
+    want = (f.merge(dd, on="d").groupby("tag").v.sum()
+            .reset_index().sort_values("tag"))
+    assert np.allclose(df3.s.to_numpy(), want.v.to_numpy())
+
+
+def test_build_cache_respects_probe_dictionary():
+    """Two tables joining the same build over DIFFERENT probe
+    dictionaries must not share the remapped entry."""
+    from ydb_tpu.query import QueryEngine
+
+    eng = QueryEngine(block_rows=1 << 12)
+    for t in ("p1", "p2"):
+        eng.execute(f"create table {t} (k Int64 not null, s Utf8, "
+                    f"primary key (k))")
+    eng.execute("create table dim (s Utf8 not null, w Int64, "
+                "primary key (s))")
+    eng.execute("insert into dim (s, w) values ('a', 1), ('b', 2)")
+    eng.execute("insert into p1 (k, s) values (1, 'a'), (2, 'b')")
+    # p2's dictionary encodes in a different order
+    eng.execute("insert into p2 (k, s) values (1, 'b'), (2, 'a')")
+    q = "select sum(w) as t from {p} join dim on {p}.s = dim.s where k = 1"
+    assert eng.query(q.format(p="p1")).t[0] == 1
+    assert eng.query(q.format(p="p2")).t[0] == 2

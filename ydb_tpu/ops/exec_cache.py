@@ -1,0 +1,150 @@
+"""Bounded LRU for compiled-executable references.
+
+Accumulating live XLA executables in one process eventually wedges or
+segfaults this platform's compile service (and grows the XLA CPU
+client's executable table without bound in tests) — round 4 routed
+around it by manually clearing every cache between queries. The real
+fix is a lifecycle: every compiled-program cache in the engine
+(whole-query fused programs, finalize programs, distributed agg/shuffle
+programs, the per-stage ProgramCache) shares ONE live-executable budget,
+LRU-evicted, so a long-lived server holds a bounded working set no
+matter how many distinct query shapes pass through. The analog of the
+reference's computation pattern cache with its size limit
+(`mkql_computation_pattern_cache.h:56` — MaxPatternsSize/MaxCompiledSize).
+
+Eviction drops the last engine-side reference to a jitted callable; its
+underlying executables are freed when Python GC runs. A shared global
+budget (`GLOBAL_BUDGET`) spans every cache created in the process.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+__all__ = ["ExecCache", "GLOBAL_BUDGET", "live_executables"]
+
+
+class _Budget:
+    """Process-wide live-executable budget shared by all ExecCaches."""
+
+    def __init__(self, max_entries: int):
+        self.max_entries = max_entries
+        self._mu = threading.RLock()
+        self._caches: list = []
+
+    def register(self, cache: "ExecCache") -> None:
+        with self._mu:
+            self._caches.append(cache)
+
+    def total(self) -> int:
+        with self._mu:
+            return sum(len(c) for c in self._caches)
+
+    def evict_to_fit(self, incoming: int = 1) -> None:
+        """Evict globally-LRU entries until `incoming` new ones fit."""
+        with self._mu:
+            while self.total() + incoming > self.max_entries:
+                victim = None
+                oldest = None
+                for c in self._caches:
+                    t = c._oldest_tick()
+                    if t is not None and (oldest is None or t < oldest):
+                        oldest, victim = t, c
+                if victim is None:
+                    return
+                victim._evict_one()
+
+
+GLOBAL_BUDGET = _Budget(int(os.environ.get(
+    "YDB_TPU_EXEC_CACHE_ENTRIES", 160)))
+
+_tick_mu = threading.Lock()
+_tick = [0]
+
+
+def _next_tick() -> int:
+    with _tick_mu:
+        _tick[0] += 1
+        return _tick[0]
+
+
+def live_executables() -> int:
+    return GLOBAL_BUDGET.total()
+
+
+class ExecCache:
+    """One named compiled-program cache drawing on the global budget.
+
+    dict-like for the common get/put shape; every entry counts as one
+    live executable against GLOBAL_BUDGET regardless of which cache
+    holds it, and recency is global (a hot fused program keeps its slot
+    while a cold distributed shape from another cache is evicted)."""
+
+    def __init__(self, name: str, budget: _Budget = None):
+        self.name = name
+        self._budget = budget or GLOBAL_BUDGET
+        self._entries: OrderedDict = OrderedDict()   # key -> (value, tick)
+        self._mu = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self._budget.register(self)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return len(self._entries)
+
+    def get(self, key, default=None):
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                return default
+            self._entries[key] = (ent[0], _next_tick())
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return ent[0]
+
+    def __contains__(self, key) -> bool:
+        with self._mu:
+            return key in self._entries
+
+    def __getitem__(self, key):
+        v = self.get(key, _MISSING)
+        if v is _MISSING:
+            raise KeyError(key)
+        return v
+
+    def __setitem__(self, key, value) -> None:
+        self._budget.evict_to_fit(1)
+        with self._mu:
+            self._entries[key] = (value, _next_tick())
+            self._entries.move_to_end(key)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._entries.clear()
+
+    # -- budget hooks ------------------------------------------------------
+
+    def _oldest_tick(self):
+        with self._mu:
+            if not self._entries:
+                return None
+            first = next(iter(self._entries.values()))
+            return first[1]
+
+    def _evict_one(self) -> None:
+        with self._mu:
+            if self._entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+
+
+class _Missing:
+    pass
+
+
+_MISSING = _Missing()

@@ -504,10 +504,13 @@ def compress(env, length, sel, cap):
 
 
 class ProgramCache:
-    """(program fp, signature, capacity) -> jitted fn. Pattern-cache analog."""
+    """(program fp, signature, capacity) -> jitted fn. Pattern-cache
+    analog; entries draw on the process-wide live-executable budget
+    (`ops/exec_cache.py`)."""
 
     def __init__(self):
-        self._cache: dict = {}
+        from ydb_tpu.ops.exec_cache import ExecCache
+        self._cache = ExecCache("program")
         self.hits = 0
         self.misses = 0
 

@@ -803,11 +803,16 @@ class QueryEngine:
         except ValueError as e:
             raise QueryError(str(e)) from e
         inner_block = self._run_select(inner, snap)
-        self._host_lane_guard(inner_block.length, "window")
-        try:
-            df = W.compute_windows(inner_block.to_pandas(), outer)
-        except ValueError as e:
-            raise QueryError(str(e)) from e
+        df = None
+        if self.config.flag("enable_device_windows") \
+                and inner_block.length >= self.config.window_device_min_rows:
+            df = self._windows_on_device(inner_block, outer)
+        if df is None:
+            self._host_lane_guard(inner_block.length, "window")
+            try:
+                df = W.compute_windows(inner_block.to_pandas(), outer)
+            except ValueError as e:
+                raise QueryError(str(e)) from e
         if post is not None:
             # window results used INSIDE expressions: evaluate the
             # rewritten items as a second pass over the computed frame.
@@ -844,6 +849,41 @@ class QueryEngine:
         except ValueError as e:
             raise QueryError(str(e)) from e
         return HostBlock.from_pandas(df)
+
+    def _windows_on_device(self, inner_block: HostBlock, outer):
+        """Device window lane (`ops/window_dev.py`): every spec computed
+        in one scatter-free jitted program — sort, segment boundaries,
+        prefix-scan formulas — with a single device→host transfer for
+        all outputs. Returns the assembled frame, or None when a spec
+        requires the pandas lane (which then counts its host rows)."""
+        from ydb_tpu.ops.window_dev import compute_windows_device
+        from ydb_tpu.utils.metrics import GLOBAL
+        try:
+            dev = compute_windows_device(inner_block, outer)
+        except Exception:                # noqa: BLE001 — lane, not law
+            GLOBAL.inc("engine/window_device_errors")
+            return None
+        if dev is None:
+            return None
+        GLOBAL.inc("engine/window_device_rows", inner_block.length)
+        import pandas as pd
+        base = inner_block.to_pandas()
+        cols = {}
+        for kind, payload in outer:
+            if kind == "col":
+                cols[payload] = base[payload]
+            else:
+                alias = payload["alias"]
+                vals, valid, dic = dev[alias]
+                if dic is not None:
+                    decoded = dic.decode(vals)
+                    s = pd.Series(decoded, dtype=object)
+                else:
+                    s = pd.Series(vals)
+                if valid is not None and not valid.all():
+                    s = s.where(pd.Series(valid))
+                cols[alias] = s
+        return pd.DataFrame(cols)
 
     def explain(self, sql: str) -> str:
         stmt = parse(sql)

@@ -39,18 +39,24 @@ class Executor:
     def __init__(self, catalog, block_rows: int = DEFAULT_BLOCK_ROWS,
                  device_cache=None, mesh=None):
         from ydb_tpu.storage.device_cache import DeviceColumnCache
+        from ydb_tpu.ops.exec_cache import ExecCache
         self.catalog = catalog
         self.block_rows = block_rows
         self.device_cache = device_cache or DeviceColumnCache()
-        self._finalize_cache: dict = {}
-        self._fused_cache: dict = {}
+        # compiled-program caches share one process-wide live-executable
+        # budget with LRU eviction (ops/exec_cache.py) — unbounded dicts
+        # here accumulated executables until the platform compile service
+        # wedged (r4 cleared them manually between queries)
+        self._finalize_cache = ExecCache("finalize")
+        self._fused_cache = ExecCache("fused")
         # device mesh for distributed execution (None / size-1 mesh →
         # single-device). The analog of the KQP task graph + DQ hash-shuffle
         # channels (`dq_tasks_graph.h:43`): scans are row-partitioned across
         # mesh devices, the partial→final aggregation boundary is an ICI
         # all_to_all hash shuffle.
         self.mesh = mesh
-        self._dist_aggs: dict = {}
+        self._dist_aggs = ExecCache("dist-agg")
+        self._shuffle_joins = ExecCache("shuffle-join")
         # feature flag (utils/config.py): the whole-query single-dispatch
         # path; off = always the portioned streaming path (debug lever)
         self.enable_fused = True
@@ -86,6 +92,14 @@ class Executor:
         # observed to SIGSEGV the platform's TPU compiler service
         self.fuse_max_joins = int(
             _os.environ.get("YDB_TPU_FUSE_MAX_JOINS", 6))
+        # cross-query join-build cache (query/build_cache.py): finished
+        # device-resident BuildTables keyed by build-plan fingerprint +
+        # visible data + probe dictionary — the r4 profile's dominant
+        # slow-query cost was per-query build re-execution + LUT re-upload
+        from ydb_tpu.query.build_cache import BuildCache
+        self.build_cache = BuildCache(int(
+            _os.environ.get("YDB_TPU_BUILD_CACHE_BUDGET", 2 << 30)),
+            device_cache=self.device_cache)
 
     @property
     def last_path(self) -> str:
@@ -828,10 +842,7 @@ class Executor:
                    ndev,
                    tuple(p.fingerprint() for p in rest),
                    pipe.partial.fingerprint() if pipe.partial else "")
-            sj = self._shuffle_joins.get(key) if hasattr(
-                self, "_shuffle_joins") else None
-            if not hasattr(self, "_shuffle_joins"):
-                self._shuffle_joins = {}
+            sj = self._shuffle_joins.get(key)
             if sj is None:
                 sj = SJ.ShuffleJoin(self.mesh, in_schema, step.probe_key,
                                     step.kind, payload_cols,
@@ -998,6 +1009,29 @@ class Executor:
                       snapshot: Snapshot, probe_dict=None,
                       prebuilt_block: Optional[HostBlock] = None
                       ) -> J.BuildTable:
+        from ydb_tpu.query.build_cache import build_plan_fingerprint
+        cache_key = None
+        if prebuilt_block is None:
+            single_dev = self.mesh is None or self.mesh.devices.size <= 1
+            # knobs that steer the PartitionedBuild-vs-BuildTable choice
+            # are part of the key (tests flip grace_budget_bytes)
+            cache_key = build_plan_fingerprint(
+                step, params, snapshot, self.catalog,
+                extra=(single_dev, self.grace_budget_bytes))
+            if cache_key is not None:
+                hit = self.build_cache.lookup(cache_key, probe_dict)
+                if hit is not None:
+                    return hit
+        bt = self._prepare_join_uncached(step, params, snapshot,
+                                         probe_dict, prebuilt_block)
+        if cache_key is not None:
+            self.build_cache.insert(cache_key, bt, probe_dict)
+        return bt
+
+    def _prepare_join_uncached(self, step: JoinStep, params: dict,
+                               snapshot: Snapshot, probe_dict=None,
+                               prebuilt_block: Optional[HostBlock] = None
+                               ) -> J.BuildTable:
         if prebuilt_block is not None:
             built = prebuilt_block
         elif isinstance(step.build, QueryPlan):

@@ -22,7 +22,7 @@ import pandas as pd
 from ydb_tpu.sql import ast
 
 WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "sum", "min", "max",
-                "count", "avg"}
+                "count", "avg", "lead", "lag"}
 
 
 def _contains_window(e) -> bool:
@@ -166,6 +166,26 @@ def split_windowed(sel: ast.Select):
     return inner, outer, (post_items if any_nested else None)
 
 
+def _constant_arg(s: pd.DataFrame, args: list, idx: int, fn: str,
+                  what: str, default):
+    """lead/lag offset/default arguments must be CONSTANT over the frame
+    (SQL requires literal offsets); a per-row value would silently apply
+    only its first row's value, so refuse instead."""
+    if len(args) <= idx:
+        return default
+    col = s[args[idx]]
+    if not len(col):
+        return default
+    first = col.iloc[0]
+    if pd.isna(first):
+        if col.isna().all():
+            return None if what == "default" else default
+        raise ValueError(f"{fn} {what} must be a constant")
+    if col.nunique(dropna=False) > 1:
+        raise ValueError(f"{fn} {what} must be a constant")
+    return int(first) if what == "offset" else first
+
+
 def _frame_agg_group(g: pd.Series, fn: str, frame: tuple) -> pd.Series:
     """One partition's ROWS-BETWEEN aggregate, vectorized: sums/counts/
     averages via prefix sums over the [i+lo, i+hi] row window; min/max
@@ -245,6 +265,23 @@ def compute_windows(df: pd.DataFrame, outer: list) -> pd.DataFrame:
         fn = spec["func"]
         if fn == "row_number":
             vals = grp.cumcount() + 1
+        elif fn in ("lead", "lag"):
+            col = s[spec["args"][0]]
+            off = _constant_arg(s, spec["args"], 1, fn, "offset", 1)
+            keys = [s[c] for c in part]
+            grp2 = col.groupby(keys, sort=False, dropna=False)
+            vals = grp2.shift(off if fn == "lag" else -off)
+            if len(spec["args"]) > 2:
+                # 3-arg form: rows whose frame position falls outside
+                # the partition get the DEFAULT, not NULL — and a NULL
+                # value inside the partition stays NULL
+                default = _constant_arg(s, spec["args"], 2, fn,
+                                        "default", None)
+                pos = s.groupby(part, sort=False, dropna=False).cumcount()
+                size = pos.groupby([s[c] for c in part], sort=False,
+                                   dropna=False).transform("size")
+                oob = (pos < off) if fn == "lag" else (pos >= size - off)
+                vals = vals.mask(oob, default)
         elif fn in ("rank", "dense_rank"):
             rn = grp.cumcount() + 1
             if spec["order"]:
@@ -280,6 +317,15 @@ def compute_windows(df: pd.DataFrame, outer: list) -> pd.DataFrame:
                         else grp["__row"].transform("size"))
             else:
                 col = s[arg]
+                if col.dtype == object:
+                    # NULL-bearing numerics round-trip to_pandas as
+                    # object; grouped cumsum/cummin refuse object dtype.
+                    # String-valued args (min/max/count over Utf8) must
+                    # stay object — coerce only when everything parses.
+                    try:
+                        col = pd.to_numeric(col)
+                    except (ValueError, TypeError):
+                        pass
                 keys = [s[c] for c in part]
                 g = col.groupby(keys, sort=False, dropna=False)
                 if running:       # SQL default frame with ORDER BY

@@ -332,6 +332,10 @@ class _Handler(socketserver.BaseRequestHandler):
                 or self._aborted:
             return _msg(b"n", b"")
         try:
+            # remember the commit epoch: a write landing between Describe
+            # and Execute (same batch) invalidates this pre-computed
+            # result — Execute re-runs instead of replaying stale rows
+            portal["epoch"] = srv.engine.coordinator.last_plan_step
             block = srv.engine.execute(portal["sql"], session=session)
             kind2 = srv.engine.last_stats.kind
             if kind2 not in self._READ_KINDS:
@@ -368,13 +372,22 @@ class _Handler(socketserver.BaseRequestHandler):
             portal.pop("done_tag", None)
         done = portal.pop("done_tag", None)
         if done is not None:
+            portal["consumed"] = True
             return _msg(b"C", _cstr(done))
         block = portal.pop("result", None)
+        if block is not None \
+                and portal.get("epoch") != srv.engine.coordinator.last_plan_step:
+            block = None                 # a write landed since Describe
         if block is not None:
             # described portal: the result was produced at Describe time;
             # Execute emits DataRows + CommandComplete only (spec shape)
+            portal["consumed"] = True
             return self._data_rows(block) \
                 + _msg(b"C", _cstr(f"SELECT {block.length}"))
+        if portal.get("consumed"):
+            # re-Execute of a completed portal: the stream is exhausted
+            # (spec: portals run once) — no re-execution, no second 'T'
+            return _msg(b"C", _cstr("SELECT 0"))
         # reuse the simple-query runner minus its trailing ReadyForQuery
         # (extended flow defers that to Sync)
         out = self._run(srv, session, portal["sql"])

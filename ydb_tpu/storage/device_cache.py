@@ -74,6 +74,11 @@ class DeviceColumnCache:
         self.budget = budget_bytes
         self._entries: OrderedDict = OrderedDict()  # (pid, col) -> (data, valid, nbytes)
         self.bytes = 0
+        # device bytes held by OTHER long-lived caches sharing this HBM
+        # budget (the cross-query BuildCache registers here): column
+        # eviction makes room for them so the two pools never sum past
+        # the device budget
+        self.foreign_bytes = 0
         self.hits = 0
         self.misses = 0
         # concurrent readers share the cache; the lock covers the
@@ -81,16 +86,29 @@ class DeviceColumnCache:
         self._mu = threading.RLock()
 
     def _evict(self):
-        while self.bytes > self.budget and self._entries:
+        while self.bytes + self.foreign_bytes > self.budget \
+                and self._entries:
             _key, (_d, _v, nbytes) = self._entries.popitem(last=False)
             self.bytes -= nbytes
+
+    def acquire_foreign(self, nbytes: int) -> None:
+        """Register device bytes owned by another long-lived cache
+        against this budget, evicting columns to make room."""
+        with self._mu:
+            self.foreign_bytes += nbytes
+            self._evict()
+
+    def release_foreign(self, nbytes: int) -> None:
+        with self._mu:
+            self.foreign_bytes = max(0, self.foreign_bytes - nbytes)
 
     def reserve(self, nbytes: int) -> None:
         """Evict LRU entries until `nbytes` of HBM fits beside the cached
         set — for paths that allocate device memory the cache doesn't
         track (tiled scan stacks, spill partials)."""
         with self._mu:
-            while self.bytes + nbytes > self.budget and self._entries:
+            while self.bytes + self.foreign_bytes + nbytes > self.budget \
+                    and self._entries:
                 _key, (_d, _v, nb) = self._entries.popitem(last=False)
                 self.bytes -= nb
 

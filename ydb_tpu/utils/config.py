@@ -33,6 +33,7 @@ DEFAULT_FLAGS = {
     "enable_fused": True,           # whole-query single-dispatch path
     "enable_plan_cache": True,
     "enable_auto_compaction": True,  # background portion merging
+    "enable_device_windows": True,   # window functions on device
 }
 
 
@@ -46,6 +47,10 @@ class Config:
     # above this many rows — a silent single-core pandas job over a huge
     # frame is a perf trap; raise the limit explicitly to accept it
     host_lane_max_rows: int = 8 << 20
+    # frames at or above this many rows take the device window lane
+    # (ops/window_dev.py); below it the fixed dispatch+readout cost
+    # outweighs the pandas pass. 0 = always device when supported.
+    window_device_min_rows: int = 1 << 16
     # auto-split threshold for column shards (rows); 0 = disabled
     shard_split_rows: int = 0
     feature_flags: dict = field(default_factory=lambda: dict(DEFAULT_FLAGS))
@@ -77,7 +82,8 @@ class Config:
         if unknown:
             raise ValueError(f"unknown feature flags: {sorted(unknown)}")
         known = {"block_rows", "grace_budget_bytes", "data_dir",
-                 "server_port", "host_lane_max_rows", "shard_split_rows"}
+                 "server_port", "host_lane_max_rows", "shard_split_rows",
+                 "window_device_min_rows"}
         bad = set(merged) - known
         if bad:
             raise ValueError(f"unknown config keys: {sorted(bad)}")

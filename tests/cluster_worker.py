@@ -34,12 +34,16 @@ def main() -> None:
 
     eng = QueryEngine(block_rows=1 << 12)
     data = TpchData(sf)
+    # lineitem AND orders are sharded — by their OWN row index, so a
+    # lineitem row's order usually lives on the OTHER worker: joining
+    # them requires the worker<->worker shuffle, not co-location
+    sharded = ("lineitem", "orders")
     for tname, (schema, keys) in TPCH_SCHEMAS.items():
         table = eng.catalog.create_table(tname, schema, keys, shards=1,
                                          portion_rows=1 << 12)
         arrays = data.tables[tname]
         n = len(arrays[schema.names[0]])
-        idx = np.arange(n) if tname != "lineitem" \
+        idx = np.arange(n) if tname not in sharded \
             else np.nonzero(np.arange(n) % nw == wid)[0]
         enc = {}
         for c in schema:

@@ -55,6 +55,13 @@ def cluster(tmp_path_factory):
             p.terminate()
         raise
     c = ShardedCluster([f"127.0.0.1:{port}" for port in ports])
+    # topology metadata the DDL path would have recorded: lineitem and
+    # orders are SHARDED (cluster_worker splits them by row index — NOT
+    # co-partitioned), the dimension tables are replicated
+    c.key_columns["lineitem"] = ["l_orderkey", "l_linenumber"]
+    c.key_columns["orders"] = ["o_orderkey"]
+    c.replicated = {"customer", "nation", "region", "part", "partsupp",
+                    "supplier"}
     from ydb_tpu.bench.tpch_gen import TpchData
     c.tpch_data = TpchData(SF)          # same seed → the oracle dataset
     yield c
@@ -79,11 +86,45 @@ def test_global_agg_across_processes(cluster):
 
 
 def test_join_agg_across_processes(cluster):
-    # lineitem sharded, orders/customer replicated → co-located join
+    # lineitem AND orders sharded (by row index — NOT co-partitioned):
+    # q3 joins them through the worker<->worker hash shuffle, with
+    # customer replicated joining worker-locally afterwards
     got = cluster.query(QUERIES["q3"])
     want = oracle("q3", cluster.tpch_data)
     want.columns = list(got.columns)
     assert_frames_match(got, want, ordered=True)
+
+
+def test_shuffle_join_sharded_x_sharded(cluster):
+    """VERDICT r4 #3 Done criterion: a 2-process join of two sharded
+    tables where NEITHER worker holds the other's shard — rows meet
+    through the exchange channels, oracle-checked."""
+    import pandas as pd
+    # neither worker holds all of orders or all of lineitem
+    for t, n_total in (("orders",
+                        len(cluster.tpch_data.tables["orders"]["o_orderkey"])),
+                       ("lineitem",
+                        len(cluster.tpch_data.tables["lineitem"]["l_orderkey"]))):
+        per = [int(w.execute(f"select count(*) as c from {t}")["rows"][0][0])
+               for w in cluster.workers]
+        assert sum(per) == n_total
+        assert all(0 < p < n_total for p in per), (t, per)
+    got = cluster.query(
+        "select o_orderpriority, count(*) as n, sum(l_extendedprice) as s "
+        "from lineitem, orders where l_orderkey = o_orderkey "
+        "and l_discount > 0.02 group by o_orderpriority "
+        "order by o_orderpriority")
+    li = pd.DataFrame(cluster.tpch_data.tables["lineitem"])
+    od = pd.DataFrame(cluster.tpch_data.tables["orders"])
+    j = li[li.l_discount > 0.02].merge(od, left_on="l_orderkey",
+                                       right_on="o_orderkey")
+    w = j.groupby("o_orderpriority").agg(
+        n=("o_orderpriority", "size"),
+        s=("l_extendedprice", "sum")).reset_index() \
+        .sort_values("o_orderpriority")
+    assert list(got.o_orderpriority) == list(w.o_orderpriority)
+    assert list(got.n) == list(w.n)
+    np.testing.assert_allclose(got.s, w.s, rtol=1e-9)
 
 
 def test_scan_across_processes(cluster):

@@ -1,0 +1,120 @@
+"""Worker↔worker data channels — the DQ output-channel analog.
+
+The r4 cluster seam scattered SQL TEXT star-wise and merged in the
+router; workers never exchanged data, so a join between two sharded
+tables was impossible without replicating one side. This module is the
+data plane the reference's DQ channels provide
+(`ydb/library/yql/dq/runtime/dq_output_channel.cpp:31`, task graph
+`dq_tasks_graph.h:43-165`): a *channel* is a named set of hash
+partitions in flight between workers; a *frame* is one partition's rows
+as an npz payload behind a JSON header, shipped over the workers' gRPC
+front (DCN seam). Hash routing uses the shared splitmix64/crc32
+definitions, so every producer routes a key to the same consumer
+(`utils/hashing.py` — host and device agree bit-for-bit).
+
+Frame wire format: 4-byte big-endian header length | header JSON
+{channel, part, src, n_rows} | npz bytes (one array per column; object
+columns allow-pickle within the trusted cluster, the Interconnect trust
+model).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+import zlib
+
+import numpy as np
+import pandas as pd
+
+from ydb_tpu.utils.hashing import splitmix64
+
+
+def hash_partition(df: pd.DataFrame, key: str, n_parts: int) -> list:
+    """Split rows by key hash into n_parts frames (NULL keys drop — an
+    inner-join shuffle never matches them)."""
+    col = df[key]
+    notna = col.notna()
+    if not notna.all():
+        df = df[notna]
+        col = df[key]
+    vals = col.to_numpy()
+    if vals.dtype == object or vals.dtype.kind in ("U", "S", "T"):
+        h = np.fromiter((zlib.crc32(str(v).encode()) for v in vals),
+                        np.uint64, count=len(vals))
+    elif vals.dtype.kind == "f":
+        raise ValueError("float join keys are not hash-partitionable "
+                         "(equality on floats is ill-defined across the "
+                         "wire)")
+    else:
+        h = splitmix64(np, vals.astype(np.int64))
+    part = (h % np.uint64(n_parts)).astype(np.int64)
+    return [df[part == p] for p in range(n_parts)]
+
+
+def pack_frame(header: dict, df: pd.DataFrame) -> bytes:
+    buf = io.BytesIO()
+    arrays = {}
+    for c in df.columns:
+        a = df[c].to_numpy()
+        if a.dtype.kind in ("U", "S", "T"):
+            a = a.astype(object)
+        arrays[c] = a
+    np.savez(buf, **arrays)
+    header = dict(header, columns=list(df.columns), n_rows=len(df))
+    hj = json.dumps(header).encode()
+    return struct.pack("!I", len(hj)) + hj + buf.getvalue()
+
+
+def unpack_header(data: bytes) -> dict:
+    """Parse ONLY the JSON header — safe on untrusted bytes. Callers
+    must authenticate against it BEFORE touching the npz payload
+    (np.load with allow_pickle executes pickle payloads)."""
+    (hlen,) = struct.unpack_from("!I", data, 0)
+    return json.loads(data[4:4 + hlen].decode())
+
+
+def unpack_frame(data: bytes):
+    (hlen,) = struct.unpack_from("!I", data, 0)
+    header = json.loads(data[4:4 + hlen].decode())
+    z = np.load(io.BytesIO(data[4 + hlen:]), allow_pickle=True)
+    cols = {c: z[c] for c in header["columns"]}
+    df = pd.DataFrame(cols, columns=header["columns"])
+    return header, df
+
+
+class ExchangeBuffer:
+    """Per-worker in-memory landing zone for incoming channel frames
+    (the input-channel buffer of a DQ compute actor)."""
+
+    def __init__(self, budget_bytes: int = 1 << 30):
+        import threading
+        self._frames: dict = {}           # channel -> [(DataFrame, bytes)]
+        self.bytes = 0
+        self.budget = budget_bytes
+        self._mu = threading.Lock()
+
+    def put(self, channel: str, df: pd.DataFrame, nbytes: int) -> None:
+        with self._mu:
+            if self.bytes + nbytes > self.budget:
+                raise MemoryError(
+                    f"exchange buffer over budget "
+                    f"({self.bytes + nbytes} > {self.budget})")
+            self._frames.setdefault(channel, []).append((df, nbytes))
+            self.bytes += nbytes
+
+    def take(self, channel: str) -> pd.DataFrame:
+        """Drain and concatenate every frame of a channel."""
+        with self._mu:
+            frames = self._frames.pop(channel, [])
+            self.bytes -= sum(nb for (_f, nb) in frames)
+        if not frames:
+            return pd.DataFrame()
+        return pd.concat([f for (f, _nb) in frames], ignore_index=True)
+
+    def drop(self, channel: str) -> None:
+        with self._mu:
+            frames = self._frames.pop(channel, None)
+            if frames:
+                self.bytes -= sum(nb for (_f, nb) in frames)

@@ -150,6 +150,121 @@ def _table_names(rel) -> list:
     return []
 
 
+# -- shuffle-join plan helpers ---------------------------------------------
+
+
+def _has_outer_join(rel) -> bool:
+    if isinstance(rel, ast.Join):
+        return (rel.kind not in ("inner", "cross")
+                or _has_outer_join(rel.left) or _has_outer_join(rel.right))
+    return False
+
+
+def _relation_binds(rel) -> dict:
+    """FROM bindings: {bind name (alias or table): table name}."""
+    out: dict = {}
+    if isinstance(rel, ast.TableRef):
+        out[rel.alias or rel.name] = rel.name
+    elif isinstance(rel, ast.Join):
+        out.update(_relation_binds(rel.left))
+        out.update(_relation_binds(rel.right))
+    return out
+
+
+def _collect_names(node, out=None) -> list:
+    if out is None:
+        out = []
+    if isinstance(node, ast.Name):
+        out.append(node.parts)
+        return out
+    for f in getattr(node, "__dataclass_fields__", ()):
+        v = getattr(node, f)
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vs:
+            if isinstance(x, tuple):
+                for y in x:
+                    if hasattr(y, "__dataclass_fields__"):
+                        _collect_names(y, out)
+            elif hasattr(x, "__dataclass_fields__"):
+                _collect_names(x, out)
+    return out
+
+
+def _attribute(parts: tuple, binds: dict, table_cols: dict):
+    """Which TABLE a column reference binds to (None = unresolvable)."""
+    if len(parts) == 2:
+        t = binds.get(parts[0])
+        return t
+    hits = [t for t in set(binds.values())
+            if parts[-1] in table_cols.get(t, ())]
+    if len(hits) == 1:
+        return hits[0]
+    if len(hits) > 1:
+        raise ClusterError(f"ambiguous column {parts[-1]!r} across "
+                           f"{sorted(hits)} — qualify it")
+    return None
+
+
+def _conjuncts(e) -> list:
+    if e is None:
+        return []
+    if isinstance(e, ast.BinOp) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _join_ons(rel) -> list:
+    if isinstance(rel, ast.Join):
+        return (_conjuncts(rel.on) + _join_ons(rel.left)
+                + _join_ons(rel.right))
+    return []
+
+
+def _expr_tables(e, binds: dict, table_cols: dict) -> set:
+    out = set()
+    for parts in _collect_names(e):
+        t = _attribute(parts, binds, table_cols)
+        if t is not None:
+            out.add(t)
+    return out
+
+
+def _only_tables(e, allowed: set, binds: dict, table_cols: dict) -> bool:
+    ts = _expr_tables(e, binds, table_cols)
+    return bool(ts) and ts <= allowed
+
+
+def _cross_equality(e, a: str, b: str, binds: dict, table_cols: dict):
+    """`A.x = B.y` (either orientation) → (x, y); else None."""
+    if not (isinstance(e, ast.BinOp) and e.op == "="
+            and isinstance(e.left, ast.Name)
+            and isinstance(e.right, ast.Name)):
+        return None
+    lt = _attribute(e.left.parts, binds, table_cols)
+    rt = _attribute(e.right.parts, binds, table_cols)
+    if lt == a and rt == b:
+        return (e.left.parts[-1], e.right.parts[-1])
+    if lt == b and rt == a:
+        return (e.right.parts[-1], e.left.parts[-1])
+    return None
+
+
+def _rewrite_relation(rel, temp_of: dict):
+    """Swap sharded TableRefs for their shuffle-temp names, keeping the
+    original bind name as the alias so every column reference resolves
+    unchanged."""
+    if isinstance(rel, ast.TableRef):
+        if rel.name in temp_of:
+            return ast.TableRef(temp_of[rel.name],
+                                rel.alias or rel.name)
+        return rel
+    if isinstance(rel, ast.Join):
+        return dataclasses.replace(
+            rel, left=_rewrite_relation(rel.left, temp_of),
+            right=_rewrite_relation(rel.right, temp_of))
+    return rel
+
+
 class ShardedCluster:
     """Router over worker gRPC endpoints (one engine process per shard)."""
 
@@ -246,19 +361,143 @@ class ShardedCluster:
             raise ClusterError("CTEs/subqueries are not distributable "
                                "over shards yet (their aggregates would "
                                "compute shard-locally)")
-        # at most one sharded table per query: a join between two sharded
-        # tables on non-co-hashed keys would silently drop cross-shard
-        # matches (replicated dims join worker-locally)
+        # two sharded tables: hash-shuffle both sides worker<->worker so
+        # the join runs co-partitioned (the DQ HashShuffle connection,
+        # `dq_tasks_graph.h:43` / `dq_output_channel.cpp:31`); more than
+        # two still refuses (needs a multi-stage graph)
         sharded = [n for n in _table_names(stmt.relation)
                    if n not in self.replicated and n in self.key_columns]
-        if len(set(sharded)) > 1:
+        if len(set(sharded)) == 2:
+            return self._shuffle_join_query(stmt, sorted(set(sharded)))
+        if len(set(sharded)) > 2:
             raise ClusterError(
-                f"joining multiple sharded tables ({sorted(set(sharded))}) "
-                "is not supported — create dimensions with "
-                "replicated=True")
+                f"joining {len(set(sharded))} sharded tables "
+                f"({sorted(set(sharded))}) is not supported yet — at most "
+                "two shuffle; create dimensions with replicated=True")
         if _has_agg(stmt):
             return self._scatter_agg(stmt)
         return self._scatter_scan(stmt)
+
+    # -- sharded x sharded shuffle join ------------------------------------
+
+    def _table_columns(self, table: str) -> list:
+        """Column names of a worker table (cached; schema probe)."""
+        cache = self.__dict__.setdefault("_col_cache", {})
+        cols = cache.get(table)
+        if cols is None:
+            resp = self.workers[0].execute(f"select * from {table} limit 0")
+            cols = cache[table] = list(resp["columns"])
+        return cols
+
+    def _shuffle_join_query(self, sel: ast.Select,
+                            sharded: list) -> pd.DataFrame:
+        """Join two sharded tables with a worker<->worker hash shuffle:
+
+          stage 1  each worker projects its shard of A and B (single-
+                   table WHERE conjuncts pushed down) and ships each
+                   row to hash(join key) % n_workers over the exchange
+                   channels — after the barrier every worker holds
+                   co-partitioned rows of BOTH tables;
+          stage 2  the channels materialize as transient tables aliased
+                   to the original names, and the ORIGINAL query —
+                   relation rewritten — runs through the normal
+                   scatter/merge paths (now a worker-local join).
+
+        Neither worker ever holds the other's full shard set, let alone
+        a replicated build — the contract the reference's ShuffleJoin
+        exists for (`dq_opt_join.cpp`)."""
+        import uuid
+
+        if any(isinstance(it.expr, ast.Star) for it in sel.items):
+            raise ClusterError("SELECT * is not supported in a shuffle "
+                               "join — name the columns")
+        if _has_outer_join(sel.relation):
+            # the shuffle drops NULL join keys (inner semantics); a
+            # LEFT/FULL join would silently lose its NULL-extended rows
+            raise ClusterError("outer joins between two sharded tables "
+                               "are not supported yet (inner only)")
+        binds = _relation_binds(sel.relation)       # bind name -> table
+        # column attribution for every Name in the statement
+        table_cols = {t: self._table_columns(t) for t in
+                      {tbl for tbl in binds.values()}}
+        refs = _collect_names(sel)
+        used: dict = {t: set() for t in binds.values()}
+        for parts in refs:
+            t = _attribute(parts, binds, table_cols)
+            if t is not None:
+                used[t].add(parts[-1])
+
+        # join key: the first WHERE/ON equality linking the two sharded
+        # tables (additional equalities stay as local filters — rows
+        # co-partitioned by the first key still satisfy them locally)
+        conjs = _conjuncts(sel.where) + _join_ons(sel.relation)
+        a, b = sharded
+        key_a = key_b = None
+        for c in conjs:
+            pair = _cross_equality(c, a, b, binds, table_cols)
+            if pair is not None:
+                key_a, key_b = pair
+                break
+        if key_a is None:
+            raise ClusterError(
+                f"no equality join condition between sharded tables "
+                f"{a!r} and {b!r} — a cross join cannot shuffle")
+        used[a].add(key_a)
+        used[b].add(key_b)
+
+        # stage 1: project + push down single-table conjuncts; every
+        # worker partitions its shard of both tables over the channels
+        from concurrent.futures import ThreadPoolExecutor
+        tag = uuid.uuid4().hex[:10]
+        endpoints = [w.endpoint for w in self.workers]
+        plans = {}
+        for t, key in ((a, key_a), (b, key_b)):
+            alias = next(al for al, tbl in binds.items() if tbl == t)
+            local = [c for c in _conjuncts(sel.where)
+                     if _only_tables(c, {t}, binds, table_cols)]
+            where = None
+            for c in local:
+                where = c if where is None else ast.BinOp("and", where, c)
+            items = [ast.SelectItem(ast.Name((alias, col)), col)
+                     for col in sorted(used[t])]
+            stage = ast.Select(items=items,
+                               relation=ast.TableRef(t, alias),
+                               where=where)
+            plans[t] = (render.select(stage), key, f"__xch_{tag}_{t}")
+
+        temp_of = {t: f"__xj_{tag}_{t}" for t in sharded}
+        try:
+            for t, (sql, key, channel) in plans.items():
+                with ThreadPoolExecutor(
+                        max_workers=len(self.workers)) as pool:
+                    resps = list(pool.map(
+                        lambda w: w.shuffle_write(sql, key, channel,
+                                                  endpoints),
+                        self.workers))
+                dtypes: dict = {}
+                for r in resps:
+                    dtypes.update(r.get("dtypes") or {})
+                cols = [(c, dtypes.get(c, "float64"))
+                        for c in sorted(used[t])]
+                # barrier: every producer finished before any consumer
+                # drains its channel (the stage boundary of the graph)
+                with ThreadPoolExecutor(
+                        max_workers=len(self.workers)) as pool:
+                    list(pool.map(
+                        lambda w: w.channel_open(channel, temp_of[t],
+                                                 columns=cols),
+                        self.workers))
+            final = dataclasses.replace(
+                sel, relation=_rewrite_relation(sel.relation, temp_of))
+            return self.query(render.select(final))
+        finally:
+            for w in self.workers:
+                try:
+                    w.channel_close(tables=list(temp_of.values()),
+                                    channels=[ch for (_s, _k, ch)
+                                              in plans.values()])
+                except Exception:            # noqa: BLE001 — best effort
+                    pass
 
     def _gather(self, worker_sql: str) -> pd.DataFrame:
         """Scatter one SQL text over every worker CONCURRENTLY (they are

@@ -30,25 +30,45 @@ class _Budget:
     """Process-wide live-executable budget shared by all ExecCaches."""
 
     def __init__(self, max_entries: int):
+        import weakref
         self.max_entries = max_entries
         self._mu = threading.RLock()
+        # weak refs: an engine's caches must die with the engine — a
+        # strong registry would pin every dead executor's executables
+        # and grow the scan with each engine ever created
         self._caches: list = []
+        self._weakref = weakref.ref
 
     def register(self, cache: "ExecCache") -> None:
         with self._mu:
-            self._caches.append(cache)
+            self._caches.append(self._weakref(cache))
+
+    def _live(self) -> list:
+        alive = []
+        dead = False
+        for ref in self._caches:
+            c = ref()
+            if c is None:
+                dead = True
+            else:
+                alive.append(c)
+        if dead:
+            self._caches = [self._weakref(c) for c in alive]
+        return alive
 
     def total(self) -> int:
         with self._mu:
-            return sum(len(c) for c in self._caches)
+            return sum(len(c) for c in self._live())
 
     def evict_to_fit(self, incoming: int = 1) -> None:
         """Evict globally-LRU entries until `incoming` new ones fit."""
         with self._mu:
-            while self.total() + incoming > self.max_entries:
+            caches = self._live()
+            while sum(len(c) for c in caches) + incoming \
+                    > self.max_entries:
                 victim = None
                 oldest = None
-                for c in self._caches:
+                for c in caches:
                     t = c._oldest_tick()
                     if t is not None and (oldest is None or t < oldest):
                         oldest, victim = t, c

@@ -193,8 +193,14 @@ class BuildCache:
     def insert(self, key, bt, probe_dict) -> None:
         nb = _entry_bytes(bt)
         with self._mu:
-            if key in self._entries:
-                return
+            old = self._entries.pop(key, None)
+            if old is not None:
+                # replace, don't keep: a stale entry here was invalidated
+                # by a probe-dictionary change — keeping it would miss
+                # forever while pinning the dead build's HBM
+                self.bytes -= old[1]
+                if self.device_cache is not None:
+                    self.device_cache.release_foreign(old[1])
             if nb > self.budget:
                 return                    # never cache something unevictable
             self._entries[key] = (bt, nb, probe_dict,

@@ -377,7 +377,15 @@ class _Handler(socketserver.BaseRequestHandler):
         block = portal.pop("result", None)
         if block is not None \
                 and portal.get("epoch") != srv.engine.coordinator.last_plan_step:
-            block = None                 # a write landed since Describe
+            # a write landed since Describe: the client already holds the
+            # RowDescription, so re-run and emit DataRows only (a second
+            # 'T' inside Execute would desync v3 clients)
+            try:
+                block = srv.engine.execute(portal["sql"], session=session)
+            except Exception as e:           # noqa: BLE001 — wire boundary
+                if session.tx is not None:
+                    self._aborted = True
+                return _error(f"{type(e).__name__}: {e}")
         if block is not None:
             # described portal: the result was produced at Describe time;
             # Execute emits DataRows + CommandComplete only (spec shape)

@@ -137,6 +137,115 @@ class QueryServicer:
             return {"error": "Unauthenticated: invalid or missing token"}
         return {"counters": self.engine.counters()}
 
+    # -- worker<->worker exchange (DQ channel data plane) ------------------
+    #
+    # The router drives a two-stage shuffle: ShuffleWrite runs a local
+    # stage SQL, hash-partitions the rows and ships each partition to its
+    # peer's ExchangePut (binary frames, cluster/exchange.py); ChannelOpen
+    # materializes a drained channel as a transient table so the final
+    # stage is ordinary local SQL over co-partitioned data.
+
+    @property
+    def exchange(self):
+        from ydb_tpu.cluster.exchange import ExchangeBuffer
+        buf = getattr(self, "_exchange", None)
+        if buf is None:
+            buf = self._exchange = ExchangeBuffer()
+        return buf
+
+    def exchange_put(self, request: bytes, context):
+        import hmac
+
+        from ydb_tpu.cluster.exchange import unpack_frame, unpack_header
+        try:
+            # auth BEFORE deserialization: the npz payload allows pickle
+            # (trusted-cluster format) — only the JSON header may be
+            # parsed pre-auth
+            header = unpack_header(request)
+            if self._token and not hmac.compare_digest(
+                    str(header.get("token", "")), self._token):
+                return {"error": "Unauthenticated: invalid or missing "
+                                 "token"}
+            header, df = unpack_frame(request)
+            self.exchange.put(header["channel"], df, len(request))
+            return {"ok": True, "rows": len(df)}
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def shuffle_write(self, request, context):
+        """Run a stage SQL locally, hash-partition by `key`, ship each
+        partition to peers[part] (loopback included — one code path)."""
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ydb_tpu.cluster.exchange import hash_partition, pack_frame
+        try:
+            sql = request["sql"]
+            key = request["key"]
+            channel = request["channel"]
+            peers = request["peers"]
+            block = self.engine.execute(sql)
+            df = block.to_pandas()
+            parts = hash_partition(df, key, len(peers))
+
+            def send(p):
+                frame = pack_frame(
+                    {"channel": channel, "part": p, "token": self._token},
+                    parts[p])
+                ExchangeClient(peers[p]).put(frame)
+                return len(parts[p])
+
+            with ThreadPoolExecutor(max_workers=len(peers)) as pool:
+                sent = list(pool.map(send, range(len(peers))))
+            return {"ok": True, "rows_in": len(df),
+                    "rows_sent": sent,
+                    "dtypes": {c: str(df[c].dtype) for c in df.columns}}
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def channel_open(self, request, context):
+        """Materialize a drained channel as a transient local table."""
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        from ydb_tpu.core.block import HostBlock
+        try:
+            df = self.exchange.take(request["channel"])
+            columns = request.get("columns")
+            if df.empty and columns:
+                df = _empty_typed_frame(columns)
+            block = HostBlock.from_pandas(df)
+            name = request["table"]
+            if self.engine.catalog.has(name):
+                self.engine.catalog.drop_table(name)
+            t = self.engine.catalog.create_table(
+                name, block.schema,
+                [block.schema.names[0]], transient=True)
+            # the block's dictionaries BECOME the table's: the binder
+            # reads table-level dictionaries for group-by domains and
+            # rank LUTs — leaving the fresh empty ones in place makes
+            # every string key decode to code 0
+            t.dictionaries = {n: cd.dictionary
+                              for n, cd in block.columns.items()
+                              if cd.dictionary is not None}
+            from ydb_tpu.storage.mvcc import WriteVersion
+            t.commit(t.write(block), WriteVersion(1, 1))
+            t.indexate()
+            return {"ok": True, "rows": block.length}
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def channel_close(self, request, context):
+        try:
+            for name in request.get("tables", []):
+                if self.engine.catalog.has(name):
+                    self.engine.catalog.drop_table(name)
+            for ch in request.get("channels", []):
+                self.exchange.drop(ch)
+            return {"ok": True}
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
     def ping(self, request, context):
         return {"ok": True}
 
@@ -170,6 +279,20 @@ class QueryServicer:
         }
 
 
+def _empty_typed_frame(columns):
+    """Zero-row frame with the stage schema's dtypes — a worker whose
+    channel received no partitions still registers a typed temp table."""
+    import numpy as np
+    import pandas as pd
+    cols = {}
+    for (name, dtype) in columns:
+        if dtype in ("object", "str"):
+            cols[name] = np.empty(0, dtype=object)
+        else:
+            cols[name] = np.empty(0, dtype=np.dtype(dtype))
+    return pd.DataFrame(cols)
+
+
 def serve(engine, port: int = 2136, max_workers: int = 8,
           token: str = ""):
     """Start the gRPC server; returns (server, bound_port). `token`
@@ -193,13 +316,58 @@ def serve(engine, port: int = 2136, max_workers: int = 8,
         "Health": grpc.unary_unary_rpc_method_handler(
             servicer.health, request_deserializer=_deser,
             response_serializer=_ser),
+        # exchange data plane: binary request frames (npz), JSON replies
+        "ExchangePut": grpc.unary_unary_rpc_method_handler(
+            servicer.exchange_put, request_deserializer=lambda b: b,
+            response_serializer=_ser),
+        "ShuffleWrite": grpc.unary_unary_rpc_method_handler(
+            servicer.shuffle_write, request_deserializer=_deser,
+            response_serializer=_ser),
+        "ChannelOpen": grpc.unary_unary_rpc_method_handler(
+            servicer.channel_open, request_deserializer=_deser,
+            response_serializer=_ser),
+        "ChannelClose": grpc.unary_unary_rpc_method_handler(
+            servicer.channel_close, request_deserializer=_deser,
+            response_serializer=_ser),
     }
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_send_message_length", 256 << 20),
+                 ("grpc.max_receive_message_length", 256 << 20)])
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE, handlers),))
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
     server.start()
     return server, bound
+
+
+class ExchangeClient:
+    """Data-plane client: ships one binary channel frame to a peer."""
+
+    _channels: dict = {}
+    _mu = threading.Lock()
+
+    def __init__(self, endpoint: str):
+        import grpc
+        # channel reuse: a shuffle sends many frames to few peers — a
+        # fresh HTTP/2 connection per frame would dominate small shuffles
+        with ExchangeClient._mu:
+            ch = ExchangeClient._channels.get(endpoint)
+            if ch is None:
+                ch = grpc.insecure_channel(endpoint, options=[
+                    ("grpc.max_send_message_length", 256 << 20),
+                    ("grpc.max_receive_message_length", 256 << 20)])
+                ExchangeClient._channels[endpoint] = ch
+        self._put = ch.unary_unary(
+            f"/{SERVICE}/ExchangePut",
+            request_serializer=lambda b: b,
+            response_deserializer=_deser)
+
+    def put(self, frame: bytes) -> dict:
+        resp = self._put(frame)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
 
 
 class Client:
@@ -209,6 +377,7 @@ class Client:
                  token: str = ""):
         import grpc
 
+        self.endpoint = endpoint
         self.token = token
         self._channel = grpc.insecure_channel(endpoint)
         self._exec = self._channel.unary_unary(
@@ -222,6 +391,15 @@ class Client:
             response_deserializer=_deser)
         self._health = self._channel.unary_unary(
             f"/{SERVICE}/Health", request_serializer=_ser,
+            response_deserializer=_deser)
+        self._shuffle = self._channel.unary_unary(
+            f"/{SERVICE}/ShuffleWrite", request_serializer=_ser,
+            response_deserializer=_deser)
+        self._chopen = self._channel.unary_unary(
+            f"/{SERVICE}/ChannelOpen", request_serializer=_ser,
+            response_deserializer=_deser)
+        self._chclose = self._channel.unary_unary(
+            f"/{SERVICE}/ChannelClose", request_serializer=_ser,
             response_deserializer=_deser)
         self.session_id = session_id
 
@@ -244,6 +422,27 @@ class Client:
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["counters"]
+
+    def shuffle_write(self, sql: str, key: str, channel: str,
+                      peers: list) -> dict:
+        resp = self._shuffle({"sql": sql, "key": key, "channel": channel,
+                              "peers": peers, "token": self.token})
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def channel_open(self, channel: str, table: str,
+                     columns=None) -> dict:
+        resp = self._chopen({"channel": channel, "table": table,
+                             "columns": columns, "token": self.token})
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def channel_close(self, tables=(), channels=()) -> dict:
+        return self._chclose({"tables": list(tables),
+                              "channels": list(channels),
+                              "token": self.token})
 
     def ping(self) -> bool:
         return bool(self._ping({}).get("ok"))

@@ -1,0 +1,36 @@
+"""Durable worker process for the distributed-2PC test.
+
+Usage: python tests/dtx_worker.py DATA_DIR PORT_FILE [PORT]
+
+Boots an engine from DATA_DIR (recovering any previous state), serves
+the gRPC front on PORT (0 = ephemeral) and writes the bound port to
+PORT_FILE. YDB_TPU_TEST_FAULTS=1 in the environment arms the servicer's
+crash points (kill -9 semantics via os._exit)."""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    data_dir, port_file = sys.argv[1], sys.argv[2]
+    port = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    from ydb_tpu.query import QueryEngine
+    from ydb_tpu.server import serve
+
+    eng = QueryEngine(block_rows=1 << 12, data_dir=data_dir)
+    server, bound = serve(eng, port=port)
+    with open(port_file, "w") as f:
+        f.write(str(bound))
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Distributed two-phase commit across worker processes.
+
+The r4 cluster routed a multi-worker INSERT as independent per-worker
+statements — a crash between them left the cluster half-written. This
+module is the cross-process commit protocol the reference runs through
+its coordinator tablet + DataShard readsets
+(`ydb/core/tx/coordinator/coordinator_impl.h:209`,
+`datashard_outreadset.cpp`), collapsed to the router-as-coordinator
+shape:
+
+  PREPARE   every involved worker stages the statements in a held
+            session and appends a durable `prepared {gtx, sqls}` record
+            (logical logging — the statements re-execute on recovery);
+  DECIDE    the router appends commit/abort to ITS durable decision log
+            before telling anyone (the coordinator's plan-step log);
+  COMMIT    workers append `decision`, apply the held session's commit
+            (one local plan step), then append `done`;
+  RESOLVE   a worker that crashed between prepare and done re-executes
+            the logged statements when the router re-delivers a commit
+            decision — UPSERT-style idempotence makes the re-execution
+            safe whether or not the local commit had landed.
+
+Journals are JSON-lines with fsync per record; a torn tail (crash mid
+append) drops only the partial line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+class DtxJournal:
+    """Append-only prepared-transaction journal (worker side), and the
+    decision log (router side) — same format, different record kinds."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def append(self, rec: dict) -> None:
+        # torn-tail repair: a crash mid-append leaves a partial line with
+        # no newline — terminating it BEFORE the new record keeps it
+        # isolated (records() skips it) instead of merging it with this
+        # append into one unparsable line that would hide every later
+        # record
+        needs_nl = False
+        try:
+            with open(self.path, "rb") as rf:
+                rf.seek(-1, os.SEEK_END)
+                needs_nl = rf.read(1) != b"\n"
+        except (FileNotFoundError, OSError):
+            pass
+        with open(self.path, "ab") as f:
+            if needs_nl:
+                f.write(b"\n")
+            f.write(json.dumps(rec).encode() + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def records(self) -> list:
+        try:
+            with open(self.path) as f:
+                out = []
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue         # torn line (crash mid-append)
+                return out
+        except FileNotFoundError:
+            return []
+
+    def in_doubt(self) -> dict:
+        """{gtx: prepared record} for every prepared without done."""
+        open_tx: dict = {}
+        for rec in self.records():
+            if rec["op"] == "prepared":
+                open_tx[rec["gtx"]] = rec
+            elif rec["op"] == "done":
+                open_tx.pop(rec["gtx"], None)
+        return open_tx
+
+    def decisions(self) -> dict:
+        """Router log fold: {gtx: "commit" | "abort"}."""
+        out: dict = {}
+        for rec in self.records():
+            if rec["op"] == "decision":
+                out[rec["gtx"]] = rec["decision"]
+        return out

@@ -268,7 +268,8 @@ def _rewrite_relation(rel, temp_of: dict):
 class ShardedCluster:
     """Router over worker gRPC endpoints (one engine process per shard)."""
 
-    def __init__(self, endpoints: list, merge_engine=None):
+    def __init__(self, endpoints: list, merge_engine=None,
+                 dtx_log: Optional[str] = None):
         from ydb_tpu.query import QueryEngine
         from ydb_tpu.server import Client
         self.workers = [Client(ep) for ep in endpoints]
@@ -277,6 +278,10 @@ class ShardedCluster:
         self.engine = merge_engine or QueryEngine(block_rows=1 << 16)
         self.replicated: set = set()        # table names on every worker
         self.key_columns: dict = {}         # table -> [pk col]
+        # durable coordinator decision log for cross-worker 2PC
+        # (cluster/dtx.py). None = single-statement routing only.
+        from ydb_tpu.cluster.dtx import DtxJournal
+        self.dtx_log = DtxJournal(dtx_log) if dtx_log else None
 
     # -- DDL / DML ----------------------------------------------------------
 
@@ -304,6 +309,11 @@ class ShardedCluster:
                 "INSERT ... SELECT into a sharded table is not supported "
                 "(broadcasting would duplicate every row per worker)")
         if stmt.table in self.replicated:
+            if self.dtx_log is not None and stmt.mode == "upsert" \
+                    and len(self.workers) > 1:
+                # replicated UPSERT: all-or-nothing across every copy
+                return self._commit_2pc([(w, [sql])
+                                         for w in self.workers])
             for w in self.workers:
                 w.execute(sql)
             return {"ok": True}
@@ -335,15 +345,81 @@ class ShardedCluster:
                     f"got {type(v).__name__} ({v!r})")
             per[h % nw].append(row)
         cols = ", ".join(stmt.columns)
+        per_sql = []
         for w, rows in zip(self.workers, per):
             if not rows:
+                per_sql.append(None)
                 continue
             vals = ", ".join(
                 "(" + ", ".join(render.expr(v) for v in row) + ")"
                 for row in rows)
-            w.execute(f"{stmt.mode} into {stmt.table} ({cols}) "
-                      f"values {vals}")
+            per_sql.append(f"{stmt.mode} into {stmt.table} ({cols}) "
+                           f"values {vals}")
+        touched = [(w, s) for (w, s) in zip(self.workers, per_sql)
+                   if s is not None]
+        # 2PC applies to UPSERT only: crash recovery RE-EXECUTES the
+        # journaled statements, which is exactly-once only under upsert
+        # semantics (a replayed plain INSERT into a column table would
+        # append duplicates)
+        if len(touched) > 1 and self.dtx_log is not None \
+                and stmt.mode == "upsert":
+            return self._commit_2pc([(w, [s]) for (w, s) in touched])
+        for (w, s) in touched:
+            w.execute(s)
         return {"ok": True}
+
+    def _commit_2pc(self, work: list) -> dict:
+        """Two-phase commit of per-worker statement lists: prepare all →
+        durable decision → decide all (cluster/dtx.py; the coordinator
+        plan-step protocol, `coordinator_impl.h:209`). A worker that
+        dies after the decision is healed later by `resolve_in_doubt`
+        re-delivering the logged decision."""
+        import uuid
+        gtx = uuid.uuid4().hex
+        self.dtx_log.append({"op": "begin", "gtx": gtx,
+                             "workers": [w.endpoint for (w, _s) in work]})
+        prepared = []
+        failed = None
+        for (w, sqls) in work:
+            try:
+                w.tx_prepare(gtx, sqls)
+                prepared.append(w)
+            except Exception as e:           # noqa: BLE001
+                failed = e
+                break
+        decision = "abort" if failed is not None else "commit"
+        self.dtx_log.append({"op": "decision", "gtx": gtx,
+                             "decision": decision})
+        outcome_ok = True
+        crash_points = getattr(self, "dtx_test_crash", {})
+        for w in prepared:
+            try:
+                extra = {}
+                cp = crash_points.get(w.endpoint)
+                if cp:
+                    extra["crash_point"] = cp
+                w.tx_decide(gtx, decision, **extra)
+            except Exception:                # noqa: BLE001
+                outcome_ok = False           # healed by resolve_in_doubt
+        if failed is not None:
+            raise ClusterError(f"2PC aborted: {failed}")
+        self.dtx_log.append({"op": "done", "gtx": gtx})
+        return {"ok": True, "gtx": gtx, "healed_later": not outcome_ok}
+
+    def resolve_in_doubt(self) -> dict:
+        """Re-deliver durable decisions for transactions a worker holds
+        in doubt (post-restart recovery). Unknown gtx (prepared on the
+        worker, no decision logged — the router died first) resolve to
+        abort: presumed-abort, the coordinator never promised commit."""
+        if self.dtx_log is None:
+            return {"resolved": 0}
+        decisions = self.dtx_log.decisions()
+        n = 0
+        for w in self.workers:
+            for gtx in w.tx_in_doubt():
+                w.tx_resolve(gtx, decisions.get(gtx, "abort"))
+                n += 1
+        return {"resolved": n}
 
     # -- SELECT -------------------------------------------------------------
 

@@ -34,7 +34,8 @@ class QueryError(Exception):
 class QueryEngine:
     def __init__(self, catalog: Optional[Catalog] = None,
                  block_rows: Optional[int] = None, mesh=None,
-                 data_dir: Optional[str] = None, config=None):
+                 data_dir: Optional[str] = None, config=None,
+                 replica=None):
         """`mesh`: a jax.sharding.Mesh for distributed execution — scans are
         row-partitioned across its devices and aggregation boundaries become
         ICI hash shuffles (`ydb_tpu.parallel.make_mesh(n)` builds one).
@@ -64,9 +65,19 @@ class QueryEngine:
         restored_step = 0
         if data_dir is not None and catalog is None:
             from ydb_tpu.storage.persist import Store
-            store = Store(data_dir)
+            sink = None
+            if replica is not None:
+                # synchronous standby mirror (cluster/replica.py):
+                # every durable mutation ships before acknowledgement
+                from ydb_tpu.cluster.replica import make_sink
+                sink = make_sink(replica)
+            store = Store(data_dir, replica=sink)
             if os.path.exists(os.path.join(data_dir, "catalog.json")):
                 catalog, restored_step = store.load()
+                # pre-existing data + fresh standby: full initial sync
+                # (delta shipping alone would reference blobs the
+                # standby never saw)
+                store.sync_replica()
             else:
                 catalog = Catalog(store=store)
                 store.save_catalog(catalog)

@@ -23,6 +23,7 @@ likewise runs a session's statements sequentially.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from concurrent import futures
 
@@ -235,6 +236,122 @@ class QueryServicer:
         except Exception as e:               # noqa: BLE001 — wire boundary
             return {"error": f"{type(e).__name__}: {e}"}
 
+    # -- distributed two-phase commit (cluster/dtx.py) ---------------------
+
+    @property
+    def _dtx_journal(self):
+        from ydb_tpu.cluster.dtx import DtxJournal
+        j = getattr(self, "_dtx_j", None)
+        if j is None:
+            store = self.engine.catalog.store
+            root = store.root if store is not None else None
+            if root is None:
+                return None              # no durability: 2PC refuses
+            j = self._dtx_j = DtxJournal(os.path.join(root, "dtx.jsonl"))
+        return j
+
+    def _maybe_crash(self, request, point: str) -> None:
+        """Test-only fault injection (the nemesis hook the reference's
+        test runtime provides via event interception): honored only when
+        the worker opted in via YDB_TPU_TEST_FAULTS=1."""
+        if os.environ.get("YDB_TPU_TEST_FAULTS") == "1" \
+                and request.get("crash_point") == point:
+            os._exit(137)
+
+    def tx_prepare(self, request, context):
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        j = self._dtx_journal
+        if j is None:
+            return {"error": "2PC needs a durable worker (no data_dir)"}
+        gtx = request["gtx"]
+        sqls = request["sqls"]
+        s = None
+        try:
+            s = self.engine.session()
+            s.execute("begin")
+            for sql in sqls:
+                s.execute(sql)
+            j.append({"op": "prepared", "gtx": gtx, "sqls": sqls})
+            self._maybe_crash(request, "after_prepare")
+            with self._lock:
+                self.__dict__.setdefault("_dtx_live", {})[gtx] = s
+            return {"ok": True}
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            # roll the partial session back: a leaked open tx pins its
+            # coordinator snapshot (blocking compaction) and holds
+            # staged writes forever
+            if s is not None and s.tx is not None:
+                try:
+                    s.rollback()
+                except Exception:            # noqa: BLE001
+                    pass
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def tx_decide(self, request, context):
+        """Phase 2 on a LIVE worker: apply the decision to the held
+        session, then mark done."""
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        j = self._dtx_journal
+        gtx = request["gtx"]
+        decision = request["decision"]
+        try:
+            with self._lock:
+                s = self.__dict__.setdefault("_dtx_live", {}).pop(gtx, None)
+            self._maybe_crash(request, "before_apply")
+            if s is not None:
+                if decision == "commit":
+                    s.commit()
+                else:
+                    s.rollback()
+            elif decision == "commit":
+                # no live session (restarted since prepare): re-execute
+                # from the journal — upsert idempotence
+                return self.tx_resolve(request, context)
+            self._maybe_crash(request, "after_apply")
+            j.append({"op": "done", "gtx": gtx, "decision": decision})
+            return {"ok": True}
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def tx_resolve(self, request, context):
+        """Recovery: the router re-delivers the durable decision for an
+        in-doubt gtx. Commit re-executes the logged statements (UPSERT
+        idempotence — safe whether or not the crashed apply landed);
+        abort just closes the record (staged writes died with the
+        process)."""
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        j = self._dtx_journal
+        gtx = request["gtx"]
+        decision = request["decision"]
+        try:
+            rec = j.in_doubt().get(gtx)
+            if rec is None:
+                return {"ok": True, "state": "already-done"}
+            if decision == "commit":
+                s = self.engine.session()
+                s.execute("begin")
+                try:
+                    for sql in rec["sqls"]:
+                        s.execute(sql)
+                    s.commit()
+                except Exception:
+                    if s.tx is not None:
+                        s.rollback()
+                    raise
+            j.append({"op": "done", "gtx": gtx, "decision": decision})
+            return {"ok": True, "state": "resolved"}
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def tx_in_doubt(self, request, context):
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        j = self._dtx_journal
+        return {"gtx": sorted(j.in_doubt()) if j is not None else []}
+
     def channel_close(self, request, context):
         try:
             for name in request.get("tables", []):
@@ -328,6 +445,18 @@ def serve(engine, port: int = 2136, max_workers: int = 8,
             response_serializer=_ser),
         "ChannelClose": grpc.unary_unary_rpc_method_handler(
             servicer.channel_close, request_deserializer=_deser,
+            response_serializer=_ser),
+        "TxPrepare": grpc.unary_unary_rpc_method_handler(
+            servicer.tx_prepare, request_deserializer=_deser,
+            response_serializer=_ser),
+        "TxDecide": grpc.unary_unary_rpc_method_handler(
+            servicer.tx_decide, request_deserializer=_deser,
+            response_serializer=_ser),
+        "TxResolve": grpc.unary_unary_rpc_method_handler(
+            servicer.tx_resolve, request_deserializer=_deser,
+            response_serializer=_ser),
+        "TxInDoubt": grpc.unary_unary_rpc_method_handler(
+            servicer.tx_in_doubt, request_deserializer=_deser,
             response_serializer=_ser),
     }
     server = grpc.server(
@@ -443,6 +572,33 @@ class Client:
         return self._chclose({"tables": list(tables),
                               "channels": list(channels),
                               "token": self.token})
+
+    def _dtx_call(self, method: str, body: dict) -> dict:
+        stubs = self.__dict__.setdefault("_dtx_stubs", {})
+        call = stubs.get(method)
+        if call is None:
+            call = stubs[method] = self._channel.unary_unary(
+                f"/{SERVICE}/{method}", request_serializer=_ser,
+                response_deserializer=_deser)
+        resp = call({**body, "token": self.token})
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def tx_prepare(self, gtx: str, sqls: list, **extra) -> dict:
+        return self._dtx_call("TxPrepare",
+                              {"gtx": gtx, "sqls": sqls, **extra})
+
+    def tx_decide(self, gtx: str, decision: str, **extra) -> dict:
+        return self._dtx_call("TxDecide",
+                              {"gtx": gtx, "decision": decision, **extra})
+
+    def tx_resolve(self, gtx: str, decision: str) -> dict:
+        return self._dtx_call("TxResolve",
+                              {"gtx": gtx, "decision": decision})
+
+    def tx_in_doubt(self) -> list:
+        return self._dtx_call("TxInDoubt", {})["gtx"]
 
     def ping(self) -> bool:
         return bool(self._ping({}).get("ok"))

@@ -67,11 +67,81 @@ from ydb_tpu.storage import blobfile as B
 
 
 class Store:
-    """Filesystem persistence for a catalog of column tables."""
+    """Filesystem persistence for a catalog of column tables.
 
-    def __init__(self, root: str):
+    `replica`: optional mirror sink (`cluster/replica.py`). Every durable
+    TABLE-STORAGE mutation (catalog/state/dicts json, WAL appends and
+    rewrites, portion blobs, drops) ships SYNCHRONOUSLY after the local
+    write — an acknowledged commit exists on both sides, so a dead
+    primary loses no table data (mirror-group v1,
+    `blobstorage_grouptype.cpp` analog). Scope note: topics/changefeed
+    state and the audit log are engine-level files that do NOT route
+    through the Store yet — they are not mirrored."""
+
+    def __init__(self, root: str, replica=None):
         self.root = root
+        self.replica = replica
         os.makedirs(root, exist_ok=True)
+
+    # -- replica shipping primitives ---------------------------------------
+
+    def _ship(self, kind: str, path: str, data=None, **kw) -> None:
+        if self.replica is None:
+            return
+        op = {"op": kind, "path": os.path.relpath(path, self.root), **kw}
+        if data is not None:
+            op["data"] = data
+        self.replica.ship(op)
+
+    def _json(self, path: str, obj) -> None:
+        _atomic_json(path, obj)
+        self._ship("json", path, obj)
+
+    def _wal_app(self, path: str, rec: dict, sync: bool = True) -> None:
+        B.wal_append(path, rec, sync=sync)
+        self._ship("wal_append", path, rec, sync=sync)
+
+    def _wal_rw(self, path: str, recs: list) -> None:
+        B.wal_rewrite(path, recs)
+        self._ship("wal_rewrite", path, recs)
+
+    def _blob(self, path: str, block) -> None:
+        B.write_portion(path, block)
+        if self.replica is not None:
+            import base64
+            with open(path, "rb") as f:
+                self._ship("put_b64", path,
+                           base64.b64encode(f.read()).decode())
+
+    def _unlink(self, path: str) -> None:
+        os.unlink(path)
+        self._ship("unlink", path)
+
+    def _rmtree(self, path: str) -> None:
+        import shutil
+        shutil.rmtree(path)
+        self._ship("rmtree", path)
+
+    def sync_replica(self) -> int:
+        """Full initial sync: ship EVERY existing file to the standby —
+        required when a replica attaches to a store that already holds
+        data (delta shipping alone would send manifests referencing
+        portion blobs the standby never received). Returns files
+        shipped."""
+        if self.replica is None:
+            return 0
+        import base64
+        n = 0
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                if fn.endswith(".tmp"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                with open(path, "rb") as f:
+                    self._ship("put_b64", path,
+                               base64.b64encode(f.read()).decode())
+                n += 1
+        return n
 
     # -- paths -------------------------------------------------------------
 
@@ -103,12 +173,12 @@ class Store:
                 "ttl": list(t.ttl) if getattr(t, "ttl", None) else None,
                 "serial_next": dict(getattr(t, "serial_next", {}) or {}),
             }
-        _atomic_json(os.path.join(self.root, "catalog.json"),
-                     {"tables": metas})
+        self._json(os.path.join(self.root, "catalog.json"),
+                   {"tables": metas})
 
     def save_state(self, last_plan_step: int) -> None:
-        _atomic_json(os.path.join(self.root, "state.json"),
-                     {"last_plan_step": last_plan_step})
+        self._json(os.path.join(self.root, "state.json"),
+                   {"last_plan_step": last_plan_step})
 
     def load_state(self) -> int:
         return _read_json(os.path.join(self.root, "state.json"),
@@ -124,14 +194,13 @@ class Store:
         self.save_dictionaries(table)
 
     def drop_table(self, name: str) -> None:
-        import shutil
         if os.path.isdir(self._tdir(name)):
-            shutil.rmtree(self._tdir(name))
+            self._rmtree(self._tdir(name))
 
     def save_dictionaries(self, table) -> None:
         vals = {col: list(d.values_array())
                 for col, d in table.dictionaries.items()}
-        _atomic_json(os.path.join(self._tdir(table.name), "dicts.json"), vals)
+        self._json(os.path.join(self._tdir(table.name), "dicts.json"), vals)
 
     # -- WAL ---------------------------------------------------------------
 
@@ -146,12 +215,12 @@ class Store:
         rec = {"plan_step": version.plan_step, "tx_id": version.tx_id,
                "ops": [[kind, {c: native(v) for c, v in vals.items()}]
                        for (kind, vals) in ops]}
-        B.wal_append(os.path.join(self._tdir(table), "rowwal.bin"), rec)
+        self._wal_app(os.path.join(self._tdir(table), "rowwal.bin"), rec)
 
     def wal_write(self, table: str, shard: int, wid: int,
                   block: HostBlock, tx=None) -> None:
         sdir = self._sdir(table, shard)
-        B.write_portion(os.path.join(sdir, f"wal_{wid}.ydbp"), block)
+        self._blob(os.path.join(sdir, f"wal_{wid}.ydbp"), block)
         rec = {"op": "write", "wid": wid}
         if tx is not None:
             rec["tx"] = tx     # boot discards writes of txs that died open
@@ -202,8 +271,8 @@ class Store:
 
     def _intent_append(self, table: str, rec: dict,
                        sync: bool = True) -> None:
-        B.wal_append(os.path.join(self._tdir(table), "commits.bin"), rec,
-                     sync=sync)
+        self._wal_app(os.path.join(self._tdir(table), "commits.bin"), rec,
+                      sync=sync)
 
     @staticmethod
     def _open_intents(path: str) -> dict:
@@ -233,24 +302,24 @@ class Store:
                    for sid, wids in rec["shards"].items()
                    for wid in wids):
                 keep.append(rec)
-        B.wal_rewrite(path, keep)
+        self._wal_rw(path, keep)
 
     def wal_delete(self, table: str, shard: int, portion_id: int,
                    version: WriteVersion, rows, sync: bool = True) -> None:
         """Durable MVCC delete mark (fsynced before the statement acks,
         unless an intent record already covers the outcome)."""
-        B.wal_append(os.path.join(self._sdir(table, shard), "wal.bin"),
-                     {"op": "delete", "portion": portion_id,
-                      "plan_step": version.plan_step,
-                      "tx_id": version.tx_id,
-                      "rows": [int(r) for r in rows]}, sync=sync)
+        self._wal_app(os.path.join(self._sdir(table, shard), "wal.bin"),
+                      {"op": "delete", "portion": portion_id,
+                       "plan_step": version.plan_step,
+                       "tx_id": version.tx_id,
+                       "rows": [int(r) for r in rows]}, sync=sync)
 
     def wal_abort(self, table: str, shard: int, wids: list) -> None:
         self._wal_append(self._sdir(table, shard),
                          {"op": "abort", "wids": wids})
 
     def _wal_append(self, sdir: str, rec: dict) -> None:
-        B.wal_append(os.path.join(sdir, "wal.bin"), rec)
+        self._wal_app(os.path.join(sdir, "wal.bin"), rec)
 
     # -- portions ----------------------------------------------------------
 
@@ -263,7 +332,7 @@ class Store:
         for p in shard.portions:
             path = os.path.join(sdir, f"portion_{p.id}.ydbp")
             if not os.path.exists(path):
-                B.write_portion(path, p.block)
+                self._blob(path, p.block)
             entry = {"id": p.id, "rows": p.num_rows,
                      "plan_step": p.version.plan_step,
                      "tx_id": p.version.tx_id}
@@ -284,8 +353,8 @@ class Store:
         # anything this manifest knew about (a single high-water mark would
         # be wrong when an old uncommitted write outlives newer consumed
         # ones)
-        _atomic_json(os.path.join(sdir, "manifest.json"),
-                     {"portions": live,
+        self._json(os.path.join(sdir, "manifest.json"),
+                   {"portions": live,
                       "pending_wids": [e.write_id for e in shard.inserts],
                       "max_wid": shard._next_write_id - 1})
         # drop orphaned portion files (compaction) and consumed wal blocks
@@ -294,10 +363,10 @@ class Store:
         for fn in os.listdir(sdir):
             if fn.startswith("portion_") and fn.endswith(".ydbp") \
                     and fn not in keep:
-                os.unlink(os.path.join(sdir, fn))
+                self._unlink(os.path.join(sdir, fn))
             if fn.startswith("wal_") and fn.endswith(".ydbp") \
                     and fn not in still:
-                os.unlink(os.path.join(sdir, fn))
+                self._unlink(os.path.join(sdir, fn))
         # rewrite the WAL with only still-pending entries
         recs = []
         for e in shard.inserts:
@@ -306,15 +375,14 @@ class Store:
                 recs.append({"op": "commit", "wids": [e.write_id],
                              "plan_step": e.committed_version.plan_step,
                              "tx_id": e.committed_version.tx_id})
-        B.wal_rewrite(os.path.join(sdir, "wal.bin"), recs)
+        self._wal_rw(os.path.join(sdir, "wal.bin"), recs)
 
     def drop_shard_dir(self, table: str, shard_id: int) -> None:
         """Remove a merged-away shard's directory (portions already
         persisted under the target shard)."""
-        import shutil
         sdir = os.path.join(self._tdir(table), f"shard_{shard_id}")
         if os.path.isdir(sdir):
-            shutil.rmtree(sdir)
+            self._rmtree(sdir)
 
     def rewrite_row_wal(self, table) -> None:
         """Compact a row table's mutation log to its current committed
@@ -337,18 +405,17 @@ class Store:
                 row[c] = v
             recs.append({"plan_step": ver.plan_step, "tx_id": ver.tx_id,
                          "ops": [["replace", row]]})
-        B.wal_rewrite(os.path.join(self._tdir(table.name), "rowwal.bin"),
-                      recs)
+        self._wal_rw(os.path.join(self._tdir(table.name), "rowwal.bin"),
+                     recs)
 
     def rewrite_shard_blobs(self, table, shard) -> None:
         """Force-rewrite every blob of a shard (DROP COLUMN: stale bytes
         must not resurface if the name is re-added). Atomic per file."""
         sdir = self._sdir(table.name, shard.shard_id)
         for p in shard.portions:
-            B.write_portion(os.path.join(sdir, f"portion_{p.id}.ydbp"),
-                            p.block)
+            self._blob(os.path.join(sdir, f"portion_{p.id}.ydbp"), p.block)
         for e in shard.inserts:
-            B.write_portion(
+            self._blob(
                 os.path.join(sdir, f"wal_{e.write_id}.ydbp"), e.block)
 
     # -- recovery ----------------------------------------------------------

@@ -1349,5 +1349,16 @@ def oracle(name: str, raw: dict) -> pd.DataFrame:
         return g.sort_values(["sumsales", "cust"],
                              ascending=[False, True],
                              kind="stable").head(100)
+    from tests.tpcds_util2 import QUERIES2, oracle2
+    if name in QUERIES2:
+        return oracle2(name, f)
     raise KeyError(name)
+
+
+def _merge_round5_templates():
+    from tests.tpcds_util2 import QUERIES2
+    QUERIES.update(QUERIES2)
+
+
+_merge_round5_templates()
 

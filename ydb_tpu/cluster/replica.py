@@ -75,6 +75,9 @@ class DirSink:
     def ship(self, op: dict) -> None:
         apply_op(self.root, op)
 
+    def has_catalog(self) -> bool:
+        return os.path.exists(os.path.join(self.root, "catalog.json"))
+
 
 class GrpcSink:
     """Standby in another process, over its Replica gRPC front."""
@@ -95,6 +98,13 @@ class GrpcSink:
         resp = self._apply({**op, "token": self.token})
         if "error" in resp:
             raise RuntimeError(f"replica apply failed: {resp['error']}")
+
+    def has_catalog(self) -> bool:
+        resp = self._apply({"op": "probe", "path": "catalog.json",
+                            "token": self.token})
+        if "error" in resp:
+            raise RuntimeError(f"replica probe failed: {resp['error']}")
+        return bool(resp.get("exists"))
 
 
 class StandbyServer:
@@ -117,6 +127,12 @@ class StandbyServer:
                 if tok and not hmac.compare_digest(
                         str(request.get("token", "")), tok):
                     return {"error": "Unauthenticated"}
+                if request.get("op") == "probe":
+                    rel = request.get("path", "")
+                    if os.path.isabs(rel) or ".." in rel.split(os.sep):
+                        return {"error": "bad probe path"}
+                    return {"ok": True, "exists": os.path.exists(
+                        os.path.join(self.root, rel))}
                 apply_op(self.root, request)
                 self.applied += 1
                 return {"ok": True}

@@ -415,11 +415,17 @@ class ShardedCluster:
             return {"resolved": 0}
         decisions = self.dtx_log.decisions()
         n = 0
+        unreachable = []
         for w in self.workers:
-            for gtx in w.tx_in_doubt():
-                w.tx_resolve(gtx, decisions.get(gtx, "abort"))
-                n += 1
-        return {"resolved": n}
+            # heal the reachable subset: one down worker must not block
+            # every other worker's recovery
+            try:
+                for gtx in w.tx_in_doubt():
+                    w.tx_resolve(gtx, decisions.get(gtx, "abort"))
+                    n += 1
+            except Exception as e:           # noqa: BLE001
+                unreachable.append((w.endpoint, str(e)[:80]))
+        return {"resolved": n, "unreachable": unreachable}
 
     # -- SELECT -------------------------------------------------------------
 

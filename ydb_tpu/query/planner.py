@@ -153,7 +153,11 @@ def walk_aggs(e, out: list):
 def _hash_key_expr(cols: list) -> ir.Expr:
     """Combined 64-bit hash over key columns (both join sides use this same
     expression, mirroring `ydb/core/formats/arrow/hash/calcer.cpp`)."""
-    parts = [ir.call("hash64", ir.Col(c)) for c in cols]
+    return _hash_key_expr_of([ir.Col(c) for c in cols])
+
+
+def _hash_key_expr_of(exprs: list) -> ir.Expr:
+    parts = [ir.call("hash64", e) for e in exprs]
     if len(parts) == 1:
         return parts[0]
     return ir.call("hash_combine", *parts)
@@ -244,10 +248,10 @@ class Planner:
                         pairs.append((c.left, c.right))
                         continue
                 raise PlanError(f"unsupported LEFT JOIN condition {c!r}")
-            if len(pairs) != 1:
-                raise PlanError("LEFT JOIN needs exactly one equi-join "
-                                "condition (composite keys not yet)")
-            spec["pair"], spec["local"] = pairs[0], local
+            if not pairs:
+                raise PlanError("LEFT JOIN needs at least one equi-join "
+                                "condition")
+            spec["pairs"], spec["local"] = pairs, local
 
         # classify predicates ((a∧x)∨(a∧y) → a∧(x∨y) first: surfaces
         # join conditions buried in OR branches, e.g. TPC-H Q19)
@@ -386,7 +390,8 @@ class Planner:
         for p in residuals:
             self._demand(p, needed)
         for spec in self._left_specs:
-            self._demand(spec["pair"][0], needed)
+            for (p_ast, _b) in spec["pairs"]:
+                self._demand(p_ast, needed)
         for p in self._left_post_preds:
             self._demand(p, needed)
 
@@ -681,30 +686,69 @@ class Planner:
         probe automatically."""
         for spec in self._left_specs:
             alias = spec["alias"]
-            probe_ast, build_name = spec["pair"]
-            build_col = build_name.parts[-1]
+            pairs = spec["pairs"]
+            build_cols = [bn.parts[-1] for (_p, bn) in pairs]
             right_cols = sorted({n.split(".", 1)[1] for n in needed
                                  if n.startswith(alias + ".")}
-                                | {build_col})
+                                | set(build_cols))
             items = [ast.SelectItem(ast.Name((alias, col)), f"{alias}.{col}")
                      for col in right_cols]
             sub = ast.Select(items=items,
                              relation=ast.TableRef(spec["tref"].name, alias),
                              where=_and_fold(spec["local"]))
             jplan = self._plan_inner(sub)
+            payload = [f"{alias}.{c}" for c in right_cols]
 
-            e = binder.bind(probe_ast)
-            if isinstance(e, ir.Col):
-                probe_key = e.name
+            if len(pairs) == 1:
+                e = binder.bind(pairs[0][0])
+                if isinstance(e, ir.Col):
+                    probe_key = e.name
+                else:
+                    probe_key = f"__lj{self._jk_counter}"
+                    self._jk_counter += 1
+                    pre = ir.Program().assign(probe_key, e)
+                    pipeline.steps.append(("program", pre))
+                js = JoinStep(jplan, f"{alias}.{build_cols[0]}", probe_key,
+                              "left", payload)
+                pipeline.steps.append(("join", js))
             else:
+                # composite key: hash-combine both sides (host-side for
+                # the build via build_hash_keys, in-program for the
+                # probe), then verify each equality POST-join — a hash
+                # collision cannot filter the row (LEFT keeps it), so
+                # mismatched payloads are NULLed instead
+                bound = [binder.bind(p_ast) for (p_ast, _b) in pairs]
+                for (p_ast, _b), e in zip(pairs, bound):
+                    b = self.scope.try_resolve(p_ast.parts) \
+                        if isinstance(p_ast, ast.Name) else None
+                    if b is not None and b.dtype.is_string:
+                        raise PlanError(
+                            "composite LEFT JOIN over string keys is "
+                            "not supported yet")
                 probe_key = f"__lj{self._jk_counter}"
                 self._jk_counter += 1
-                pre = ir.Program().assign(probe_key, e)
+                pre = ir.Program().assign(
+                    probe_key,
+                    _hash_key_expr_of(bound))
                 pipeline.steps.append(("program", pre))
-            payload = [f"{alias}.{c}" for c in right_cols]
-            js = JoinStep(jplan, f"{alias}.{build_col}", probe_key, "left",
-                          payload)
-            pipeline.steps.append(("join", js))
+                bh = f"{alias}.__ljbh"
+                js = JoinStep(jplan, bh, probe_key, "left", payload,
+                              build_hash_keys=[f"{alias}.{c}"
+                                               for c in build_cols])
+                pipeline.steps.append(("join", js))
+                ver = ir.Program()
+                ok = None
+                for e, bc in zip(bound, build_cols):
+                    t = ir.call("eq", e, ir.Col(f"{alias}.{bc}"))
+                    ok = t if ok is None else ir.call("and", ok, t)
+                okname = f"__ljok{self._jk_counter}"
+                self._jk_counter += 1
+                ver.assign(okname, ok)
+                for pcol in payload:
+                    ver.assign(pcol, ir.call(
+                        "if", ir.Col(okname), ir.Col(pcol),
+                        ir.call("typed_null", ir.Col(pcol))))
+                pipeline.steps.append(("program", ver))
             pipeline.out_names.extend(
                 c for c in payload if c not in pipeline.out_names)
 
@@ -814,6 +858,16 @@ class Planner:
         if isinstance(p, ast.Exists):
             self._add_semi_spec([], p.query, p.negated, first_item_key=False)
             return None
+        n_before = len(self._sub_specs)
+        p = self._rewrite_embedded_membership(p)
+        if len(self._sub_specs) > n_before:
+            # the predicate now references mark columns that only exist
+            # AFTER the mark joins attach — apply it post-join. Scalar
+            # subqueries sharing the predicate still need their rewrite
+            # (they would otherwise reach the binder raw).
+            rewritten, _corr = self._rewrite_scalars(p)
+            self._post_preds.append(p if rewritten is None else rewritten)
+            return None
         rewritten, correlated = self._rewrite_scalars(p)
         if rewritten is None:
             return p
@@ -821,6 +875,55 @@ class Planner:
             self._post_preds.append(rewritten)
             return None
         return rewritten
+
+    def _rewrite_embedded_membership(self, p):
+        """IN/EXISTS sitting INSIDE a larger predicate (an OR arm, a CASE
+        condition): each becomes a MARK join whose bool bit substitutes
+        for the membership test — `a IN s1 OR a IN s2` runs as two mark
+        joins + one filter (ds35-family demographics queries)."""
+        import dataclasses as _dc
+
+        def walk(e):
+            # normalize NOT (x IN (...)) / NOT EXISTS the way the
+            # top-level extraction does, so the negated-inside-OR guard
+            # actually fires instead of silently planning a plain mark
+            if isinstance(e, ast.UnaryOp) and e.op == "not":
+                a = e.arg
+                if isinstance(a, ast.Exists):
+                    e = ast.Exists(a.query, not a.negated)
+                elif isinstance(a, ast.InSubquery):
+                    e = ast.InSubquery(a.arg, a.query, not a.negated)
+            if isinstance(e, (ast.InSubquery, ast.Exists)):
+                n = len(self._sub_specs) + len(self._init_subplans)
+                mark = f"__s{n}m"
+                if isinstance(e, ast.InSubquery):
+                    self._add_semi_spec([e.arg], e.query, e.negated,
+                                        first_item_key=True,
+                                        mark_pred=mark)
+                else:
+                    self._add_semi_spec([], e.query, e.negated,
+                                        first_item_key=False,
+                                        mark_pred=mark)
+                from ydb_tpu.core import dtypes as dt
+                self.scope.add("__sub", mark, B.ColumnBinding(
+                    mark, dt.DType(dt.Kind.BOOL, False)))
+                return ast.Name((mark,))
+            if not hasattr(e, "__dataclass_fields__") \
+                    or isinstance(e, (ast.ScalarSubquery, ast.Select)):
+                return e
+
+            def rw(v):
+                if isinstance(v, tuple):
+                    return tuple(rw(x) for x in v)
+                if hasattr(v, "__dataclass_fields__"):
+                    return walk(v)
+                return v
+            out = {f: rw(getattr(e, f)) for f in e.__dataclass_fields__}
+            try:
+                return _dc.replace(e, **out)
+            except TypeError:
+                return e
+        return walk(p)
 
     def _has_scalar_sub(self, e) -> bool:
         """Generic dataclass-field walk (matches the shapes the rewriter's
@@ -925,7 +1028,11 @@ class Planner:
         return out, state["correlated"]
 
     def _add_semi_spec(self, outer_exprs, inner_sel: ast.Select,
-                       negated: bool, first_item_key: bool):
+                       negated: bool, first_item_key: bool,
+                       mark_pred: str = ""):
+        """`mark_pred`: non-empty = the membership test sits INSIDE a
+        larger predicate (an OR arm) — plan a MARK join exposing the
+        bit under that name instead of a filtering semi join."""
         inner, pairs, neqs = self._split_correlations(inner_sel,
                                                       with_neq=True)
         n = len(self._sub_specs) + len(self._init_subplans)
@@ -972,6 +1079,15 @@ class Planner:
             # the build set is non-empty — x NOT IN S is NULL, not TRUE
             "not_in": negated and first_item_key,
         }
+        if mark_pred:
+            if negated:
+                raise PlanError("negated IN/EXISTS inside OR is not "
+                                "supported yet")
+            if len(keys) > 1:
+                raise PlanError("composite-key IN/EXISTS inside OR is "
+                                "not supported yet")
+            spec["kind"] = "markpred"
+            spec["mark"] = mark_pred
         if spec["not_in"] and pairs:
             # correlated NOT IN additionally needs a per-correlation-key
             # set-emptiness probe (x NOT IN {} is TRUE even for NULL x):
@@ -1040,7 +1156,14 @@ class Planner:
                 if pre.commands:
                     pipeline.steps.append(("program", pre))
                 build_key = spec["keys"][0][1]
-                if spec["kind"] == "scalar":
+                if spec["kind"] == "markpred":
+                    # membership bit for a disjunctive predicate: a MARK
+                    # join attaches `mark` = matched without filtering
+                    # (the reference lowers ORed existence tests the
+                    # same way before peephole, `dq_opt_join.cpp`)
+                    js = JoinStep(spec["plan"], build_key, probe_key,
+                                  "mark", [], mark_col=spec["mark"])
+                elif spec["kind"] == "scalar":
                     js = JoinStep(spec["plan"], build_key, probe_key,
                                   "inner", list(spec["payload"]))
                 else:

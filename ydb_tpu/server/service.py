@@ -327,6 +327,18 @@ class QueryServicer:
         gtx = request["gtx"]
         decision = request["decision"]
         try:
+            # a still-live prepared session (prepare succeeded but the
+            # reply was lost) resolves like a decide — never leak it
+            with self._lock:
+                live = self.__dict__.setdefault("_dtx_live", {}).pop(
+                    gtx, None)
+            if live is not None:
+                if decision == "commit":
+                    live.commit()
+                else:
+                    live.rollback()
+                j.append({"op": "done", "gtx": gtx, "decision": decision})
+                return {"ok": True, "state": "resolved-live"}
             rec = j.in_doubt().get(gtx)
             if rec is None:
                 return {"ok": True, "state": "already-done"}

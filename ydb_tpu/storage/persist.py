@@ -126,9 +126,13 @@ class Store:
         """Full initial sync: ship EVERY existing file to the standby —
         required when a replica attaches to a store that already holds
         data (delta shipping alone would send manifests referencing
-        portion blobs the standby never received). Returns files
-        shipped."""
+        portion blobs the standby never received). Skipped when the
+        standby already holds a catalog (a routine primary restart must
+        not re-ship the whole store). Returns files shipped."""
         if self.replica is None:
+            return 0
+        probe = getattr(self.replica, "has_catalog", None)
+        if probe is not None and probe():
             return 0
         import base64
         n = 0

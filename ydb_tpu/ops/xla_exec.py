@@ -339,45 +339,13 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
     keyparts_s = out[1:-1]
     perm = out[-1]
 
-    # batched permutation gathers: EVERY column the aggregation touches
-    # (key outputs + agg args + validities), stacked by dtype and moved
-    # with ONE axis-1 gather per dtype — on this platform a 6M-row
-    # gather costs ~50-80ms whether it moves 1 column or 8 (PERF.md r5),
-    # so per-column gathers were the dominant group-by cost
-    need = list(dict.fromkeys(
-        list(cmd.keys) + [a.arg for a in cmd.aggs if a.arg is not None]))
-    data_groups: list = []              # (names, matrix (C,cap) | vec)
-    by_dtype: dict = {}
-    for n in need:
-        by_dtype.setdefault(str(env[n][0].dtype), []).append(n)
-    for names in by_dtype.values():
-        if len(names) == 1:
-            data_groups.append((names, env[names[0]][0][perm]))
-        else:
-            stack = jnp.stack([env[n][0] for n in names])
-            data_groups.append((names, stack[:, perm]))
-    vnames = [n for n in need if env[n][1] is not None]
-    if len(vnames) == 1:
-        valid_groups = [(vnames, env[vnames[0]][1][perm])]
-    elif vnames:
-        vstack = jnp.stack([env[n][1] for n in vnames])
-        valid_groups = [(vnames, vstack[:, perm])]
-    else:
-        valid_groups = []
-
-    def _from_groups(groups, name):
-        for names, mat in groups:
-            if name in names:
-                return mat if len(names) == 1 else mat[names.index(name)]
-        return None
-
     env_s = {}
 
     def sorted_col(name):
         got = env_s.get(name)
         if got is None:
-            got = (_from_groups(data_groups, name),
-                   _from_groups(valid_groups, name))
+            d, v = env[name]
+            got = (d[perm], v[perm] if v is not None else None)
             env_s[name] = got
         return got
 
@@ -405,27 +373,13 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
     ends = jnp.clip(ends, 0, cap - 1)
     live = gi < ngroups
 
-    # key outputs: the SAME dtype stacks, gathered once at `starts`
     new_env = {}
-    starts_cache: dict = {}
-
-    def at_starts(groups, name):
-        for gi, (names, mat) in enumerate(groups):
-            if name in names:
-                got = starts_cache.get((id(groups), gi))
-                if got is None:
-                    got = mat[starts] if len(names) == 1 \
-                        else mat[:, starts]
-                    starts_cache[(id(groups), gi)] = got
-                return got if len(names) == 1 else got[names.index(name)]
-        return None
-
     for kname in cmd.keys:
-        kd = at_starts(data_groups, kname)
+        d, v = sorted_col(kname)
+        kd = d[starts]
         dt = schema.dtype(kname)
         if dt.nullable:
-            v = at_starts(valid_groups, kname)
-            kv = v if v is not None else jnp.ones((cap,), jnp.bool_)
+            kv = (v[starts] if v is not None else jnp.ones((cap,), jnp.bool_))
             new_env[kname] = (kd, kv & live)
         else:
             new_env[kname] = (kd, None)
@@ -433,68 +387,28 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
     seg = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     seg_safe = jnp.where(active_s, seg, cap)
 
-    # batched prefix sums: every count/sum series stacks into one
-    # (A, cap) cumsum per accumulator dtype, and the three endpoint
-    # gathers (ends / starts / first) run once per dtype instead of
-    # once per aggregate
-    int_series: list = []               # (tag, per_row int64)
-    f64_series: list = []               # (tag, per_row f64)
-    u64_series: list = []               # (tag, per_row uint64)
-    masks: dict = {}
+    def csum_diff(per_row):
+        """Per-group sum of a sorted per-row array via cumsum endpoints."""
+        c = jnp.cumsum(per_row)
+        first = per_row[starts]
+        return c[ends] - c[starts] + first
+
     for a in cmd.aggs:
         if a.func == "count_all":
-            int_series.append((a.out + "#c", active_s.astype(jnp.int64)))
-            continue
-        if a.arg is None:
+            data = csum_diff(active_s.astype(jnp.uint64))
+            new_env[a.out] = (jnp.where(live, data, 0), None)
             continue
         d, v = sorted_col(a.arg)
         m = active_s if v is None else (active_s & v)
-        masks[a.out] = m
-        if a.func in ("count", "sum", "min", "max"):
-            int_series.append((a.out + "#n", m.astype(jnp.int64)))
-        if a.func == "sum":
-            acc = jnp.where(m, d, 0).astype(_acc_dtype(d))
-            if acc.dtype == jnp.float64:
-                f64_series.append((a.out + "#s", acc))
-            elif acc.dtype == jnp.uint64:
-                u64_series.append((a.out + "#s", acc))
-            else:
-                int_series.append((a.out + "#s", acc))
-
-    def batch_csum(series):
-        if not series:
-            return {}
-        if len(series) == 1:
-            tag, per_row = series[0]
-            c = jnp.cumsum(per_row)
-            return {tag: c[ends] - c[starts] + per_row[starts]}
-        mat = jnp.stack([p for (_t, p) in series])
-        c = jnp.cumsum(mat, axis=1)
-        out = c[:, ends] - c[:, starts] + mat[:, starts]
-        return {tag: out[i] for i, (tag, _p) in enumerate(series)}
-
-    sums = {**batch_csum(int_series), **batch_csum(f64_series),
-            **batch_csum(u64_series)}
-
-    for a in cmd.aggs:
-        if a.func == "count_all":
-            data = sums[a.out + "#c"].astype(jnp.uint64)
-            new_env[a.out] = (jnp.where(live, data, 0), None)
-            continue
-        d, v = sorted_col(a.arg)
-        m = masks[a.out] if a.out in masks \
-            else (active_s if v is None else (active_s & v))
         if a.func == "count":
-            data = sums[a.out + "#n"].astype(jnp.uint64)
+            data = csum_diff(m.astype(jnp.uint64))
             new_env[a.out] = (jnp.where(live, data, 0), None)
             continue
-        cnt = sums.get(a.out + "#n")
-        if cnt is None:
-            cnt = jnp.cumsum(m.astype(jnp.int64))
-            cnt = cnt[ends] - cnt[starts] + m[starts].astype(jnp.int64)
+        cnt = csum_diff(m.astype(jnp.int64))
         any_valid = (cnt > 0) & live
         if a.func == "sum":
-            new_env[a.out] = (sums[a.out + "#s"], any_valid)
+            acc = jnp.where(m, d, 0).astype(_acc_dtype(d))
+            new_env[a.out] = (csum_diff(acc), any_valid)
         elif a.func in ("min", "max"):
             sent = _sentinel(np.dtype(d.dtype), a.func == "min")
             masked = jnp.where(m, d, sent)

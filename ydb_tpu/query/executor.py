@@ -760,10 +760,8 @@ class Executor:
         if step.kind not in ("inner", "left", "left_semi", "left_anti",
                              "mark"):
             return None
-        if step.build_hash_keys or step.not_in:
-            # composite hash keys and NOT IN null semantics stay on the
-            # broadcast path (NOT EXISTS is fine: null build keys are
-            # dropped by partition_build, matching build())
+        if step.not_in:
+            # NOT IN null semantics stay on the broadcast path
             return None
 
         # cheap stats gate: the build's driving-scan footprint
@@ -790,10 +788,53 @@ class Executor:
                  self._run_pipeline(step.build, params, snapshot)])
         if prebuilt is not None:
             prebuilt[j] = built
+        if step.build_hash_keys:
+            # composite key: the probe side already computed its combined
+            # 64-bit hash into `probe_key` (planner pre-program); hashing
+            # the build columns the same way makes the exchange key a
+            # plain int64 — per-key equality verification rides in the
+            # post-join programs (`rest`), exactly like the broadcast path
+            built = _add_hash_column(built, step.build_hash_keys,
+                                     step.build_key)
+            if prebuilt is not None:
+                prebuilt[j] = built
         kcd = built.columns.get(step.build_key)
-        if kcd is None or np.issubdtype(kcd.data.dtype, np.floating) \
-                or kcd.dictionary is not None:
+        if kcd is None or np.issubdtype(kcd.data.dtype, np.floating):
             return None
+        if step.anti_null_check:
+            # anti/mark semantics with an ACTUALLY-NULL build key: the
+            # broadcast path owns the three-valued-logic handling (empty
+            # probe rule, or the loud composite NOT IN refusal); the
+            # exchange would silently drop the NULLs and change the
+            # answer. NULL-free builds shuffle fine.
+            cd0 = built.columns.get(step.anti_null_col or step.build_key)
+            if cd0 is not None and cd0.valid is not None \
+                    and not cd0.valid.all():
+                return None
+        if kcd.dictionary is not None:
+            # dictionary-encoded key: remap build codes into the PROBE
+            # side's dictionary (same discipline as `_prepare_join`), so
+            # codes exchange as plain comparable ints
+            table = self.catalog.table(pipe.scan.table)
+            probe_dicts = dict(table.dictionaries)
+            for (storage, internal) in pipe.scan.columns:
+                if storage in probe_dicts:
+                    probe_dicts[internal] = probe_dicts[storage]
+            probe_dict = probe_dicts.get(step.probe_key)
+            if probe_dict is None:
+                return None          # probe dict not derivable here
+            if kcd.dictionary is not probe_dict:
+                built = _remap_build_codes(built, step.build_key,
+                                           probe_dict)
+                # build values ABSENT from the probe dictionary remap to
+                # the shared -2 never-match code: drop them before the
+                # exchange (they can't match anything, and a shared code
+                # would trip the duplicate-key uniqueness gate below)
+                codes2 = built.columns[step.build_key].data
+                if (codes2 == -2).any():
+                    built = built.take(np.nonzero(codes2 != -2)[0])
+                if prebuilt is not None:
+                    prebuilt[j] = built
         # duplicate keys: the exchange probe is first-match only
         if step.kind in ("inner", "left", "mark"):
             enc = built.columns[step.build_key].data
@@ -1059,21 +1100,7 @@ class Executor:
         if kcd is not None and kcd.dictionary is not None \
                 and probe_dict is not None \
                 and kcd.dictionary is not probe_dict:
-            # translate build key codes into the probe dictionary
-            # (host-side O(distinct) LUT; unmatched values → -2 never-match)
-            src = kcd.dictionary.values_array()
-            lut = np.full(max(len(src), 1), -2, dtype=np.int32)
-            for i, v in enumerate(src):
-                lut[i] = probe_dict.encode_existing(v)
-            codes = kcd.data
-            remapped = np.where(codes >= 0, lut[np.clip(codes, 0, None)],
-                                codes).astype(codes.dtype)
-            built = HostBlock(
-                built.schema,
-                {**built.columns,
-                 step.build_key: ColumnData(remapped, kcd.valid,
-                                            probe_dict)},
-                built.length)
+            built = _remap_build_codes(built, step.build_key, probe_dict)
         if step.build_hash_keys:
             built = _add_hash_column(built, step.build_hash_keys,
                                      step.build_key)
@@ -1357,13 +1384,36 @@ class Executor:
         return HostBlock(Schema(schema_cols), cols, block.length)
 
 
+def _remap_build_codes(built: HostBlock, key: str, probe_dict) -> HostBlock:
+    """Translate a build block's dictionary-encoded key codes into the
+    PROBE side's dictionary (host-side O(distinct) LUT; values absent
+    from the probe dictionary → -2, the never-match code; negative codes
+    — the -1 NULL slot — pass through untouched)."""
+    kcd = built.columns[key]
+    src = kcd.dictionary.values_array()
+    lut = np.full(max(len(src), 1), -2, dtype=np.int32)
+    for i, v in enumerate(src):
+        lut[i] = probe_dict.encode_existing(v)
+    codes = kcd.data
+    remapped = np.where(codes >= 0, lut[np.clip(codes, 0, None)],
+                        codes).astype(codes.dtype)
+    return HostBlock(
+        built.schema,
+        {**built.columns, key: ColumnData(remapped, kcd.valid, probe_dict)},
+        built.length)
+
+
 def _add_hash_column(block: HostBlock, key_cols: list, out: str) -> HostBlock:
     """Host-side mirror of the device hash-key expression
     (`hash_combine(hash64(c0), hash64(c1), ...)`) — bit-identical by
-    construction (`ydb_tpu/utils/hashing.py`)."""
+    construction (`ydb_tpu/utils/hashing.py`). Idempotent: a block that
+    already carries `out` (a declined shuffle attempt's prebuilt handoff)
+    passes through, instead of appending a duplicate schema column."""
     from ydb_tpu.core.dtypes import DType, Kind
     from ydb_tpu.utils.hashing import hash_combine, splitmix64
 
+    if out in block.columns:
+        return block
     h = None
     valid = None
     for name in key_cols:

@@ -32,6 +32,17 @@ import time
 
 SUITE_SFS = [float(s) for s in
              os.environ.get("BENCH_SUITE_SFS", "1,10").split(",") if s]
+# TPC-DS leg (VERDICT r5: report a TPC-DS geomean): a representative
+# query subset at this SF runs as the FINAL suite with its own budget
+# share; "" disables
+TPCDS_SF = os.environ.get("BENCH_TPCDS_SF", "1")
+# bench subset: distinct machinery (star joins, windows+lag, CASE
+# buckets, order-set semi-joins, channel unions, ranked CTEs), kept
+# small so compile count stays inside the budget
+TPCDS_BENCH = [q for q in os.environ.get(
+    "BENCH_TPCDS_QUERIES",
+    "ds3,ds7,ds27,ds42,ds43,ds52,ds55,ds62,ds67,ds70,ds89,ds94,ds96,"
+    "ds97,ds98").split(",") if q]
 # the whole bench MUST finish (and print its final JSON) inside the
 # driver's kill window with margin — r4 budgeted 2400s+grace against a
 # shorter driver window, got rc=124 and recorded NOTHING. The emergency
@@ -62,31 +73,38 @@ def geomean(xs):
 
 
 def child_main(sf: float, progress_path: str, skip: list,
-               budget_s: float) -> None:
+               budget_s: float, workload: str = "tpch") -> None:
     import shutil
 
-    from ydb_tpu.bench.tpch_gen import load_tpch
     from ydb_tpu.query import QueryEngine
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from tests.tpch_util import QUERIES, assert_frames_match, oracle
+    if workload == "tpcds":
+        from tests.tpcds_util import QUERIES as ALL_Q, oracle
+        from tests.tpch_util import assert_frames_match
+        QUERIES = {k: ALL_Q[k] for k in TPCDS_BENCH if k in ALL_Q}
+        fact_table, loader = "store_sales", "tpcds"
+    else:
+        from tests.tpch_util import QUERIES, assert_frames_match, oracle
+        fact_table, loader = "lineitem", "tpch"
 
     def emit(rec: dict) -> None:
         with open(progress_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
 
     t0 = time.perf_counter()
-    # durable store per (sf): the FIRST child generates + loads + persists;
-    # a respawn after a wedge boots from disk (WAL/manifest replay) instead
-    # of paying generation + dictionary encode again (~4 min at SF10 — in
-    # r4 that alone could eat a respawn's whole budget share)
-    store = f"/tmp/bench_store_sf{sf:g}"
+    # durable store per (workload, sf): the FIRST child generates + loads
+    # + persists; a respawn after a wedge boots from disk (WAL/manifest
+    # replay) instead of paying generation + dictionary encode again
+    # (~4 min at SF10 — in r4 that alone could eat a respawn's budget)
+    store = f"/tmp/bench_store_{loader}_sf{sf:g}" if loader != "tpch" \
+        else f"/tmp/bench_store_sf{sf:g}"
     marker = os.path.join(store, ".loaded")
-    data = None                       # TpchData — generated lazily for
-    #                                   oracles when booting from the store
+    data = None                       # raw tables — lazily regenerated
+    #                                   for oracles on store boots
     if os.path.exists(marker):
         try:
             eng = QueryEngine(block_rows=1 << 20, data_dir=store)
-            eng.catalog.table("lineitem")
+            eng.catalog.table(fact_table)
         except Exception:             # noqa: BLE001 — torn store: reload
             shutil.rmtree(store, ignore_errors=True)
             eng = None
@@ -95,10 +113,15 @@ def child_main(sf: float, progress_path: str, skip: list,
         eng = None
     if eng is None:
         eng = QueryEngine(block_rows=1 << 20, data_dir=store)
-        data = load_tpch(eng.catalog, sf=sf)
+        if loader == "tpcds":
+            from ydb_tpu.bench.tpcds_gen import load_tpcds
+            data = load_tpcds(eng.catalog, sf=sf)
+        else:
+            from ydb_tpu.bench.tpch_gen import load_tpch
+            data = load_tpch(eng.catalog, sf=sf)
         with open(marker, "w") as f:
             f.write("ok")
-    n_rows = eng.catalog.table("lineitem").num_rows
+    n_rows = eng.catalog.table(fact_table).num_rows
     load_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     eng.prewarm()
@@ -109,8 +132,12 @@ def child_main(sf: float, progress_path: str, skip: list,
     def oracle_data():
         nonlocal data
         if data is None:
-            from ydb_tpu.bench.tpch_gen import TpchData
-            data = TpchData(sf)      # deterministic: same seed, same rows
+            if loader == "tpcds":
+                from ydb_tpu.bench.tpcds_gen import gen_tpcds
+                data = gen_tpcds(sf)
+            else:
+                from ydb_tpu.bench.tpch_gen import TpchData
+                data = TpchData(sf)  # deterministic: same seed
         return data
 
     deadline = _T0 + budget_s        # the parent passes REMAINING budget
@@ -177,17 +204,20 @@ def _save_hung(d: dict) -> None:
         pass
 
 
-def run_suite(sf: float, suite_deadline: float) -> dict:
+def run_suite(sf: float, suite_deadline: float,
+              workload: str = "tpch") -> dict:
     """Run one suite; `suite_deadline` is an absolute perf_counter value
     this suite must not outlive (the per-suite budget split keeps SF10
     from starving behind SF1 — r4 recorded no SF10 at all)."""
-    progress = f"/tmp/bench_suite_sf{sf:g}_{os.getpid()}.jsonl"
+    progress = f"/tmp/bench_suite_{workload}_sf{sf:g}_{os.getpid()}.jsonl"
     if os.path.exists(progress):
         os.unlink(progress)
     # queries whose COMPILE hung a previous run (a stuck remote compile
     # burns a full watchdog window): pre-skip, they re-enter the pool
     # only when the hung file is deleted
-    known_hung = _load_hung().get(f"sf{sf:g}", [])
+    hung_key = f"sf{sf:g}" if workload == "tpch" \
+        else f"{workload}-sf{sf:g}"
+    known_hung = _load_hung().get(hung_key, [])
     skip: list = list(known_hung)
     if known_hung:
         log(f"sf={sf:g}: pre-skipping previously hung: {known_hung}")
@@ -204,7 +234,7 @@ def run_suite(sf: float, suite_deadline: float) -> dict:
         # redo minutes of timed runs + oracles per already-done query
         cmd = [sys.executable, os.path.abspath(__file__), "--suite-child",
                str(sf), progress, ",".join(skip + sorted(results)),
-               str(remaining)]
+               str(remaining), workload]
         child = subprocess.Popen(cmd)
         pos = 0
         current = None
@@ -268,9 +298,9 @@ def run_suite(sf: float, suite_deadline: float) -> dict:
                     hung.append(current)
                     skip.append(current)
                     d = _load_hung()
-                    d.setdefault(f"sf{sf:g}", [])
-                    if current not in d[f"sf{sf:g}"]:
-                        d[f"sf{sf:g}"].append(current)
+                    d.setdefault(hung_key, [])
+                    if current not in d[hung_key]:
+                        d[hung_key].append(current)
                         _save_hung(d)
                     current = None
                 else:
@@ -315,7 +345,7 @@ def run_suite(sf: float, suite_deadline: float) -> dict:
     ok = {q: r["ms"] for q, r in results.items() if r.get("ms")}
     ratios = {q: r["vs_pandas"] for q, r in results.items()
               if "vs_pandas" in r}
-    total = 22
+    total = 22 if workload == "tpch" else len(TPCDS_BENCH)
     not_timed = sorted(set(hung)
                        | {q for q, r in results.items() if not r.get("ms")}
                        | (set(skipped_budget) - set(ok)))
@@ -378,17 +408,22 @@ def main() -> None:
         os._exit(0)
 
     threading.Thread(target=emergency, daemon=True).start()
-    for i, sf in enumerate(SUITE_SFS):
+    plan = [("tpch", sf) for sf in SUITE_SFS]
+    if TPCDS_SF:
+        plan.append(("tpcds", float(TPCDS_SF)))
+    for i, (workload, sf) in enumerate(plan):
         elapsed = time.perf_counter() - _T0
         if elapsed > BUDGET_S - 120:
-            log(f"budget exhausted before sf={sf:g} suite")
+            log(f"budget exhausted before {workload} sf={sf:g} suite")
             continue
         # per-suite budget split: remaining budget divided over remaining
         # suites, so a slow first suite cannot starve the later ones
-        share = (BUDGET_S - elapsed) / (len(SUITE_SFS) - i)
-        out = run_suite(sf, time.perf_counter() + share)
-        suites[f"sf{sf:g}"] = out
-        log(f"suite sf={sf:g}: {out['coverage']} ok, "
+        share = (BUDGET_S - elapsed) / (len(plan) - i)
+        out = run_suite(sf, time.perf_counter() + share, workload)
+        key = f"sf{sf:g}" if workload == "tpch" \
+            else f"{workload}_sf{sf:g}"
+        suites[key] = out
+        log(f"suite {key}: {out['coverage']} ok, "
             f"geomean {out['geomean_ms']}ms "
             f"(penalized {out['geomean_penalized_ms']}ms)"
             + (f", {out['vs_pandas_geomean']}x pandas geomean"
@@ -407,6 +442,7 @@ if __name__ == "__main__":
         skip = [s for s in sys.argv[4].split(",") if s] \
             if len(sys.argv) > 4 else []
         budget = float(sys.argv[5]) if len(sys.argv) > 5 else BUDGET_S
-        child_main(sf, sys.argv[3], skip, budget)
+        workload = sys.argv[6] if len(sys.argv) > 6 else "tpch"
+        child_main(sf, sys.argv[3], skip, budget, workload)
     else:
         main()

@@ -1,0 +1,135 @@
+"""Scalar UDFs over dictionary columns (`query/udf.py`).
+
+The loadable-UDF seat (reference: `ydb/library/yql/udfs/common/` —
+string/url/re2/json/ip): functions evaluate once per DISTINCT value
+host-side and the device gathers through LUTs; results compose with
+filters, group keys, aggregates, and ORDER BY like any column."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ydb_tpu.query import QueryEngine, QueryError
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = QueryEngine(block_rows=1 << 10)
+    e.execute("create table t (id Int64 not null, url Utf8, doc Utf8, "
+              "ip Utf8, primary key (id))")
+    rows = []
+    urls = ["https://www.example.com/a/b?q=1", "http://other.org/x",
+            "https://example.com/a", None]
+    docs = ['{"a": {"b": 7}, "tags": ["x", "y"]}',
+            '{"a": {"b": -2.5}}', "not json", None]
+    ips = ["192.168.0.1", "8.8.8.8", "::ffff:10.0.0.1", "garbage"]
+    for i in range(40):
+        u = urls[i % 4]
+        d = docs[i % 4]
+        p = ips[i % 4]
+        rows.append("({}, {}, {}, {})".format(
+            i, "null" if u is None else f"'{u}'",
+            "null" if d is None else f"'{d.replace(chr(39), chr(39) * 2)}'",
+            f"'{p}'"))
+    e.execute("insert into t (id, url, doc, ip) values " + ", ".join(rows))
+    return e
+
+
+def test_regexp_like_filter(eng):
+    got = eng.query("select count(*) as n from t "
+                    "where regexp_like(url, 'example\\.com')")
+    assert int(got.n[0]) == 20        # 2 of 4 url variants, 10 each
+
+
+def test_regexp_extract_string_result(eng):
+    got = eng.query(
+        "select regexp_extract(url, 'https?://([^/]+)/', 1) as host, "
+        "count(*) as n from t where url is not null "
+        "group by regexp_extract(url, 'https?://([^/]+)/', 1) "
+        "order by host")
+    assert list(got.host) == ["example.com", "other.org",
+                              "www.example.com"]
+    assert list(got.n) == [10, 10, 10]
+
+
+def test_url_host_and_domain(eng):
+    got = eng.query("select url_domain(url) as d, count(*) as n from t "
+                    "where url is not null group by url_domain(url) "
+                    "order by d")
+    assert list(got.d) == ["example.com", "other.org"]
+    assert list(got.n) == [20, 10]
+
+
+def test_json_extract_typed(eng):
+    got = eng.query("select id, json_extract_int(doc, '$.a.b') as b, "
+                    "json_extract_double(doc, '$.a.b') as bd, "
+                    "json_extract(doc, '$.tags[1]') as tag "
+                    "from t where id < 4 order by id")
+    bs = got.b.to_numpy(np.float64, na_value=np.nan)
+    assert bs[0] == 7
+    assert bs[1] == -2                # int() truncation of -2.5
+    assert np.isnan(bs[2]) and np.isnan(bs[3])   # not json / NULL doc
+    assert got.bd.to_numpy(np.float64, na_value=np.nan)[1] == -2.5
+    assert [x if isinstance(x, str) else None for x in got.tag] \
+        == ["y", None, None, None]
+
+
+def test_ip_udfs(eng):
+    got = eng.query("select ip_to_canonical(ip) as c, "
+                    "count(*) as n from t group by ip_to_canonical(ip) "
+                    "order by c")
+    vals = [x if isinstance(x, str) else None for x in got.c]
+    assert "::ffff:10.0.0.1" in vals and "8.8.8.8" in vals \
+        and "192.168.0.1" in vals and None in vals   # 'garbage' → NULL
+    got2 = eng.query("select count(*) as n from t where ip_is_private(ip)")
+    assert int(got2.n[0]) == 20       # 192.168.* and ::ffff:10.*
+
+
+def test_custom_registration_and_sum(eng):
+    eng.register_udf("vowels", lambda s: sum(c in "aeiou" for c in s)
+                     if s is not None else None, returns="int64")
+    got = eng.query("select sum(vowels(url)) as s from t "
+                    "where url is not null")
+    import re
+    exp = sum(sum(c in "aeiou" for c in u) * 10
+              for u in ["https://www.example.com/a/b?q=1",
+                        "http://other.org/x", "https://example.com/a"])
+    assert int(got.s[0]) == exp
+
+
+def test_null_propagation_and_unknown(eng):
+    got = eng.query("select count(url_host(url)) as n, count(*) as c "
+                    "from t")
+    assert int(got.n[0]) == 30 and int(got.c[0]) == 40   # NULL in → NULL out
+    with pytest.raises(QueryError):
+        eng.query("select nosuch_udf(url) from t")
+
+
+def test_split_part_and_pad(eng):
+    got = eng.query("select split_part(url, '/', 3) as seg from t "
+                    "where id = 0")
+    assert list(got.seg) == ["www.example.com"]
+    got = eng.query("select lpad(split_part(url, '/', 3), 20, '.') as p "
+                    "from t where id = 1")
+    assert list(got.p) == ["...........other.org"]
+
+
+def test_bidirectional_composition(eng):
+    """Builtins wrap UDFs and UDFs wrap builtins (review r5)."""
+    got = eng.query("select substring(url_host(url), 1, 3) as p, "
+                    "count(*) as n from t where url is not null "
+                    "group by substring(url_host(url), 1, 3) order by p")
+    assert list(got.p) == ["exa", "oth", "www"]
+    got = eng.query("select url_host(upper(url)) as h from t where id = 1")
+    assert list(got.h) == ["other.org"]
+
+
+def test_udf_errors_are_query_errors(eng):
+    with pytest.raises(QueryError):      # bad regex
+        eng.query("select count(*) as n from t "
+                  "where regexp_like(url, '(')")
+    with pytest.raises(QueryError):      # wrong arity in composition
+        eng.query("select upper(split_part(url, '/')) as x from t")
+    # out-of-range split index is NULL, not a crash
+    got = eng.query("select count(split_part(url, '/', 200)) as n from t")
+    assert int(got.n[0]) == 0

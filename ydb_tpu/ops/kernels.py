@@ -264,12 +264,20 @@ def _take_lut_nv(xp, args, extra):
 
     The LUT is a runtime Param computed host-side over the column dictionary
     (see core/dictionary.py) — this is how LIKE/substr/eq on strings run on
-    the device without touching bytes."""
+    the device without touching bytes.
+
+    `null_neg`: the LUT VALUES are themselves dictionary codes where a
+    negative entry means "the transform produced NULL for this input"
+    (derived-string lane: regexp_extract with no match, split_part out of
+    range) — the result validity must reflect it, or COUNT/IS NULL see a
+    phantom value."""
     (codes, vc), (lut, _) = args
     safe = xp.clip(codes, 0, lut.shape[0] - 1) if hasattr(lut, "shape") else codes
     data = lut[safe]
     nul = codes < 0
     valid = ~nul if vc is None else (vc & ~nul)
+    if extra.get("null_neg"):
+        valid = valid & (data >= 0)
     return data, valid
 
 

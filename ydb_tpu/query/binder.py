@@ -290,16 +290,19 @@ def _try_fold(e: ast.Expr):
 # -- string folding (dictionary LUTs) --------------------------------------
 
 
-def _string_fn(e: ast.Expr, scope: Scope):
+def _string_fn(e: ast.Expr, scope: Scope, udfs=None):
     """If `e` is a pure function of ONE dictionary-encoded column returning a
-    python string, return (binding, fn: str|None -> str|None)."""
+    python string, return (binding, fn: str|None -> str|None). `udfs`:
+    optional UDF registry — string-returning UDFs compose with the
+    builtins in EITHER direction (substring(url_host(x)) and
+    url_host(substring(x)) both work)."""
     if isinstance(e, ast.Name):
         b = scope.try_resolve(e.parts)
         if b is not None and b.dtype.is_string and b.dictionary is not None:
             return b, (lambda s: s)
         return None
     if isinstance(e, ast.FuncCall) and e.name == "substring":
-        inner = _string_fn(e.args[0], scope)
+        inner = _string_fn(e.args[0], scope, udfs)
         if inner is None:
             return None
         b, f = inner
@@ -322,7 +325,7 @@ def _string_fn(e: ast.Expr, scope: Scope):
         return b, g
     if isinstance(e, ast.FuncCall) and e.name in _STR_UNARY \
             and len(e.args) == 1:
-        inner = _string_fn(e.args[0], scope)
+        inner = _string_fn(e.args[0], scope, udfs)
         if inner is None:
             return None
         b, f = inner
@@ -331,7 +334,7 @@ def _string_fn(e: ast.Expr, scope: Scope):
                    None if f(s) is None else g0(f(s)))
     if isinstance(e, ast.FuncCall) and e.name == "replace" \
             and len(e.args) == 3:
-        inner = _string_fn(e.args[0], scope)
+        inner = _string_fn(e.args[0], scope, udfs)
         old_f, new_f = _try_fold(e.args[1]), _try_fold(e.args[2])
         if inner is None or old_f is None or new_f is None:
             return None
@@ -340,7 +343,7 @@ def _string_fn(e: ast.Expr, scope: Scope):
                    None if f(s) is None else f(s).replace(o, n))
     if isinstance(e, ast.FuncCall) and e.name == "regexp_replace" \
             and len(e.args) == 3:
-        inner = _string_fn(e.args[0], scope)
+        inner = _string_fn(e.args[0], scope, udfs)
         pat_f, rep_f = _try_fold(e.args[1]), _try_fold(e.args[2])
         if inner is None or pat_f is None or rep_f is None:
             return None
@@ -351,19 +354,42 @@ def _string_fn(e: ast.Expr, scope: Scope):
     if isinstance(e, ast.BinOp) and e.op == "||":
         lf = _try_fold(e.right)
         if lf is not None and isinstance(lf.value, str):
-            inner = _string_fn(e.left, scope)
+            inner = _string_fn(e.left, scope, udfs)
             if inner is not None:
                 b, f = inner
                 return b, (lambda s, f=f, suf=lf.value:
                            None if f(s) is None else f(s) + suf)
         rf = _try_fold(e.left)
         if rf is not None and isinstance(rf.value, str):
-            inner = _string_fn(e.right, scope)
+            inner = _string_fn(e.right, scope, udfs)
             if inner is not None:
                 b, f = inner
                 return b, (lambda s, f=f, pre=rf.value:
                            None if f(s) is None else pre + f(s))
         return None
+    # string-returning UDFs compose like any builtin string transform
+    if isinstance(e, ast.FuncCall) and udfs is not None and e.name in udfs:
+        u = udfs.get(e.name)
+        if u.returns != "string" or not e.args \
+                or not (u.min_args <= len(e.args) <= u.max_args):
+            if u.returns == "string" and e.args:
+                raise BindError(f"udf {u.name} takes {u.min_args}"
+                                f"..{u.max_args} arguments")
+            return None
+        inner = _string_fn(e.args[0], scope, udfs)
+        if inner is None:
+            return None
+        b, f = inner
+        lits = []
+        for a in e.args[1:]:
+            lf2 = _try_fold(a)
+            if lf2 is None:
+                return None
+            lits.append(lf2.value)
+
+        def g(s, f=f, fn=u.fn, lits=tuple(lits)):
+            return fn(f(s) if s is not None else None, *lits)
+        return b, g
     return None
 
 
@@ -407,6 +433,29 @@ def _lut_pred(binding: ColumnBinding, fn: Callable, pool: ParamPool) -> ir.Expr:
     return ir.call("take_lut", ir.Col(binding.internal), p)
 
 
+def _lut_typed(binding: ColumnBinding, fn: Callable, pool: ParamPool,
+               kind) -> ir.Expr:
+    """Typed nullable LUT gather: value + validity LUTs over a
+    dictionary column — fn returning None lands as SQL NULL (the int64/
+    float64 UDF result path; `_lut_int` keeps its non-null contract for
+    length() and friends)."""
+    d = binding.dictionary
+    n = max(len(d), 1)
+    npdt = np.int64 if kind is dt.Kind.INT64 else np.float64
+    vals = np.zeros(n, dtype=npdt)
+    ok = np.zeros(n, dtype=np.bool_)
+    for i, v in enumerate(d.values_array()):
+        r = fn(v)
+        if r is not None:
+            vals[i] = r
+            ok[i] = True
+    pv = pool.add(vals, dt.DType(kind, False), is_array=True)
+    pb = pool.add(ok, dt.DType(dt.Kind.BOOL, False), is_array=True)
+    val_e = ir.call("take_lut", ir.Col(binding.internal), pv)
+    ok_e = ir.call("take_lut", ir.Col(binding.internal), pb)
+    return ir.call("if", ok_e, val_e, ir.call("typed_null", val_e))
+
+
 def _lut_int(binding: ColumnBinding, fn: Callable, pool: ParamPool) -> ir.Expr:
     """int64-LUT gather over a dictionary column (length() and friends)."""
     d = binding.dictionary
@@ -424,9 +473,13 @@ def _lut_int(binding: ColumnBinding, fn: Callable, pool: ParamPool) -> ir.Expr:
 class ExprBinder:
     """Binds row-level AST expressions over a Scope into op-IR."""
 
-    def __init__(self, scope: Scope, pool: ParamPool):
+    def __init__(self, scope: Scope, pool: ParamPool, udfs=None):
         self.scope = scope
         self.pool = pool
+        # UDF registry (`query/udf.py`): unknown functions resolve here
+        # last — scalar string functions evaluated per DISTINCT value
+        # into LUTs the device gathers through
+        self.udfs = udfs
 
     def bind(self, e: ast.Expr) -> ir.Expr:
         f = _try_fold(e)
@@ -453,7 +506,7 @@ class ExprBinder:
         # string-VALUED expression (substring/concat of a dict column) used
         # as a value (group key / output): map source codes to a fresh
         # dictionary via an int32 LUT. (Names returned above.)
-        sf = _string_fn(e, self.scope)
+        sf = _string_fn(e, self.scope, self.udfs)
         if sf is not None:
             return self._derived_string(e, sf)
 
@@ -468,7 +521,7 @@ class ExprBinder:
             raise BindError(f"unary {e.op}")
 
         if isinstance(e, ast.Like):
-            sf = _string_fn(e.arg, self.scope)
+            sf = _string_fn(e.arg, self.scope, self.udfs)
             if sf is None:
                 raise BindError("LIKE on a non-string expression")
             b, fn = sf
@@ -498,7 +551,7 @@ class ExprBinder:
             from dataclasses import replace as _dc_replace
             e = _dc_replace(
                 e, items=tuple(self._coerce_vs(e.arg, it) for it in e.items))
-            sf = _string_fn(e.arg, self.scope)
+            sf = _string_fn(e.arg, self.scope, self.udfs)
             if sf is not None:
                 b, fn = sf
                 values = set()
@@ -561,7 +614,9 @@ class ExprBinder:
                 lut[i] = new_dict.encode([r])[0]
         p = self.pool.add(lut, dt.DType(dt.Kind.STRING, False), is_array=True)
         self.pool.param_dicts[p.name] = new_dict
-        out = ir.call("take_lut", ir.Col(b.internal), p)
+        # null_neg: a -1 LUT entry means the transform produced NULL for
+        # that (non-null) input — validity must reflect it
+        out = ir.call("take_lut", ir.Col(b.internal), p, null_neg=True)
         cache[ckey] = out
         return out
 
@@ -596,7 +651,7 @@ class ExprBinder:
         # string comparisons fold through the dictionary
         if e.op in ("=", "<>", "<", "<=", ">", ">="):
             for a, bexp, flip in ((e.left, e.right, False), (e.right, e.left, True)):
-                sf = _string_fn(a, self.scope)
+                sf = _string_fn(a, self.scope, self.udfs)
                 lit = _try_fold(bexp)
                 if sf is not None and lit is not None and isinstance(lit.value, str):
                     b, fn = sf
@@ -614,8 +669,8 @@ class ExprBinder:
             # comparison touching a string-valued side must not fall
             # through to raw code comparison (codes from different
             # dictionaries are incomparable)
-            lsf = _string_fn(e.left, self.scope)
-            rsf = _string_fn(e.right, self.scope)
+            lsf = _string_fn(e.left, self.scope, self.udfs)
+            rsf = _string_fn(e.right, self.scope, self.udfs)
             if lsf is not None or rsf is not None:
                 if e.op in ("=", "<>") and lsf is not None and rsf is not None:
                     lb, rb = lsf[0], rsf[0]
@@ -785,7 +840,7 @@ class ExprBinder:
         if name == "length":
             if len(e.args) != 1:
                 raise BindError("length takes one argument")
-            sf = _string_fn(e.args[0], self.scope)
+            sf = _string_fn(e.args[0], self.scope, self.udfs)
             if sf is None:
                 raise BindError("length needs a string expression")
             b, fn = sf
@@ -793,7 +848,7 @@ class ExprBinder:
                 b, lambda s: None if s is None or fn(s) is None
                 else len(fn(s)), self.pool)
         if name in ("startswith", "endswith", "contains_string"):
-            sf = _string_fn(e.args[0], self.scope)
+            sf = _string_fn(e.args[0], self.scope, self.udfs)
             lit = _try_fold(e.args[1])
             if sf is None or lit is None:
                 raise BindError(f"{name} needs a string column and literal")
@@ -812,4 +867,71 @@ class ExprBinder:
                     "contains_string": lambda s: tgt in s}[name]
             return _lut_pred(b, lambda s: s is not None and test(fn(s)),
                              self.pool)
+        if self.udfs is not None and name in self.udfs:
+            return self._bind_udf(self.udfs.get(name), e)
         raise BindError(f"unknown function {name}")
+
+    def _bind_udf(self, u, e: ast.FuncCall) -> ir.Expr:
+        """Scalar UDF over a dictionary column: evaluate once per
+        DISTINCT value host-side, gather through a LUT on device
+        (`query/udf.py` — the loadable-UDF seat, re2/url/json/ip udfs).
+        First arg = string expression of one dictionary column; the rest
+        fold to literals."""
+        if not (u.min_args <= len(e.args) <= u.max_args):
+            raise BindError(f"udf {u.name} takes {u.min_args}"
+                            f"..{u.max_args} arguments")
+        lit0 = _try_fold(e.args[0])
+        if lit0 is not None and isinstance(lit0.value, str) \
+                and u.returns != "string":
+            # constant input: evaluate once at bind time
+            lits0 = []
+            for a in e.args[1:]:
+                lf = _try_fold(a)
+                if lf is None:
+                    raise BindError(f"udf {u.name}: arguments after the "
+                                    "first must fold to literals")
+                lits0.append(lf.value)
+            try:
+                r = u.fn(lit0.value, *lits0)
+            except Exception as ex:          # noqa: BLE001 — user code
+                raise BindError(f"udf {u.name} failed: "
+                                f"{type(ex).__name__}: {ex}") from ex
+            kind0 = {"int64": dt.Kind.INT64, "float64": dt.Kind.FLOAT64,
+                     "bool": dt.Kind.BOOL}[u.returns]
+            if r is None:
+                return ir.call("typed_null",
+                               ir.Const(0, dt.DType(kind0, False)))
+            # coerce like the LUT paths do (bool() / int() / float())
+            r = {"int64": int, "float64": float,
+                 "bool": bool}[u.returns](r)
+            return ir.Const(r, dt.DType(kind0, False))
+        sf = _string_fn(e.args[0], self.scope, self.udfs)
+        if sf is None:
+            raise BindError(
+                f"udf {u.name} needs a dictionary-encoded string "
+                f"expression as its first argument")
+        b, f = sf
+        lits = []
+        for a in e.args[1:]:
+            lf = _try_fold(a)
+            if lf is None:
+                raise BindError(f"udf {u.name}: arguments after the "
+                                "first must fold to literals")
+            lits.append(lf.value)
+
+        def call(s, f=f, fn=u.fn, lits=tuple(lits), name=u.name):
+            inner = f(s) if s is not None else None
+            try:
+                return fn(inner, *lits)
+            except Exception as ex:          # noqa: BLE001 — user code
+                raise BindError(
+                    f"udf {name} failed on {inner!r}: "
+                    f"{type(ex).__name__}: {ex}") from ex
+
+        if u.returns == "string":
+            return self._derived_string(e, (b, call))
+        if u.returns == "bool":
+            # predicate LUT: fn-None and input-NULL both read as FALSE
+            return _lut_pred(b, lambda s: bool(call(s)), self.pool)
+        kind = dt.Kind.INT64 if u.returns == "int64" else dt.Kind.FLOAT64
+        return _lut_typed(b, call, self.pool, kind)

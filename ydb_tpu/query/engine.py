@@ -243,6 +243,14 @@ class QueryEngine:
         from ydb_tpu.tx import Session
         return Session(self)
 
+    def register_udf(self, name: str, fn, returns: str = "string",
+                     min_args: int = 1, max_args: int = 8) -> None:
+        """Register a scalar UDF (`query/udf.py`): `fn(str_or_None,
+        *literal_args)` evaluated once per DISTINCT dictionary value,
+        gathered on device through a LUT. `returns`: string | int64 |
+        float64 | bool."""
+        self.catalog.udfs.register(name, fn, returns, min_args, max_args)
+
     # -- topics / changefeeds (PersQueue + change_exchange analogs) --------
 
     def create_topic(self, name: str, partitions: int = 1):

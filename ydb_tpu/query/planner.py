@@ -221,7 +221,8 @@ class Planner:
                                      "tref": tref, "on": on})
         self.scope = scope
         self.pool = pool
-        binder = B.ExprBinder(scope, pool)
+        binder = B.ExprBinder(scope, pool,
+                              udfs=getattr(self.catalog, "udfs", None))
         left_aliases = {s["alias"] for s in self._left_specs}
 
         # classify each left join's ON conjuncts: equi pair vs build-local

@@ -22,6 +22,10 @@ class Catalog:
         self.tables: dict[str, ColumnTable] = {}
         self.store = store
         self._next_version = 1
+        # scalar UDF registry (query/udf.py) with the standard string/
+        # url/re2/json/ip library preinstalled; engine.register_udf adds
+        from ydb_tpu.query.udf import UdfRegistry
+        self.udfs = UdfRegistry()
 
     def create_table(self, name: str, schema: Schema, key_columns: list[str],
                      shards: int = 1, portion_rows: int = 1 << 20,

@@ -1,0 +1,211 @@
+"""HTTP/JSON and Kafka wire fronts over one engine.
+
+The reference's http_proxy + kafka_proxy seats: the same engine serves
+gRPC, pgwire, HTTP and Kafka simultaneously; data written through one
+front is visible through the others (topics shared with native
+producers/consumers and CDC)."""
+
+import base64
+import json
+import socket
+import struct
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ydb_tpu.query import QueryEngine
+from ydb_tpu.server.http import serve_http
+from ydb_tpu.server.kafka import serve_kafka
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = QueryEngine(block_rows=1 << 10)
+    e.execute("create table h (id Int64 not null, v Double, "
+              "primary key (id))")
+    e.execute("insert into h (id, v) values (1, 1.5), (2, 2.5), (3, null)")
+    return e
+
+
+# -- HTTP --------------------------------------------------------------------
+
+
+def _http(port, path, body=None, token=""):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=None if body is None else json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {token}"} if token else {})},
+        method="GET" if body is None else "POST")
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_query_health_counters(eng):
+    front = serve_http(eng, port=0)
+    try:
+        code, resp = _http(front.port, "/query",
+                           {"sql": "select count(*) as n, sum(v) as s "
+                                   "from h"})
+        assert code == 200 and resp["columns"] == ["n", "s"]
+        assert resp["rows"][0][0] == 3
+        assert np.isclose(resp["rows"][0][1], 4.0)
+        code, resp = _http(front.port, "/query", {"sql": "select nope"})
+        assert code == 400 and "error" in resp
+        code, resp = _http(front.port, "/health")
+        assert code == 200 and resp["status"] in ("GOOD", "DEGRADED")
+        code, resp = _http(front.port, "/counters")
+        assert code == 200 and "counters" in resp
+        code, resp = _http(front.port, "/ready")
+        assert code == 200
+    finally:
+        front.stop()
+
+
+def test_http_bearer_auth(eng):
+    front = serve_http(eng, port=0, token="sekrit")
+    try:
+        code, resp = _http(front.port, "/query",
+                           {"sql": "select 1 as one"})
+        assert code == 401
+        code, resp = _http(front.port, "/query",
+                           {"sql": "select 1 as one"}, token="sekrit")
+        assert code == 200 and resp["rows"] == [[1]]
+    finally:
+        front.stop()
+
+
+# -- Kafka (v0 wire, hand-rolled client) ------------------------------------
+
+
+class KClient:
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port))
+        self.f = self.sock.makefile("rb")
+        self.corr = 0
+
+    def _call(self, api, body: bytes) -> "struct":
+        self.corr += 1
+        req = struct.pack("!hhi", api, 0, self.corr) + _s("test") + body
+        self.sock.sendall(struct.pack("!i", len(req)) + req)
+        (size,) = struct.unpack("!i", self.f.read(4))
+        resp = self.f.read(size)
+        (corr,) = struct.unpack_from("!i", resp, 0)
+        assert corr == self.corr
+        from ydb_tpu.server.kafka import _Reader
+        r = _Reader(resp)
+        r.i32()
+        return r
+
+    def close(self):
+        self.sock.close()
+
+
+def _s(v):
+    b = v.encode()
+    return struct.pack("!h", len(b)) + b
+
+
+def _bts(v):
+    if v is None:
+        return struct.pack("!i", -1)
+    return struct.pack("!i", len(v)) + v
+
+
+def _msg_set(kvs):
+    import zlib
+    out = b""
+    for (k, v) in kvs:
+        body = struct.pack("!bb", 0, 0) + _bts(k) + _bts(v)
+        msg = struct.pack("!I", zlib.crc32(body)) + body
+        out += struct.pack("!qi", 0, len(msg)) + msg
+    return out
+
+
+def test_kafka_produce_fetch_roundtrip(eng):
+    eng.create_topic("ktopic", partitions=2)
+    front = serve_kafka(eng, port=0)
+    c = KClient(front.port)
+    try:
+        # ApiVersions
+        r = c._call(18, b"")
+        assert r.i16() == 0 and r.i32() >= 5
+        # Metadata
+        r = c._call(3, struct.pack("!i", 1) + _s("ktopic"))
+        assert r.i32() == 1                      # brokers
+        r.i32(); r.string(); r.i32()             # broker 0
+        assert r.i32() == 1                      # topics
+        assert r.i16() == 0 and r.string() == "ktopic"
+        assert r.i32() == 2                      # partitions
+        # Produce two messages into partition 1
+        mset = _msg_set([(b"k1", b"hello"), (None, b"world")])
+        body = struct.pack("!hi", 1, 1000)
+        body += struct.pack("!i", 1) + _s("ktopic")
+        body += struct.pack("!i", 1) + struct.pack("!i", 1)
+        body += struct.pack("!i", len(mset)) + mset
+        r = c._call(0, body)
+        assert r.i32() == 1 and r.string() == "ktopic"
+        assert r.i32() == 1
+        pid, err, off = r.i32(), r.i16(), r.i64()
+        assert (pid, err, off) == (1, 0, 0)
+        # ListOffsets: latest on partition 1 is 2
+        body = struct.pack("!i", -1) + struct.pack("!i", 1) + _s("ktopic")
+        body += struct.pack("!i", 1) + struct.pack("!iqi", 1, -1, 1)
+        r = c._call(2, body)
+        r.i32(); r.string(); r.i32()
+        pid, err, n = r.i32(), r.i16(), r.i32()
+        assert (err, n) == (0, 1) and r.i64() == 2
+        # Fetch from offset 0
+        body = struct.pack("!iii", -1, 100, 0) + struct.pack("!i", 1)
+        body += _s("ktopic") + struct.pack("!i", 1)
+        body += struct.pack("!iqi", 1, 0, 1 << 20)
+        r = c._call(1, body)
+        r.i32(); r.string(); r.i32()
+        pid, err, hw, sz = r.i32(), r.i16(), r.i64(), r.i32()
+        assert (pid, err, hw) == (1, 0, 2)
+        from ydb_tpu.server.kafka import _parse_message_set
+        msgs = _parse_message_set(r.d[r.o:r.o + sz])
+        assert msgs == [(b"k1", b"hello"), (None, b"world")]
+    finally:
+        c.close()
+        front.stop()
+
+
+def test_kafka_interops_with_native_consumers(eng):
+    """Kafka-produced records are ordinary topic records: native reads
+    see them, and native writes are fetchable over Kafka."""
+    t = eng.create_topic("mix", partitions=1)
+    front = serve_kafka(eng, port=0)
+    c = KClient(front.port)
+    try:
+        mset = _msg_set([(None, b'{"from": "kafka"}')])
+        body = struct.pack("!hi", 1, 1000) + struct.pack("!i", 1)
+        body += _s("mix") + struct.pack("!i", 1) + struct.pack("!i", 0)
+        body += struct.pack("!i", len(mset)) + mset
+        c._call(0, body)
+        t.write({"from": "native"})
+        # native consumer sees both
+        recs = t.read("c1", 0, limit=10)
+        assert len(recs) == 2
+        assert base64.b64decode(recs[0]["data"]["v"]) \
+            == b'{"from": "kafka"}'
+        assert recs[1]["data"] == {"from": "native"}
+        # Kafka fetch sees both (native record JSON-serialized)
+        body = struct.pack("!iii", -1, 100, 0) + struct.pack("!i", 1)
+        body += _s("mix") + struct.pack("!i", 1)
+        body += struct.pack("!iqi", 0, 0, 1 << 20)
+        r = c._call(1, body)
+        r.i32(); r.string(); r.i32()
+        _pid, _err, hw, sz = r.i32(), r.i16(), r.i64(), r.i32()
+        from ydb_tpu.server.kafka import _parse_message_set
+        msgs = _parse_message_set(r.d[r.o:r.o + sz])
+        assert hw == 2 and len(msgs) == 2
+        assert msgs[0][1] == b'{"from": "kafka"}'
+        assert json.loads(msgs[1][1]) == {"from": "native"}
+    finally:
+        c.close()
+        front.stop()

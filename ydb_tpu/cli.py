@@ -31,17 +31,33 @@ def cmd_server(args) -> int:
     server, port = serve(eng, port=args.port)
     print(f"ydb_tpu server listening on 127.0.0.1:{port} "
           f"(data_dir={args.data_dir})", flush=True)
-    pg = None
-    if args.pg_port is not None:
-        from ydb_tpu.server.pgwire import serve_pg
-        pg = serve_pg(eng, port=args.pg_port)
-        print(f"pgwire listening on 127.0.0.1:{pg.port}", flush=True)
+    fronts = []
     try:
+        if args.pg_port is not None:
+            from ydb_tpu.server.pgwire import serve_pg
+            pg = serve_pg(eng, port=args.pg_port)
+            fronts.append(pg)
+            print(f"pgwire listening on 127.0.0.1:{pg.port}", flush=True)
+        if args.http_port is not None:
+            from ydb_tpu.server.http import serve_http
+            h = serve_http(eng, port=args.http_port)
+            fronts.append(h)
+            print(f"http listening on 127.0.0.1:{h.port}", flush=True)
+        if args.kafka_port is not None:
+            from ydb_tpu.server.kafka import serve_kafka
+            k = serve_kafka(eng, port=args.kafka_port, auto_create=True)
+            fronts.append(k)
+            print(f"kafka listening on 127.0.0.1:{k.port}", flush=True)
         server.wait_for_termination()
     except KeyboardInterrupt:
+        pass
+    finally:
+        # a bind failure in a LATER front must not leave the gRPC server
+        # (non-daemon threads) holding the process open with nothing
+        # serving what was asked
         server.stop(grace=1)
-        if pg is not None:
-            pg.stop()
+        for fr in fronts:
+            fr.stop()
     return 0
 
 
@@ -158,6 +174,10 @@ def main(argv=None) -> int:
     ps.add_argument("--port", type=int, default=2136)
     ps.add_argument("--pg-port", type=int, default=None,
                     help="also serve the PostgreSQL wire protocol")
+    ps.add_argument("--http-port", type=int, default=None,
+                    help="also serve the HTTP/JSON API")
+    ps.add_argument("--kafka-port", type=int, default=None,
+                    help="also serve the Kafka wire protocol (topics)")
     ps.add_argument("--data-dir", default=None)
     ps.set_defaults(fn=cmd_server)
 

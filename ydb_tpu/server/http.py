@@ -1,0 +1,91 @@
+"""HTTP/JSON front — the reference's HTTP proxy seat.
+
+The reference serves HTTP next to gRPC (`ydb/core/http_proxy`, the
+serverless YDB JSON API + monitoring endpoints). This front exposes the
+same engine over plain HTTP so curl-class clients need no gRPC stack:
+
+  POST /query      {"sql": "...", "session_id": "...?"}
+                   → {"columns": [...], "rows": [[...]], "stats": {...}}
+  GET  /health     → the same payload as the gRPC Health RPC
+  GET  /counters   → {"counters": {...}} (monitoring scrape endpoint)
+  GET  /ready      → 200 "ok" (liveness probe)
+
+Bearer auth mirrors the gRPC front: `Authorization: Bearer <token>`
+when the server was started with one. Statement semantics (sessions,
+transactions, concurrency) are the engine's own — this is a thin
+protocol adapter over exactly the code path the gRPC servicer uses."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class HttpFront:
+    def __init__(self, engine, port: int = 0, host: str = "127.0.0.1",
+                 token: str = ""):
+        from ydb_tpu.server.service import QueryServicer
+        servicer = QueryServicer(engine, token=token)
+        front = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # noqa: N802 — stdlib name
+                pass
+
+            def _send(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _token(self) -> str:
+                auth = self.headers.get("Authorization", "")
+                return auth[7:] if auth.startswith("Bearer ") else ""
+
+            def do_GET(self):               # noqa: N802 — stdlib name
+                if self.path == "/ready":
+                    self._send(200, {"ok": True})
+                elif self.path == "/health":
+                    self._send(200, servicer.health({}, None))
+                elif self.path == "/counters":
+                    resp = servicer.counters({"token": self._token()},
+                                             None)
+                    self._send(401 if "error" in resp else 200, resp)
+                else:
+                    self._send(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):              # noqa: N802 — stdlib name
+                if self.path != "/query":
+                    self._send(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"bad request: {e}"})
+                    return
+                req["token"] = self._token()
+                resp = servicer.execute_query(req, None)
+                if "error" in resp:
+                    code = 401 if "Unauthenticated" in resp["error"] \
+                        else 400
+                    self._send(code, resp)
+                else:
+                    self._send(200, resp)
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def serve_http(engine, port: int = 0, token: str = "") -> HttpFront:
+    return HttpFront(engine, port=port, token=token)

@@ -1,0 +1,324 @@
+"""Kafka wire-protocol front over the topic subsystem (v0 subset).
+
+The reference serves the Kafka protocol next to its own fronts
+(`ydb/core/kafka_proxy` — clients produce/consume YDB topics with stock
+Kafka drivers). This front speaks the classic v0 protocol generation —
+ApiVersions, Metadata, Produce, Fetch, and ListOffsets over CRC-framed
+MessageSets — mapped onto `storage/topic.py`: a Kafka topic IS an
+engine topic, a Kafka partition IS a topic partition, offsets are the
+partition's record offsets. Message key/value bytes ride base64 inside
+the topic's JSON-over-WAL records, so Kafka-produced data is durable
+and replayable like any native producer's, and native consumers (CDC
+readers, trace sinks) see Kafka-produced records and vice versa.
+
+Scope v1: magic-0 messages (no compression, no record batches, no
+consumer groups — clients manage offsets with ListOffsets/Fetch, the
+simple-consumer pattern)."""
+
+from __future__ import annotations
+
+import base64
+import socket
+import socketserver
+import struct
+import threading
+import zlib
+
+API_PRODUCE, API_FETCH, API_LIST_OFFSETS, API_METADATA = 0, 1, 2, 3
+API_VERSIONS = 18
+ERR_NONE, ERR_UNKNOWN_TOPIC, ERR_OFFSET_OUT_OF_RANGE = 0, 3, 1
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.d = data
+        self.o = 0
+
+    def i8(self):
+        v = struct.unpack_from("!b", self.d, self.o)[0]
+        self.o += 1
+        return v
+
+    def i16(self):
+        v = struct.unpack_from("!h", self.d, self.o)[0]
+        self.o += 2
+        return v
+
+    def i32(self):
+        v = struct.unpack_from("!i", self.d, self.o)[0]
+        self.o += 4
+        return v
+
+    def i64(self):
+        v = struct.unpack_from("!q", self.d, self.o)[0]
+        self.o += 8
+        return v
+
+    def string(self):
+        n = self.i16()
+        if n < 0:
+            return None
+        s = self.d[self.o:self.o + n].decode()
+        self.o += n
+        return s
+
+    def bytes_(self):
+        n = self.i32()
+        if n < 0:
+            return None
+        b = self.d[self.o:self.o + n]
+        self.o += n
+        return b
+
+
+def _s(v) -> bytes:
+    if v is None:
+        return struct.pack("!h", -1)
+    b = v.encode()
+    return struct.pack("!h", len(b)) + b
+
+
+def _b(v) -> bytes:
+    if v is None:
+        return struct.pack("!i", -1)
+    return struct.pack("!i", len(v)) + v
+
+
+def _message(key, value) -> bytes:
+    """One magic-0 message: crc | magic | attrs | key | value."""
+    body = struct.pack("!bb", 0, 0) + _b(key) + _b(value)
+    return struct.pack("!I", zlib.crc32(body)) + body
+
+
+def _message_set(records: list) -> bytes:
+    out = []
+    for rec in records:
+        data = rec.get("data")
+        key, value = _rec_kv(data)
+        msg = _message(key, value)
+        out.append(struct.pack("!qi", rec["offset"], len(msg)) + msg)
+    return b"".join(out)
+
+
+def _rec_kv(data):
+    """Topic record payload → (key bytes|None, value bytes). Kafka-
+    produced records carry {"k": b64|None, "v": b64}; native records
+    (CDC, traces, dict payloads) serialize as JSON values."""
+    if isinstance(data, dict) and set(data) <= {"k", "v"} and "v" in data:
+        key = base64.b64decode(data["k"]) if data.get("k") else None
+        return key, base64.b64decode(data["v"])
+    import json
+    return None, json.dumps(data).encode()
+
+
+def _parse_message_set(d: bytes) -> list:
+    """MessageSet bytes → [(key, value)] (magic 0, uncompressed)."""
+    out = []
+    o = 0
+    while o + 12 <= len(d):
+        (_off, sz) = struct.unpack_from("!qi", d, o)
+        o += 12
+        if o + sz > len(d):
+            break                         # partial trailing message
+        r = _Reader(d[o:o + sz])
+        o += sz
+        r.i32()                           # crc (recomputed on emit)
+        r.i8()                            # magic
+        r.i8()                            # attributes
+        key = r.bytes_()
+        value = r.bytes_()
+        out.append((key, value))
+    return out
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        sock: socket.socket = self.request
+        srv: "KafkaFront" = self.server.owner   # type: ignore[attr-defined]
+        f = sock.makefile("rb")
+        try:
+            while True:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    return
+                (size,) = struct.unpack("!i", hdr)
+                body = f.read(size)
+                if len(body) < size:
+                    return
+                r = _Reader(body)
+                api, ver = r.i16(), r.i16()
+                corr = r.i32()
+                r.string()                 # client_id
+                try:
+                    payload = srv._dispatch(api, ver, r)
+                except Exception as e:     # noqa: BLE001 — wire boundary
+                    srv.errors.append(f"{type(e).__name__}: {e}")
+                    return
+                resp = struct.pack("!i", corr) + payload
+                sock.sendall(struct.pack("!i", len(resp)) + resp)
+        except (ConnectionError, BrokenPipeError):
+            pass
+        finally:
+            sock.close()
+
+
+class KafkaFront:
+    """Kafka v0 listener over an engine's topics."""
+
+    def __init__(self, engine, port: int = 0, host: str = "127.0.0.1",
+                 auto_create: bool = False):
+        self.engine = engine
+        self.auto_create = auto_create
+        self.errors: list = []
+        self.host = host
+
+        class _TCP(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+        self._tcp = _TCP((host, port), _Handler)
+        self._tcp.owner = self             # type: ignore[attr-defined]
+        self.port = self._tcp.server_address[1]
+        self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # -- request handlers --------------------------------------------------
+
+    def _topic(self, name: str):
+        t = self.engine.topics.get(name)
+        if t is None and self.auto_create:
+            t = self.engine.create_topic(name, partitions=1)
+        return t
+
+    def _dispatch(self, api: int, ver: int, r: _Reader) -> bytes:
+        if api == API_VERSIONS:
+            keys = [(API_PRODUCE, 0, 0), (API_FETCH, 0, 0),
+                    (API_LIST_OFFSETS, 0, 0), (API_METADATA, 0, 0),
+                    (API_VERSIONS, 0, 0)]
+            out = struct.pack("!hi", ERR_NONE, len(keys))
+            for (k, lo, hi) in keys:
+                out += struct.pack("!hhh", k, lo, hi)
+            return out
+        if api == API_METADATA:
+            n = r.i32()
+            names = [r.string() for _ in range(n)] if n > 0 \
+                else sorted(self.engine.topics)
+            out = struct.pack("!i", 1)                 # one broker
+            out += struct.pack("!i", 0) + _s(self.host) \
+                + struct.pack("!i", self.port)
+            out += struct.pack("!i", len(names))
+            for name in names:
+                t = self._topic(name)
+                if t is None:
+                    out += struct.pack("!h", ERR_UNKNOWN_TOPIC) + _s(name)
+                    out += struct.pack("!i", 0)
+                    continue
+                out += struct.pack("!h", ERR_NONE) + _s(name)
+                out += struct.pack("!i", len(t.partitions))
+                for pid in range(len(t.partitions)):
+                    out += struct.pack("!hii", ERR_NONE, pid, 0)
+                    out += struct.pack("!ii", 1, 0)    # replicas = [0]
+                    out += struct.pack("!ii", 1, 0)    # isr = [0]
+            return out
+        if api == API_PRODUCE:
+            r.i16()                                    # acks
+            r.i32()                                    # timeout
+            out_topics = []
+            for _ in range(r.i32()):
+                name = r.string()
+                parts = []
+                for _ in range(r.i32()):
+                    pid = r.i32()
+                    sz = r.i32()
+                    mset = r.d[r.o:r.o + sz]
+                    r.o += sz
+                    t = self._topic(name)
+                    if t is None:
+                        parts.append((pid, ERR_UNKNOWN_TOPIC, -1))
+                        continue
+                    base = None
+                    for (key, value) in _parse_message_set(mset):
+                        rec = {"v": base64.b64encode(value or b"")
+                               .decode()}
+                        if key is not None:
+                            rec["k"] = base64.b64encode(key).decode()
+                        _p, off = t.write(rec, partition=pid)
+                        if base is None:
+                            base = off
+                    parts.append((pid, ERR_NONE,
+                                  -1 if base is None else base))
+                out_topics.append((name, parts))
+            out = struct.pack("!i", len(out_topics))
+            for (name, parts) in out_topics:
+                out += _s(name) + struct.pack("!i", len(parts))
+                for (pid, err, off) in parts:
+                    out += struct.pack("!ihq", pid, err, off)
+            return out
+        if api == API_LIST_OFFSETS:
+            r.i32()                                    # replica_id
+            out_topics = []
+            for _ in range(r.i32()):
+                name = r.string()
+                parts = []
+                for _ in range(r.i32()):
+                    pid = r.i32()
+                    ts = r.i64()                       # -1 latest, -2 first
+                    r.i32()                            # max offsets
+                    t = self._topic(name)
+                    if t is None or pid >= len(t.partitions):
+                        parts.append((pid, ERR_UNKNOWN_TOPIC, []))
+                        continue
+                    end = t.partitions[pid].end_offset
+                    parts.append((pid, ERR_NONE,
+                                  [0] if ts == -2 else [end]))
+                out_topics.append((name, parts))
+            out = struct.pack("!i", len(out_topics))
+            for (name, parts) in out_topics:
+                out += _s(name) + struct.pack("!i", len(parts))
+                for (pid, err, offs) in parts:
+                    out += struct.pack("!ihi", pid, err, len(offs))
+                    for off in offs:
+                        out += struct.pack("!q", off)
+            return out
+        if api == API_FETCH:
+            r.i32()                                    # replica_id
+            r.i32()                                    # max_wait
+            r.i32()                                    # min_bytes
+            out_topics = []
+            for _ in range(r.i32()):
+                name = r.string()
+                parts = []
+                for _ in range(r.i32()):
+                    pid = r.i32()
+                    fetch_off = r.i64()
+                    max_bytes = r.i32()
+                    t = self._topic(name)
+                    if t is None or pid >= len(t.partitions):
+                        parts.append((pid, ERR_UNKNOWN_TOPIC, 0, b""))
+                        continue
+                    part = t.partitions[pid]
+                    if fetch_off > part.end_offset:
+                        parts.append((pid, ERR_OFFSET_OUT_OF_RANGE,
+                                      part.end_offset, b""))
+                        continue
+                    recs = part.read(fetch_off, limit=1000)
+                    mset = _message_set(recs)[:max(max_bytes, 0)]
+                    parts.append((pid, ERR_NONE, part.end_offset, mset))
+                out_topics.append((name, parts))
+            out = struct.pack("!i", len(out_topics))
+            for (name, parts) in out_topics:
+                out += _s(name) + struct.pack("!i", len(parts))
+                for (pid, err, hw, mset) in parts:
+                    out += struct.pack("!ihqi", pid, err, hw, len(mset))
+                    out += mset
+            return out
+        raise ValueError(f"unsupported api key {api}")
+
+
+def serve_kafka(engine, port: int = 0, auto_create: bool = False
+                ) -> KafkaFront:
+    return KafkaFront(engine, port=port, auto_create=auto_create)

@@ -129,3 +129,63 @@ def test_unsupported_spec_falls_back(engines):
     assert np.allclose(got.m.to_numpy(np.float64, na_value=np.nan),
                        want.m.to_numpy(np.float64, na_value=np.nan),
                        equal_nan=True)
+
+
+FINAL_CASES = [
+    # order by window output desc + passthrough tiebreak
+    "select k, g, sum(v) over (partition by g order by k) as rs from w "
+    "order by rs desc, k limit 37",
+    # order by passthrough (nullable double!) asc — engine NULLS FIRST
+    "select k, v, row_number() over (partition by g order by k) as rn "
+    "from w order by v, k limit 25",
+    # string passthrough order key + offset
+    "select k, tag, rank() over (partition by tag order by d) as rk "
+    "from w order by tag desc, k limit 19 offset 7",
+    # multi-key: window output asc + string + desc int
+    "select k, tag, d, lag(v) over (partition by g order by k) as pv "
+    "from w order by d desc, tag, k limit 11",
+]
+
+
+@pytest.mark.parametrize("case", range(len(FINAL_CASES)))
+def test_device_final_sort_limit(engines, case):
+    """The ORDER BY + LIMIT pushdown (r5 egress lever) must agree with
+    the host tail exactly — including NULL placement and offsets."""
+    dev, host = engines
+    sql = FINAL_CASES[case]
+    before = GLOBAL.get("engine/window_device_rows")
+    push0 = GLOBAL.get("engine/window_device_pushdown")
+    got = dev.query(sql)
+    assert GLOBAL.get("engine/window_device_rows") > before
+    assert GLOBAL.get("engine/window_device_pushdown") > push0, \
+        "ORDER BY/LIMIT pushdown did not engage"
+    want = host.query(sql)
+    assert list(got.columns) == list(want.columns)
+    assert len(got) == len(want)
+    for c in got.columns:
+        a, b = got[c], want[c]
+        if not (pd.api.types.is_numeric_dtype(a)
+                and pd.api.types.is_numeric_dtype(b)):
+            assert [x if isinstance(x, str) else None for x in a] \
+                == [x if isinstance(x, str) else None for x in b], c
+        else:
+            an = a.to_numpy(np.float64, na_value=np.nan)
+            bn = b.to_numpy(np.float64, na_value=np.nan)
+            assert np.allclose(an, bn, rtol=1e-9, equal_nan=True), \
+                (c, an[:8], bn[:8])
+
+
+def test_final_sort_string_window_output(engines):
+    """ORDER BY a string-valued window output (lag of a dict column):
+    must sort LEXICOGRAPHICALLY, not by dictionary insertion codes
+    (review r5) — and NULLs take the engine's null-smallest placement."""
+    dev, host = engines
+    sql = ("select k, lag(tag) over (partition by g order by k) as pt "
+           "from w order by pt desc, k limit 23")
+    push0 = GLOBAL.get("engine/window_device_pushdown")
+    got = dev.query(sql)
+    assert GLOBAL.get("engine/window_device_pushdown") > push0
+    want = host.query(sql)
+    assert [x if isinstance(x, str) else None for x in got.pt] \
+        == [x if isinstance(x, str) else None for x in want.pt]
+    assert list(got.k) == list(want.k)

@@ -94,6 +94,46 @@ def _encode_part_host(block, names):
     return out
 
 
+def _final_key_ok(cd) -> bool:
+    d = cd.data
+    return (cd.dictionary is not None
+            or np.issubdtype(d.dtype, np.floating)
+            or np.issubdtype(d.dtype, np.integer)
+            or d.dtype == np.bool_)
+
+
+def _encode_final_key(cd, ascending):
+    """Final ORDER BY key → order-preserving operand with the ENGINE's
+    NULL placement (YQL null-smallest: first when ascending, last when
+    descending — matching `apply_order_limit`'s defaults)."""
+    d = cd.data
+    if cd.dictionary is not None:
+        ranks = cd.dictionary.sort_ranks()
+        enc = ranks[np.clip(d, 0, None)].astype(np.int64)
+        enc = np.where(d < 0, 0, enc)
+        valid = (d >= 0) if cd.valid is None else (cd.valid & (d >= 0))
+    else:
+        valid = cd.valid
+        if np.issubdtype(d.dtype, np.floating):
+            enc = d.astype(np.float64)
+            if not ascending:
+                enc = -enc
+            if valid is not None:
+                enc = np.where(valid, enc,
+                               -np.inf if ascending else np.inf)
+            return np.where(np.isnan(enc),
+                            -np.inf if ascending else np.inf, enc)
+        elif np.issubdtype(d.dtype, np.integer) or d.dtype == np.bool_:
+            enc = d.astype(np.int64)
+        else:
+            return None
+    enc = enc if ascending else -enc
+    if valid is not None:
+        sent = np.iinfo(np.int64).min if ascending else _I64MAX
+        enc = np.where(valid, enc, sent)
+    return enc
+
+
 def _encode_order_host(block, name, ascending):
     """One order key → an order-preserving f64/i64 array with NULLs
     mapped last (pandas na_position='last' parity)."""
@@ -298,7 +338,63 @@ def _build_window_fn(struct):
                 else:                     # avg
                     a = ssum.astype(jnp.float64) / jnp.maximum(scnt, 1)
                     outs[spec["alias"]] = (unsort(a), unsort(scnt > 0))
-        return outs
+
+        fin = struct.get("final")
+        if fin is None:
+            return outs
+        # final ORDER BY + LIMIT device-side: one more sort (keys +
+        # row id), then every output leaves sliced to K rows
+        ops_l = [jnp.where(active, jnp.int64(0), jnp.int64(1))]
+        for key_spec in fin["keys"]:
+            src, name, asc = key_spec[0], key_spec[1], key_spec[2]
+            if src == "col":
+                ops_l.append(inputs[name])
+                continue
+            if src == "winstr":
+                # string window output: sort by lexicographic rank LUT;
+                # NULL (code < 0 or invalid) takes the engine's
+                # null-smallest placement
+                v, vv = outs[name]
+                ranks = inputs[key_spec[3]]
+                code = v.astype(jnp.int64)
+                enc = ranks[jnp.clip(code, 0, ranks.shape[0] - 1)]
+                invalid = code < 0
+                if vv is not None:
+                    invalid = invalid | ~vv
+                enc = enc if asc else -enc
+                sent = jnp.int64(np.iinfo(np.int64).min) if asc \
+                    else jnp.int64(_I64MAX)
+                ops_l.append(jnp.where(invalid, sent, enc))
+                continue
+            v, vv = outs[name]
+            enc = v.astype(jnp.int64) if v.dtype == jnp.bool_ else v
+            if jnp.issubdtype(enc.dtype, jnp.floating):
+                enc = enc if asc else -enc
+                if vv is not None:
+                    enc = jnp.where(vv, enc,
+                                    -jnp.inf if asc else jnp.inf)
+            else:
+                enc = enc.astype(jnp.int64)
+                enc = enc if asc else -enc
+                if vv is not None:
+                    sent = jnp.int64(np.iinfo(np.int64).min) if asc \
+                        else jnp.int64(_I64MAX)
+                    enc = jnp.where(vv, enc, sent)
+            ops_l.append(enc)
+        ops_l.append(iota)
+        sout = jax.lax.sort(tuple(ops_l), num_keys=len(ops_l) - 1)
+        perm_f = sout[-1][:fin["K"]]
+        n_out = jnp.minimum(L, jnp.int64(fin["K"]))
+        final_outs = {}
+        for alias, (v, vv) in outs.items():
+            final_outs[alias] = (v[perm_f],
+                                 None if vv is None else vv[perm_f])
+        for name in fin["pass_cols"]:
+            v = inputs[f"out_{name}"][perm_f]
+            vvin = inputs.get(f"outv_{name}")
+            final_outs[name] = (v, None if vvin is None
+                                else vvin[perm_f])
+        return final_outs, n_out
 
     return fn
 
@@ -319,10 +415,19 @@ def _fn_cache():
 # ---------------------------------------------------------------------------
 
 
-def compute_windows_device(block, outer):
+def compute_windows_device(block, outer, final_sort=None, limit=None,
+                           offset=0):
     """Evaluate every window spec of `outer` on device. Returns
     {alias: (np values, np valid|None)} or None when any spec (or key
-    encoding) requires the host lane."""
+    encoding) requires the host lane.
+
+    `final_sort`/`limit`: when given ([(name, ascending, win_output?)],
+    row limit), the program ALSO sorts the full result by those keys and
+    slices to offset+limit rows device-side before transfer — the
+    output egress is then O(limit) instead of O(rows) for EVERY column
+    (the D2H link is the dominant window cost post-readout, PERF.md r5).
+    Returns ({alias_or_col: (values, valid|None, dict|None)}, n_rows)
+    in that mode, covering passthrough columns too."""
     from ydb_tpu.ops.device import bucket_capacity
 
     specs = [p for k, p in outer if k == "win"]
@@ -331,6 +436,20 @@ def compute_windows_device(block, outer):
     for s in specs:
         if not spec_supported(s, block):
             return None
+
+    # pre-validate the final-sort keys BEFORE any encoding/upload work:
+    # an ineligible key must cost a cheap decline, not a fully-prepared
+    # program thrown away (review r5)
+    win_aliases_pre = {s["alias"] for s in specs}
+    if final_sort is not None and limit is not None:
+        for (name, _asc) in final_sort:
+            if name in win_aliases_pre:
+                continue
+            cd = block.columns.get(name)
+            if cd is None or not _final_key_ok(cd):
+                return None
+    else:
+        final_sort = None             # offset/limit without both: plain
 
     # group by sort clause; build the static structure + input arrays
     groups: dict = {}
@@ -374,10 +493,10 @@ def compute_windows_device(block, outer):
             fn = s["func"]
             has_arg = bool(s["args"]) and not (
                 fn == "count" and not s["args"])
-            offset = 1
+            off_n = 1
             if fn in ("lead", "lag") and len(s["args"]) > 1:
                 off_cd = block.columns[s["args"][1]]
-                offset = int(off_cd.data[0])
+                off_n = int(off_cd.data[0])
                 if not (off_cd.data[:L] == off_cd.data[0]).all():
                     return None       # non-constant offset: host lane
             if has_arg:
@@ -396,18 +515,67 @@ def compute_windows_device(block, outer):
                 "func": fn, "frame": s.get("frame"),
                 "has_arg": has_arg,
                 "running": bool(s["order"]),
-                "offset": offset, "alias": s["alias"],
+                "offset": off_n, "alias": s["alias"],
                 "dict": (block.columns[s["args"][0]].dictionary
                          if has_arg and fn in ("lead", "lag") else None),
             })
         struct["groups"].append({
             "n_part_ops": pi, "n_order": len(onames), "specs": sspecs})
 
+    # final ORDER BY + LIMIT pushed into the program: passthrough
+    # columns upload once, every output leaves the device sliced to
+    # offset+limit rows
+    win_aliases = {s["alias"] for s in specs}
+    if final_sort is not None:
+        K = min(int(offset) + int(limit), cap)
+        dict_of_alias = {s2["alias"]: s2["dict"]
+                         for g in struct["groups"] for s2 in g["specs"]}
+        fkeys = []
+        for fi, (name, ascending) in enumerate(final_sort):
+            if name in win_aliases:
+                dic = dict_of_alias.get(name)
+                if dic is not None:
+                    # string-valued window output (lead/lag of a dict
+                    # column): sort by LEXICOGRAPHIC rank, not raw
+                    # insertion-order codes — ranks upload as a LUT the
+                    # program gathers through
+                    ranks = dic.sort_ranks().astype(np.int64)
+                    inputs[f"frank{fi}"] = jnp.asarray(
+                        ranks if len(ranks) else np.zeros(1, np.int64))
+                    fkeys.append(("winstr", name, ascending,
+                                  f"frank{fi}"))
+                else:
+                    fkeys.append(("win", name, ascending))
+            else:
+                cd = block.columns.get(name)
+                if cd is None:
+                    return None
+                enc = _encode_final_key(cd, ascending)
+                if enc is None:
+                    return None
+                inputs[f"fs{fi}"] = up(
+                    enc, fill=np.inf if enc.dtype == np.float64
+                    else _I64MAX)
+                fkeys.append(("col", f"fs{fi}", ascending))
+        pass_cols = [p for k2, p in outer if k2 == "col"]
+        pass_dicts = {}
+        for name in pass_cols:
+            cd = block.columns[name]
+            d = cd.data
+            inputs[f"out_{name}"] = up(d)
+            if cd.valid is not None:
+                inputs[f"outv_{name}"] = up(cd.valid, fill=False)
+            if cd.dictionary is not None:
+                pass_dicts[name] = cd.dictionary
+        struct["final"] = {"keys": fkeys, "K": K,
+                           "pass_cols": list(pass_cols)}
+
     skey = (cap, repr([(g["n_part_ops"], g["n_order"],
                         [(s["func"], s["frame"], s["has_arg"],
                           s["running"], s["offset"], s["alias"])
                          for s in g["specs"]])
                        for g in struct["groups"]]),
+            repr(struct.get("final")),
             tuple(sorted((k, str(v.dtype)) for k, v in inputs.items()
                          if hasattr(v, "dtype"))))
     cache = _fn_cache()
@@ -415,12 +583,24 @@ def compute_windows_device(block, outer):
     if fn is None:
         fn = _build_window_fn(struct)
         cache[skey] = fn
+    dicts = {s2["alias"]: s2["dict"]
+             for g in struct["groups"] for s2 in g["specs"]}
+    if struct.get("final") is not None:
+        dev, n_dev = fn(inputs)
+        host, n = jax.device_get((dev, n_dev))
+        n = int(n)
+        dicts.update(pass_dicts)
+        out = {}
+        for name, (vals, valid) in host.items():
+            out[name] = (np.asarray(vals)[:n],
+                         None if valid is None
+                         else np.asarray(valid)[:n],
+                         dicts.get(name))
+        return out, n
     dev = fn(inputs)
     host = jax.device_get(dev)
 
     out = {}
-    dicts = {s2["alias"]: s2["dict"]
-             for g in struct["groups"] for s2 in g["specs"]}
     for alias, (vals, valid) in host.items():
         out[alias] = (np.asarray(vals)[:L],
                       None if valid is None else np.asarray(valid)[:L],

@@ -823,8 +823,25 @@ class QueryEngine:
             raise QueryError(str(e)) from e
         inner_block = self._run_select(inner, snap)
         df = None
-        if self.config.flag("enable_device_windows") \
-                and inner_block.length >= self.config.window_device_min_rows:
+        device_ok = self.config.flag("enable_device_windows") \
+            and inner_block.length >= self.config.window_device_min_rows
+        if device_ok and post is None and not sel.distinct \
+                and sel.limit is not None:
+            # final ORDER BY + LIMIT pushable: every output leaves the
+            # device sliced to offset+limit rows (O(rows) egress was the
+            # dominant window cost — PERF.md r5)
+            fs = self._final_sort_spec(sel, outer)
+            if fs is not None:
+                done = self._windows_on_device(inner_block, outer,
+                                               final_sort=fs,
+                                               limit=sel.limit,
+                                               offset=sel.offset or 0)
+                if done is not None:
+                    lo = sel.offset or 0
+                    return HostBlock.from_pandas(
+                        done.iloc[lo:lo + sel.limit]
+                        .reset_index(drop=True))
+        if device_ok:
             df = self._windows_on_device(inner_block, outer)
         if df is None:
             self._host_lane_guard(inner_block.length, "window")
@@ -869,39 +886,70 @@ class QueryEngine:
             raise QueryError(str(e)) from e
         return HostBlock.from_pandas(df)
 
-    def _windows_on_device(self, inner_block: HostBlock, outer):
+    def _final_sort_spec(self, sel, outer):
+        """[(output name, ascending)] when every ORDER BY key is a plain
+        output-column reference with default NULL placement; None
+        otherwise (the host tail handles the exotic cases)."""
+        names = set()
+        for kind, payload in outer:
+            names.add(payload if kind == "col" else payload["alias"])
+        fs = []
+        for o in sel.order_by:
+            if not isinstance(o.expr, ast.Name) \
+                    or o.expr.parts[-1] not in names \
+                    or o.nulls_first is not None:
+                return None
+            fs.append((o.expr.parts[-1], o.ascending))
+        return fs
+
+    def _windows_on_device(self, inner_block: HostBlock, outer,
+                           final_sort=None, limit=None, offset=0):
         """Device window lane (`ops/window_dev.py`): every spec computed
         in one scatter-free jitted program — sort, segment boundaries,
         prefix-scan formulas — with a single device→host transfer for
-        all outputs. Returns the assembled frame, or None when a spec
+        all outputs (sliced to offset+limit rows when the final sort
+        pushes down). Returns the assembled frame, or None when a spec
         requires the pandas lane (which then counts its host rows)."""
+        import pandas as pd
+
         from ydb_tpu.ops.window_dev import compute_windows_device
         from ydb_tpu.utils.metrics import GLOBAL
         try:
-            dev = compute_windows_device(inner_block, outer)
+            dev = compute_windows_device(inner_block, outer,
+                                         final_sort=final_sort,
+                                         limit=limit, offset=offset)
         except Exception:                # noqa: BLE001 — lane, not law
             GLOBAL.inc("engine/window_device_errors")
             return None
         if dev is None:
             return None
         GLOBAL.inc("engine/window_device_rows", inner_block.length)
-        import pandas as pd
+        if final_sort is not None:
+            GLOBAL.inc("engine/window_device_pushdown")
+
+        def series(vals, valid, dic):
+            if dic is not None:
+                s = pd.Series(dic.decode(vals), dtype=object)
+            else:
+                s = pd.Series(vals)
+            if valid is not None and not valid.all():
+                s = s.where(pd.Series(valid))
+            return s
+
+        if final_sort is not None:
+            sliced, _n = dev
+            cols = {}
+            for kind, payload in outer:
+                name = payload if kind == "col" else payload["alias"]
+                cols[name] = series(*sliced[name])
+            return pd.DataFrame(cols)
         base = inner_block.to_pandas()
         cols = {}
         for kind, payload in outer:
             if kind == "col":
                 cols[payload] = base[payload]
             else:
-                alias = payload["alias"]
-                vals, valid, dic = dev[alias]
-                if dic is not None:
-                    decoded = dic.decode(vals)
-                    s = pd.Series(decoded, dtype=object)
-                else:
-                    s = pd.Series(vals)
-                if valid is not None and not valid.all():
-                    s = s.where(pd.Series(valid))
-                cols[alias] = s
+                cols[payload["alias"]] = series(*dev[payload["alias"]])
         return pd.DataFrame(cols)
 
     def explain(self, sql: str) -> str:

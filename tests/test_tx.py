@@ -332,3 +332,59 @@ def test_read_watermark_interleaved_publishes(eng):
     assert coord.read_snapshot().plan_step == base   # v1 still applying
     coord.publish(v1.plan_step)
     assert coord.read_snapshot().plan_step == v2.plan_step
+
+
+def test_blind_upserts_disjoint_keys_commit(eng):
+    """pk-granular write locks (r5): two txs that only WRITE disjoint
+    keys of a row table must BOTH commit — the r4 table-granular lock
+    aborted the second spuriously."""
+    eng.execute("create table wkv (id Int64 not null, v Int64 not null, "
+                "primary key (id)) with (store = row)")
+    s1, s2 = eng.session(), eng.session()
+    s1.execute("begin")
+    s2.execute("begin")
+    s1.execute("upsert into wkv (id, v) values (1, 10), (2, 20)")
+    s2.execute("upsert into wkv (id, v) values (3, 30), (4, 40)")
+    s1.execute("commit")
+    s2.execute("commit")                 # disjoint keys: no conflict
+    df = eng.query("select count(*) as n, sum(v) as s from wkv")
+    assert int(df.n[0]) == 4 and int(df.s[0]) == 100
+
+
+def test_blind_upserts_same_key_conflict(eng):
+    """...but the SAME key still conflicts (write-write, exactly one
+    winner), and a reader tx still aborts on any foreign write."""
+    eng.execute("create table wk2 (id Int64 not null, v Int64 not null, "
+                "primary key (id)) with (store = row)")
+    eng.execute("insert into wk2 (id, v) values (7, 0)")
+    s1, s2 = eng.session(), eng.session()
+    s1.execute("begin")
+    s2.execute("begin")
+    s1.execute("upsert into wk2 (id, v) values (7, 1)")
+    s2.execute("upsert into wk2 (id, v) values (7, 2)")
+    s1.execute("commit")
+    with pytest.raises(QueryError, match="conflict|optimistic"):
+        s2.execute("commit")
+    assert int(eng.query("select v from wk2 where id = 7").v[0]) == 1
+    # read+write tx stays table-granular: foreign write → abort
+    s3 = eng.session()
+    s3.execute("begin")
+    s3.query("select count(*) as n from wk2")
+    eng.execute("upsert into wk2 (id, v) values (99, 9)")
+    s3.execute("upsert into wk2 (id, v) values (50, 5)")
+    with pytest.raises(QueryError, match="optimistic"):
+        s3.execute("commit")
+
+
+def test_insert_select_self_reference_stays_table_granular(eng):
+    """Review r5: INSERT ... SELECT reads its source — a tx doing the
+    self-referencing form must still abort on a foreign write."""
+    eng.execute("create table isr (id Int64 not null, v Int64 not null, "
+                "primary key (id)) with (store = row)")
+    eng.execute("insert into isr (id, v) values (1, 10), (2, 20)")
+    s = eng.session()
+    s.execute("begin")
+    s.execute("insert into isr (id, v) select id + 100, v from isr")
+    eng.execute("upsert into isr (id, v) values (2, 999)")   # foreign
+    with pytest.raises(QueryError, match="optimistic"):
+        s.execute("commit")

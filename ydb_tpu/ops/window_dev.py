@@ -127,6 +127,10 @@ def _encode_final_key(cd, ascending):
             enc = d.astype(np.int64)
         else:
             return None
+    # INT64_MIN cannot negate (wraps to itself) and collides with the
+    # ascending NULL sentinel — decline such rows to the host tail
+    if len(enc) and int(enc.min()) == np.iinfo(np.int64).min:
+        return None
     enc = enc if ascending else -enc
     if valid is not None:
         sent = np.iinfo(np.int64).min if ascending else _I64MAX

@@ -1342,7 +1342,13 @@ class QueryEngine:
     def _insert(self, stmt: ast.Insert, snap=None, tx=None) -> HostBlock:
         table = self._table(stmt.table)
         if tx is not None:
-            tx.lock(table)
+            # a blind VALUES insert/upsert only WRITES the target:
+            # pk-granular write locks (row stores) or commuting appends
+            # (column stores) — duplicate-pk races are caught by the
+            # point-conflict check at commit. INSERT ... SELECT may READ
+            # the target (self-reference) and its source reads aren't
+            # separately locked, so it keeps the table-granular lock.
+            tx.lock(table, read=stmt.query is not None)
         if stmt.query is not None:
             return self._insert_select(stmt, table, snap, tx)
         names = stmt.columns or table.schema.names
@@ -1453,7 +1459,10 @@ class QueryEngine:
         if tx is not None:
             table.apply(ops, None, durable=False, tx=tx.tx_id)
             tx.row_writes.append((table, ops))
-            tx.note_self_bump(table)
+            # pk-granular write lock: a tx that only WRITES this table
+            # validates point conflicts on these keys, not the whole
+            # table's data_version
+            tx.note_self_bump(table, write_pks=table.pks_of_ops(ops))
         else:
             with self._commit_step() as version:
                 table.apply(ops, version)

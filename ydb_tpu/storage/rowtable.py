@@ -264,6 +264,29 @@ class RowTable:
             self.changefeed.emit(ops, version)
         return len(appends)
 
+    def max_committed_step(self, pks) -> int:
+        """Highest committed plan step across the given pk chains — the
+        point-conflict probe for write-only optimistic validation."""
+        hi = 0
+        for pk in pks:
+            for (ver, _vals, _tx) in self.rows.get(pk, ()):
+                if ver is not None and ver.plan_step > hi:
+                    hi = ver.plan_step
+        return hi
+
+    def pks_of_ops(self, ops: list) -> set:
+        """Primary keys a mutation batch touches (encoded domain) —
+        only KEY columns encode (apply() already paid the full pass)."""
+        out = set()
+        for (_kind, vals) in ops:
+            try:
+                enc = {k: self._encode_value(k, vals[k])
+                       for k in self.key_columns if k in vals}
+                out.add(self._pk_of(enc))
+            except KeyError:
+                pass                   # malformed op: apply() will raise
+        return out
+
     def stamp_tx(self, tx: int, version: WriteVersion,
                  ops_for_wal: Optional[list] = None) -> None:
         """Commit an open transaction's entries at `version` — O(write

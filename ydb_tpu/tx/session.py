@@ -14,18 +14,24 @@ v0 semantics (snapshot isolation + table-granular optimistic locks):
   * writes stage against storage tagged with the tx id — row tables get
     unstamped version-chain entries, column tables uncommitted insert-table
     writes — invisible to every other session;
-  * each table read or written records (uid, data_version-at-snapshot) in
-    the lock set; because own staged writes bump data_version, the lock
-    remembers how many bumps were self-inflicted;
-  * COMMIT validates every lock (any foreign bump since BEGIN → TxAborted,
-    the optimistic-lock-broken error), then takes one coordinator plan
-    step and stamps all staged writes at it — atomically visible, since
+  * each table READ records (uid, data_version-at-snapshot) in the lock
+    set and validates TABLE-granular at commit (any foreign bump since
+    BEGIN → TxAborted); own staged writes bump data_version, so the
+    lock remembers how many bumps were self-inflicted;
+  * tables only ever WRITTEN validate finer (the row/range-lock
+    refinement of `ydb/core/tx/locks/`): row-store blind writes take
+    pk-granular write locks — commit aborts only when a foreign commit
+    newer than the snapshot touched one of OUR keys — and column-store
+    blind inserts are commuting appends (no conflict possible without a
+    read);
+  * COMMIT validates every lock, then takes one coordinator plan step
+    and stamps all staged writes at it — atomically visible, since
     readers order by plan step;
   * ROLLBACK (or abort) removes every staged write.
 
-Coarser than the reference's row/range locks (a foreign write to any READ
-table aborts), but sound: serializable over row tables, snapshot-write
-isolation over column tables.
+Reads stay table-granular (no predicate locks), which keeps the
+protocol sound: serializable over row tables, snapshot-write isolation
+over column tables.
 """
 
 from __future__ import annotations
@@ -51,24 +57,46 @@ class Transaction:
         self.begin_versions = begin_versions
         # uid -> [table, baseline version, self bumps since]
         self.locks: dict = {}
+        # READ-locked tables validate table-granular; tables only ever
+        # WRITTEN validate at pk granularity (row stores) or commute
+        # (column inserts are pure appends) — concurrent blind upserts
+        # to disjoint keys stop aborting spuriously (the row/range-lock
+        # refinement of `ydb/core/tx/locks/`, point-write granularity)
+        self.read_locked: set = set()
+        self.write_pks: dict = {}      # uid -> set of pk tuples
         self.row_writes: list = []     # (table, ops) in apply order
         self.col_writes: list = []     # (table, [(shard, wid)])
         self.col_deletes: list = []    # (table, [delete-mark handles])
 
-    def lock(self, table) -> None:
+    def lock(self, table, read: bool = True) -> None:
+        if read:
+            self.read_locked.add(table.uid)
         if table.uid not in self.locks:
             seen = self.begin_versions.get(table.uid, table.data_version)
             self.locks[table.uid] = [table, seen, 0]
 
-    def note_self_bump(self, table, n: int = 1) -> None:
-        self.lock(table)
+    def note_self_bump(self, table, n: int = 1,
+                       write_pks=None) -> None:
+        self.lock(table, read=False)
         self.locks[table.uid][2] += n
+        if write_pks is not None:
+            self.write_pks.setdefault(table.uid, set()).update(write_pks)
 
     def validate(self) -> None:
-        for table, seen, self_bumps in self.locks.values():
-            if table.data_version - self_bumps != seen:
+        for uid, (table, seen, self_bumps) in self.locks.items():
+            if uid in self.read_locked:
+                if table.data_version - self_bumps != seen:
+                    raise TxAborted(
+                        f"optimistic lock broken on table {table.name!r}")
+                continue
+            # write-only: point conflicts on the touched keys only
+            pks = self.write_pks.get(uid)
+            check = getattr(table, "max_committed_step", None)
+            if pks and check is not None \
+                    and check(pks) > self.snapshot.plan_step:
                 raise TxAborted(
-                    f"optimistic lock broken on table {table.name!r}")
+                    f"write-write conflict on table {table.name!r}")
+            # write-only column-table appends commute: no check
 
 
 class Session:

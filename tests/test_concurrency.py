@@ -40,7 +40,9 @@ def test_concurrent_selects_in_flight():
     active = [0]
     max_active = [0]
     mu = threading.Lock()
-    orig = eng.executor.execute
+    # the engine drives the pipelined seam now: SELECT dispatches go
+    # through execute_async (readout resolves the returned future)
+    orig = eng.executor.execute_async
 
     def instrumented(plan, snapshot):
         with mu:
@@ -54,7 +56,7 @@ def test_concurrent_selects_in_flight():
             with mu:
                 active[0] -= 1
 
-    eng.executor.execute = instrumented
+    eng.executor.execute_async = instrumented
     errs = []
     want_sum = sum(i * 0.25 for i in range(60_000))
 

@@ -1,0 +1,571 @@
+"""Concurrent query pipeline: dispatch decoupled from readout.
+
+The dispatch cliff (PERF.md) makes every post-readout dispatch cost
+~35 ms fixed, but overlapped async dispatches pipeline down to ~10 ms —
+so the engine splits SELECTs into a dispatch phase (plan → compile-cache
+→ device enqueue, `Executor.execute_async`) and a lock-free readout
+phase that resolves a `DeviceResultFuture` (`ops/device.py`). These
+tests pin the pipeline's observable contract: genuine wall-clock overlap
+for concurrent SELECTs, the bounded in-flight window, the per-stage
+counters, and the satellite bugfixes that rode along in the same PR
+(channel RPC hardening, schema-driven shuffle hashing, torn-commit
+poisoning).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ydb_tpu.ops.device import DeviceResultFuture
+from ydb_tpu.query import QueryEngine
+from ydb_tpu.query.engine import QueryError
+from ydb_tpu.utils.metrics import GLOBAL
+
+
+def _mk_engine(rows: int = 120_000) -> QueryEngine:
+    eng = QueryEngine(block_rows=1 << 16)
+    eng.execute("create table t (id Int64 not null, k Int64 not null, "
+                "v Double not null, primary key (id)) "
+                "with (store = column)")
+    ids = np.arange(rows, dtype=np.int64)
+    df = pd.DataFrame({"id": ids, "k": ids % 13, "v": ids * 0.25})
+    t = eng.catalog.table("t")
+    t.bulk_upsert(df, eng._next_version())
+    t.indexate()
+    return eng
+
+
+# ---------------------------------------------------------------------------
+# tentpole: dispatch/readout pipelining
+# ---------------------------------------------------------------------------
+
+
+def test_device_result_future_contract():
+    calls = []
+
+    def fetch():
+        calls.append(1)
+        return "block"
+
+    fut = DeviceResultFuture(fetch)
+    assert not fut.done()
+    assert fut.result() == "block"
+    assert fut.done()
+    assert fut.result() == "block"
+    assert len(calls) == 1, "fetch must run exactly once"
+
+    mapped = fut.map(lambda b: b + "!")
+    assert mapped.result() == "block!"
+
+    done = DeviceResultFuture.completed(42)
+    assert done.done() and done.result() == 42
+
+    def boom():
+        raise RuntimeError("transfer died")
+
+    bad = DeviceResultFuture(boom)
+    with pytest.raises(RuntimeError, match="transfer died"):
+        bad.result()
+    with pytest.raises(RuntimeError, match="transfer died"):
+        bad.result()               # cached exception re-raises
+
+
+def test_execute_async_returns_future_with_same_result():
+    eng = _mk_engine(20_000)
+    sql = "select k, sum(v) as s from t group by k order by k"
+    want = eng.query(sql)
+    stmt = __import__("ydb_tpu.sql", fromlist=["parse"]).parse(sql)
+    plan = eng.planner.plan_select(stmt)
+    fut = eng.executor.execute_async(plan, eng.snapshot())
+    assert isinstance(fut, DeviceResultFuture)
+    got = fut.result().to_pandas()
+    pd.testing.assert_frame_equal(got, want)
+    # resolving twice is safe and stable
+    assert fut.result().length == len(want)
+
+
+def test_concurrent_selects_overlap_and_beat_serial():
+    """K concurrent single-shot SELECTs finish in measurably less wall
+    clock than K serial runs, and the overlap counter proves queries
+    were genuinely in flight together (the acceptance bar).
+
+    The overlap counter is the DETERMINISTIC gate; the wall-clock ratio
+    is measured best-of-3 because a loaded 2-core CI runner can produce
+    a noisy single sample with no regression in the dispatch path."""
+    eng = _mk_engine()
+    sql = "select k, sum(v) as s, count(*) as c from t group by k"
+    eng.query(sql)                         # compile + plan-cache warm-up
+    K = 6
+
+    def run_serial() -> float:
+        t0 = time.perf_counter()
+        for _ in range(K):
+            assert len(eng.query(sql)) == 13
+        return time.perf_counter() - t0
+
+    def run_concurrent() -> float:
+        errs: list = []
+        barrier = threading.Barrier(K)
+
+        def one():
+            try:
+                barrier.wait()
+                assert len(eng.query(sql)) == 13
+            except Exception as e:         # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=one) for _ in range(K)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs, errs
+        return time.perf_counter() - t0
+
+    before = GLOBAL.snapshot()
+    ratios = []
+    for _ in range(3):
+        serial_s = run_serial()
+        wall_s = run_concurrent()
+        ratios.append(wall_s / serial_s)
+        if ratios[-1] < 0.9:               # clean sample: done
+            break
+    after = GLOBAL.snapshot()
+    overlap = after.get("pipeline/overlap_hits", 0) \
+        - before.get("pipeline/overlap_hits", 0)
+    assert overlap > 0, "no two queries were ever in flight together"
+    assert min(ratios) < 0.95, \
+        f"no pipelining: concurrent/serial ratios {ratios}"
+
+
+def test_pipeline_window_bounds_inflight_dispatches():
+    """pipeline_window=1 degrades to fully serialized dispatch→readout:
+    at most one query is ever past dispatch and undrained."""
+    eng = _mk_engine(20_000)
+    sql = "select k, sum(v) as s from t group by k"
+    eng.query(sql)
+    eng.pipeline_window = 1
+    eng._pipe_sem = threading.BoundedSemaphore(1)
+    seen = []
+    mu = threading.Lock()
+    orig = eng.executor.execute_async
+
+    def instrumented(plan, snapshot):
+        fut = orig(plan, snapshot)
+        with mu:
+            seen.append(eng._pipe_inflight)
+        return fut
+
+    eng.executor.execute_async = instrumented
+    threads = [threading.Thread(
+        target=lambda: eng.query(sql)) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    # _pipe_inflight is sampled right after each dispatch, BEFORE this
+    # query registers itself: with a window of 1 nobody else can be
+    # in flight at that point
+    assert seen and max(seen) == 0, seen
+    assert eng.counters()["pipeline/window"] == 1
+
+
+def test_pipeline_counters_on_observability_endpoint():
+    """The new per-stage counters ride the existing /counters surface."""
+    import json
+    from urllib.request import urlopen
+
+    from ydb_tpu.server.http import serve_http
+    eng = _mk_engine(5_000)
+    eng.query("select count(*) as c from t")
+    front = serve_http(eng, port=0)
+    try:
+        with urlopen(f"http://127.0.0.1:{front.port}/counters") as r:
+            c = json.loads(r.read())["counters"]
+    finally:
+        front.stop()
+    for k in ("pipeline/dispatched", "pipeline/in_flight",
+              "pipeline/overlap_hits", "pipeline/readout_ms",
+              "pipeline/window"):
+        assert k in c, k
+    assert c["pipeline/dispatched"] >= 1
+    assert c["pipeline/in_flight"] == 0      # everything drained
+
+
+# ---------------------------------------------------------------------------
+# satellite: channel RPC hardening (auth + shuffle-temp namespace)
+# ---------------------------------------------------------------------------
+
+
+def _servicer(engine, token="sekrit"):
+    from ydb_tpu.server.service import QueryServicer
+    return QueryServicer(engine, token=token)
+
+
+def test_channel_close_requires_auth():
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table users (id Int64 not null, primary key (id)) "
+                "with (store = column)")
+    sv = _servicer(eng)
+    resp = sv.channel_close({"tables": ["users"]}, None)
+    assert "Unauthenticated" in resp.get("error", "")
+    assert eng.catalog.has("users")
+
+
+def test_channel_close_refuses_non_shuffle_tables():
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table users (id Int64 not null, primary key (id)) "
+                "with (store = column)")
+    sv = _servicer(eng)
+    resp = sv.channel_close({"tables": ["users"], "token": "sekrit"}, None)
+    assert "shuffle-temp" in resp.get("error", "")
+    assert eng.catalog.has("users"), "a real table was dropped"
+    # a genuine __xj_ temp drops fine
+    cols = [("id", "int64")]
+    ok = sv.channel_open({"channel": "ch0", "table": "__xj_tmp1",
+                          "columns": cols, "token": "sekrit"}, None)
+    assert ok.get("ok"), ok
+    assert eng.catalog.has("__xj_tmp1")
+    resp = sv.channel_close({"tables": ["__xj_tmp1"], "token": "sekrit"},
+                            None)
+    assert resp.get("ok"), resp
+    assert not eng.catalog.has("__xj_tmp1")
+
+
+def test_channel_open_guards_namespace_and_transience():
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table users (id Int64 not null, primary key (id)) "
+                "with (store = column)")
+    eng.execute("insert into users (id) values (1)")
+    sv = _servicer(eng)
+    cols = [("id", "int64")]
+    # outside the namespace: refused outright
+    resp = sv.channel_open({"channel": "c1", "table": "users",
+                            "columns": cols, "token": "sekrit"}, None)
+    assert "shuffle-temp" in resp.get("error", "")
+    assert int(eng.query("select count(*) as c from users").c[0]) == 1
+    # a durable table squatting in the namespace is not replaceable
+    eng.execute("create table __xj_squat (id Int64 not null, "
+                "primary key (id)) with (store = column)")
+    resp = sv.channel_open({"channel": "c1", "table": "__xj_squat",
+                            "columns": cols, "token": "sekrit"}, None)
+    assert "non-transient" in resp.get("error", "")
+    assert eng.catalog.has("__xj_squat")
+    # transient temps replace freely (the router's re-run path)
+    for _ in range(2):
+        resp = sv.channel_open({"channel": "c2", "table": "__xj_ok",
+                                "columns": cols, "token": "sekrit"}, None)
+        assert resp.get("ok"), resp
+
+
+# ---------------------------------------------------------------------------
+# satellite: schema-driven shuffle hashing
+# ---------------------------------------------------------------------------
+
+
+def test_hash_partition_nullable_int_matches_int64():
+    """Object-dtype (nullable) int keys must route to the SAME partition
+    as int64 keys — the r5 dtype-guess sent them down the string-hash
+    path and sharded×sharded joins on nullable keys dropped matches."""
+    from ydb_tpu.cluster.exchange import hash_partition
+    keys = np.arange(97, dtype=np.int64)
+    df_int = pd.DataFrame({"k": keys, "v": keys * 2})
+    obj = pd.Series(list(keys) + [None], dtype=object)
+    df_obj = pd.DataFrame({"k": obj, "v": list(keys * 2) + [0]})
+    parts_int = hash_partition(df_int, "k", 4)
+    parts_obj = hash_partition(df_obj, "k", 4, kind="int")
+    owner_int = {int(k): p for p in range(4)
+                 for k in parts_int[p]["k"]}
+    owner_obj = {int(k): p for p in range(4)
+                 for k in parts_obj[p]["k"]}
+    assert owner_int == owner_obj
+    # NULL keys still drop (inner-join shuffle semantics)
+    assert sum(len(p) for p in parts_obj) == len(keys)
+
+
+def test_hash_partition_kind_routes():
+    from ydb_tpu.cluster.exchange import hash_partition
+    df = pd.DataFrame({"k": pd.Series(["a", "b", "a"], dtype=object)})
+    parts = hash_partition(df, "k", 2, kind="string")
+    assert sum(len(p) for p in parts) == 3
+    # equal keys land together
+    owner = {v: p for p in range(2) for v in parts[p]["k"]}
+    assert len(owner) == 2
+    with pytest.raises(ValueError, match="float"):
+        hash_partition(pd.DataFrame({"k": [1.5]}), "k", 2, kind="float")
+
+
+def test_shuffle_write_uses_schema_kind():
+    """End to end through the servicer: a NULLABLE int key column (object
+    dtype after to_pandas) still int-hashes, so its partitions agree
+    with a NOT NULL producer's."""
+    from ydb_tpu.cluster.exchange import hash_partition, unpack_frame
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table s (id Int64 not null, k Int64, "
+                "primary key (id)) with (store = column)")
+    vals = ",".join(f"({i},{i})" for i in range(40))
+    eng.execute(f"insert into s (id, k) values {vals}")
+    sv = _servicer(eng, token="")
+    sent = []
+
+    class FakeClient:
+        def __init__(self, endpoint):
+            pass
+
+        def put(self, frame):
+            sent.append(unpack_frame(frame))
+            return {"ok": True}
+
+    import ydb_tpu.server.service as S
+    orig = S.ExchangeClient
+    S.ExchangeClient = FakeClient
+    try:
+        resp = sv.shuffle_write({"sql": "select k from s", "key": "k",
+                                 "channel": "c", "peers": ["a", "b"]},
+                                None)
+    finally:
+        S.ExchangeClient = orig
+    assert resp.get("ok"), resp
+    # partitions must match the int64 splitmix64 routing exactly
+    df = pd.DataFrame({"k": np.arange(40, dtype=np.int64)})
+    want = hash_partition(df, "k", 2)
+    got = {h["part"]: f for (h, f) in sent}
+    for p in range(2):
+        assert sorted(int(v) for v in got[p]["k"]) \
+            == sorted(int(v) for v in want[p]["k"])
+
+
+# ---------------------------------------------------------------------------
+# satellite: torn multi-table commits are poisoned, not published
+# ---------------------------------------------------------------------------
+
+
+def test_torn_commit_poisons_session_and_unwedges_watermark():
+    from ydb_tpu.tx import TxCommitTorn
+    eng = QueryEngine(block_rows=1 << 10)
+    for n in ("a", "b"):
+        eng.execute(f"create table {n} (id Int64 not null, v Int64 not "
+                    "null, primary key (id)) with (store = row)")
+    s = eng.session()
+    s.execute("begin")
+    s.execute("insert into a (id, v) values (1, 10)")
+    s.execute("insert into b (id, v) values (1, 20)")
+
+    tb = eng.catalog.table("b")
+
+    def boom(*a, **kw):
+        raise RuntimeError("disk on fire")
+
+    tb.stamp_tx = boom
+    with pytest.raises(TxCommitTorn, match="torn"):
+        s.commit()
+    del tb.stamp_tx                    # restore the class method
+    # the session's tx is cleared — no half-open tx pinning snapshots
+    assert s.tx is None
+    with pytest.raises(Exception, match="no open transaction"):
+        s.rollback()
+    # b's apply was in flight when it died → in-doubt: left alone (its
+    # unstamped staged entries stay invisible), a's stamped write
+    # survives (stamped versions cannot be recalled — the error names it)
+    assert int(eng.query("select count(*) as c from b").c[0]) == 0
+    assert int(eng.query("select count(*) as c from a").c[0]) == 1
+    # the watermark did NOT wedge: new commits are immediately visible
+    eng.execute("insert into b (id, v) values (2, 7)")
+    assert int(eng.query("select count(*) as c from b").c[0]) == 1
+    # and the session is reusable for a fresh tx
+    s.execute("begin")
+    s.execute("insert into a (id, v) values (3, 30)")
+    s.execute("commit")
+    assert int(eng.query("select count(*) as c from a").c[0]) == 2
+
+
+def test_channel_close_refuses_durable_table_in_namespace():
+    """A durable table squatting under __xj_ is not ChannelClose's to
+    drop — same invariant ChannelOpen enforces."""
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table __xj_squat (id Int64 not null, "
+                "primary key (id)) with (store = column)")
+    sv = _servicer(eng)
+    resp = sv.channel_close({"tables": ["__xj_squat"],
+                             "token": "sekrit"}, None)
+    assert "non-transient" in resp.get("error", "")
+    assert eng.catalog.has("__xj_squat")
+
+
+def test_in_doubt_table_commit_is_never_rolled_back():
+    """If table.commit raises AFTER its durable record landed (e.g. a
+    late state-save OSError), the poison path must keep that table's
+    writes: rolling back would append a WAL abort for committed wids
+    and the next replay would drop the rows."""
+    from ydb_tpu.tx import TxCommitTorn
+    eng = QueryEngine(block_rows=1 << 10)
+    for n in ("ca", "cb"):
+        eng.execute(f"create table {n} (id Int64 not null, v Int64 not "
+                    "null, primary key (id)) with (store = column)")
+    s = eng.session()
+    s.execute("begin")
+    s.execute("insert into ca (id, v) values (1, 1)")
+    s.execute("insert into cb (id, v) values (1, 2)")
+    tcb = eng.catalog.table("cb")
+    real_commit = tcb.commit
+
+    def commit_then_die(*a, **kw):
+        real_commit(*a, **kw)          # the durable apply DOES land
+        raise OSError("state save: disk full")
+
+    tcb.commit = commit_then_die
+    with pytest.raises(TxCommitTorn, match="in-doubt"):
+        s.commit()
+    del tcb.commit
+    assert s.tx is None
+    # the in-doubt table's landed writes survive — NOT force-aborted
+    assert int(eng.query("select count(*) as c from cb").c[0]) == 1
+    assert int(eng.query("select v from cb where id = 1").v[0]) == 2
+
+
+def test_refused_channel_close_still_frees_channel_buffers():
+    """Close is the cleanup RPC: refusing its table drops must not
+    leave the request's queued frames parked in the exchange."""
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table users (id Int64 not null, primary key (id)) "
+                "with (store = column)")
+    sv = _servicer(eng)
+    sv.exchange.put("chX", pd.DataFrame({"a": [1, 2]}), 64)
+    assert sv.exchange.bytes == 64
+    resp = sv.channel_close({"tables": ["users"], "channels": ["chX"],
+                             "token": "sekrit"}, None)
+    assert "error" in resp and eng.catalog.has("users")
+    assert sv.exchange.bytes == 0, "refused close leaked channel frames"
+
+
+def test_pre_apply_commit_failure_stays_retryable():
+    """A failure BEFORE any table's apply call (here: dropping a staged
+    delete mark) force-aborts everything cleanly — that's a plain
+    retryable TxAborted, not the must-not-retry torn error."""
+    from ydb_tpu.tx import TxAborted, TxCommitTorn
+    eng = QueryEngine(block_rows=1 << 10)
+    eng.execute("create table ct (id Int64 not null, v Int64 not null, "
+                "primary key (id)) with (store = column)")
+    eng.execute("insert into ct (id, v) values (1, 1), (2, 2)")
+    s = eng.session()
+    s.execute("begin")
+    s.execute("delete from ct where id = 1")
+    p = eng.catalog.table("ct").shards[0].portions[0]
+
+    def boom(*a, **kw):
+        raise RuntimeError("mark store corrupted")
+
+    p.drop_delete = boom
+    with pytest.raises(TxAborted, match="safe to retry") as ei:
+        s.commit()
+    del p.drop_delete
+    assert not isinstance(ei.value, TxCommitTorn)
+    assert s.tx is None
+    # nothing landed: both rows still present, engine fully usable
+    assert int(eng.query("select count(*) as c from ct").c[0]) == 2
+    eng.execute("insert into ct (id, v) values (3, 3)")
+    assert int(eng.query("select count(*) as c from ct").c[0]) == 3
+
+
+def test_mid_stamp_row_failure_is_in_doubt_not_rolled_back():
+    """stamp_tx stamps version chains BEFORE its WAL append: a failure
+    in between leaves committed-visible rows rollback_tx cannot recall.
+    The poison path must treat that table as in-doubt (keep the rows,
+    name the table) instead of falsely reporting it force-aborted."""
+    from ydb_tpu.tx import TxCommitTorn
+    eng = QueryEngine(block_rows=1 << 10)
+    for n in ("ra", "rb"):
+        eng.execute(f"create table {n} (id Int64 not null, v Int64 not "
+                    "null, primary key (id)) with (store = row)")
+    s = eng.session()
+    s.execute("begin")
+    s.execute("insert into ra (id, v) values (1, 1)")
+    s.execute("insert into rb (id, v) values (1, 2)")
+    trb = eng.catalog.table("rb")
+    real = trb.stamp_tx
+
+    def stamp_then_die(*a, **kw):
+        real(*a, **kw)                 # chains stamped, WAL landed
+        raise OSError("wal fsync: disk full")
+
+    trb.stamp_tx = stamp_then_die
+    with pytest.raises(TxCommitTorn, match="rb"):
+        s.commit()
+    del trb.stamp_tx
+    assert s.tx is None
+    # rb's stamped rows are honestly kept, not claimed aborted
+    assert int(eng.query("select v from rb where id = 1").v[0]) == 2
+    assert int(eng.query("select v from ra where id = 1").v[0]) == 1
+
+
+def test_torn_commit_is_not_a_retryable_abort():
+    """`except TxAborted: retry` must NOT catch a torn commit — a re-run
+    would double-apply the tables whose writes already landed."""
+    from ydb_tpu.tx import TxAborted, TxCommitTorn
+    assert not issubclass(TxCommitTorn, TxAborted)
+
+
+def test_indexate_failure_does_not_tear_committed_commit():
+    """Indexation is maintenance: once every table's durable commit
+    record landed, a failing indexate must neither poison the tx nor
+    roll a committed table back (a WAL abort for committed wids would
+    drop the rows at the next replay)."""
+    eng = QueryEngine(block_rows=1 << 10)
+    for n in ("ca", "cb"):
+        eng.execute(f"create table {n} (id Int64 not null, v Int64 not "
+                    "null, primary key (id)) with (store = column)")
+    s = eng.session()
+    s.execute("begin")
+    s.execute("insert into ca (id, v) values (1, 1)")
+    s.execute("insert into cb (id, v) values (1, 2)")
+    tcb = eng.catalog.table("cb")
+
+    def boom(*a, **kw):
+        raise RuntimeError("indexation disk full")
+
+    tcb.indexate = boom
+    s.execute("commit")                # must NOT raise
+    del tcb.indexate
+    assert s.tx is None
+    assert int(eng.query("select v from ca where id = 1").v[0]) == 1
+    assert int(eng.query("select v from cb where id = 1").v[0]) == 2
+
+
+def test_hash_partition_refuses_inexact_float_widened_keys():
+    """Float-widened int keys above 2^53 can't round-trip — hashing the
+    rounded value would misroute vs an int64 producer, so refuse."""
+    from ydb_tpu.cluster.exchange import hash_partition
+    big = float(2**53 + 2)      # representable, but in the collision zone
+    df = pd.DataFrame({"k": np.array([1.0, big], dtype=np.float64)})
+    with pytest.raises(ValueError, match="2\\^53"):
+        hash_partition(df, "k", 2, kind="int")
+    with pytest.raises(ValueError, match="2\\^53"):
+        hash_partition(pd.DataFrame({"k": np.array([1.5])}), "k", 2,
+                       kind="int")
+    # exactly-representable float-widened keys route like int64
+    pf = hash_partition(
+        pd.DataFrame({"k": np.array([1.0, 2.0, 3.0])}), "k", 2,
+        kind="int")
+    pi = hash_partition(
+        pd.DataFrame({"k": np.array([1, 2, 3], dtype=np.int64)}), "k", 2)
+    for p in range(2):
+        assert sorted(int(v) for v in pf[p]["k"]) \
+            == sorted(int(v) for v in pi[p]["k"])
+
+
+def test_clean_multi_table_commit_still_works():
+    eng = QueryEngine(block_rows=1 << 10)
+    for n in ("a", "b"):
+        eng.execute(f"create table {n} (id Int64 not null, v Int64 not "
+                    "null, primary key (id)) with (store = row)")
+    s = eng.session()
+    s.execute("begin")
+    s.execute("insert into a (id, v) values (1, 1)")
+    s.execute("insert into b (id, v) values (1, 2)")
+    s.execute("commit")
+    assert int(eng.query("select v from a where id = 1").v[0]) == 1
+    assert int(eng.query("select v from b where id = 1").v[0]) == 2

@@ -31,24 +31,65 @@ import pandas as pd
 from ydb_tpu.utils.hashing import splitmix64
 
 
-def hash_partition(df: pd.DataFrame, key: str, n_parts: int) -> list:
+def hash_partition(df: pd.DataFrame, key: str, n_parts: int,
+                   kind: str = None) -> list:
     """Split rows by key hash into n_parts frames (NULL keys drop — an
-    inner-join shuffle never matches them)."""
+    inner-join shuffle never matches them).
+
+    `kind` ("int" | "string" | None) is the TABLE SCHEMA's verdict on
+    the key type, passed by `shuffle_write` from the stage result's
+    schema. Deciding from the pandas dtype alone (the r5 behavior) is
+    wrong for nullable integer keys: `to_pandas` widens them to object
+    dtype, so one producer hashed `str(7)` with crc32 while a NOT NULL
+    producer hashed `7` with splitmix64 — the same key routed to two
+    different consumers and sharded×sharded joins silently dropped
+    matches. With kind="int", object-dtype values coerce to int64 and
+    take the splitmix64 route every producer agrees on."""
     col = df[key]
     notna = col.notna()
     if not notna.all():
         df = df[notna]
         col = df[key]
     vals = col.to_numpy()
-    if vals.dtype == object or vals.dtype.kind in ("U", "S", "T"):
-        h = np.fromiter((zlib.crc32(str(v).encode()) for v in vals),
-                        np.uint64, count=len(vals))
-    elif vals.dtype.kind == "f":
+    if kind is None:                  # no schema available: dtype guess
+        if vals.dtype == object or vals.dtype.kind in ("U", "S", "T"):
+            kind = "string"
+        elif vals.dtype.kind == "f":
+            kind = "float"
+        else:
+            kind = "int"
+    if kind == "float":
         raise ValueError("float join keys are not hash-partitionable "
                          "(equality on floats is ill-defined across the "
                          "wire)")
+    if kind == "string":
+        h = np.fromiter((zlib.crc32(str(v).encode()) for v in vals),
+                        np.uint64, count=len(vals))
     else:
-        h = splitmix64(np, vals.astype(np.int64))
+        # schema-int keys: nullable columns arrive as object (python
+        # ints — exact, numpy raises on int64 overflow) or float64
+        # (NaN-widened). Float widening is only exact up to 2^53: a
+        # value that doesn't round-trip would hash differently than on
+        # an int64-dtype producer — the exact misroute this path
+        # exists to prevent — so refuse loudly instead
+        arr = np.asarray(vals)
+        if arr.dtype.kind == "f":
+            # any |v| >= 2^53 may have COLLIDED during the int→float
+            # widening (2^53 and 2^53+1 are the same float64) — the loss
+            # happened upstream, so a round-trip check can't see it;
+            # refuse by magnitude, plus round-trip for fractional values
+            iv = arr.astype(np.int64)
+            if (len(arr) and np.abs(arr).max() >= float(2**53)) \
+                    or not np.array_equal(iv.astype(arr.dtype), arr):
+                raise ValueError(
+                    "int key column arrived float-widened with values "
+                    "at or above 2^53 (or fractional) — not exactly "
+                    "representable, cannot hash-partition consistently "
+                    "across producers")
+            arr = iv
+        else:
+            arr = arr.astype(np.int64)
+        h = splitmix64(np, arr)
     part = (h % np.uint64(n_parts)).astype(np.int64)
     return [df[part == p] for p in range(n_parts)]
 

@@ -95,3 +95,72 @@ def to_host(dblock: DeviceBlock) -> HostBlock:
         cols[c.name] = host_column(host_a[c.name], host_v.get(c.name),
                                    c.dtype, dblock.dictionaries.get(c.name))
     return HostBlock(dblock.schema, cols, n)
+
+
+class DeviceResultFuture:
+    """Handle to a dispatched device computation whose device→host
+    readout is deferred until the result is actually consumed.
+
+    The dispatch cliff (PERF.md) makes overlap the whole game: a
+    dispatch is ~async and cheap, but every blocking readout costs a
+    full link round trip — so a query pipeline that dispatches query
+    N+1 while query N drains D2H turns N × (dispatch + readout) into
+    ~max(compute) + one readout. The future is the seam: the executor
+    dispatches the fused program WITHOUT `block_until_ready`, wraps the
+    single-pytree `jax.device_get` (plus host-side unpack) in `fetch`,
+    and the engine resolves it in its lock-free readout phase.
+
+    `result()` runs `fetch` exactly once (thread-safe) and caches the
+    block — or the exception, which re-raises on every later call.
+    """
+
+    __slots__ = ("_fetch", "_value", "_exc", "_done", "_mu")
+
+    def __init__(self, fetch):
+        import threading
+        self._fetch = fetch            # () -> HostBlock
+        self._value = None
+        self._exc = None
+        self._done = False
+        self._mu = threading.Lock()
+
+    @classmethod
+    def completed(cls, block) -> "DeviceResultFuture":
+        """Wrap an already-materialized result (host-lane / distributed
+        paths) so every executor path speaks one readout protocol."""
+        fut = cls(None)
+        fut._value = block
+        fut._done = True
+        return fut
+
+    def done(self) -> bool:
+        return self._done
+
+    def result(self):
+        with self._mu:
+            if not self._done:
+                # only Exception is cached as the computation's outcome;
+                # control-flow BaseExceptions (KeyboardInterrupt,
+                # SystemExit) propagate WITHOUT poisoning the future —
+                # _done stays False so a later result() can refetch
+                try:
+                    self._value = self._fetch()
+                except Exception as e:       # noqa: BLE001 — re-raised
+                    self._exc = e
+                self._done = True
+                self._fetch = None           # drop device refs promptly
+            if self._exc is not None:
+                raise self._exc
+            return self._value
+
+    def map(self, fn) -> "DeviceResultFuture":
+        """Chain a host-side transform onto the readout (projection,
+        offset slicing) without forcing it now."""
+        return DeviceResultFuture(lambda: fn(self.result()))
+
+
+def to_host_async(dblock: DeviceBlock) -> DeviceResultFuture:
+    """`to_host` as a future: the device program stays in flight (jax
+    async dispatch) and the single pytree `device_get` runs when the
+    result is consumed."""
+    return DeviceResultFuture(lambda: to_host(dblock))

@@ -144,6 +144,48 @@ def build_fused_fn(pipe, final_program: Optional[ir.Program],
     return fn, layout_box
 
 
+def fetch_fused_result(data_stacks, valid_stack, length, layout_box: dict,
+                       out_schema: Schema, out_dicts: dict):
+    """Device→host readout of one fused dispatch: ONE `jax.device_get`
+    for the whole result (length included) — per-column fetches pay a
+    full link round trip each (PERF.md). Large row-level outputs sync
+    the length first and slice device-side so padding doesn't cross the
+    link. This is the deferred half of the device-result future: the
+    dispatch returns immediately and this runs when the result is
+    consumed, so concurrent queries overlap compute with D2H drains."""
+    from ydb_tpu.core.block import HostBlock
+    from ydb_tpu.ops.device import host_column
+
+    cap_out = (next(iter(data_stacks.values())).shape[1]
+               if data_stacks else 0)
+    if cap_out > (1 << 16):
+        n = int(length)
+        m = max(n, 1)
+        data_stacks = {k: v[:, :m] for k, v in data_stacks.items()}
+        if valid_stack is not None:
+            valid_stack = valid_stack[:, :m]
+        host_stacks, host_valids = jax.device_get(
+            (data_stacks, valid_stack))
+    else:
+        host_stacks, host_valids, n = jax.device_get(
+            (data_stacks, valid_stack, length))
+        n = int(n)
+    valid_row = {nm: i for i, nm in enumerate(layout_box["valids"])}
+    cols = {}
+    out_cols = []
+    for (name, dtype_key, row) in layout_box["data"]:
+        if not out_schema.has(name):
+            continue
+        valid = (host_valids[valid_row[name]][:n]
+                 if name in valid_row and host_valids is not None
+                 else None)
+        cols[name] = host_column(host_stacks[dtype_key][row][:n], valid,
+                                 out_schema.dtype(name),
+                                 out_dicts.get(name))
+        out_cols.append(out_schema.col(name))
+    return HostBlock(Schema(out_cols), cols, n)
+
+
 def build_tile_fn(pipe, scan_cols: list, K: int, CAP: int,
                   sb_valid_names: frozenset, join_metas: list):
     """Fused scan→filter→join→partial-agg program for ONE tile of a scan

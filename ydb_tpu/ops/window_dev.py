@@ -214,6 +214,20 @@ def _build_window_fn(struct):
         outs = {}
         for gi, grp in enumerate(struct["groups"]):
             # --- one sort per clause group
+            #
+            # PARTITION BY keys are hash-combined into ONE u64 sort
+            # operand — a deliberate correctness/compile-time tradeoff:
+            # two DISTINCT partitions whose combined splitmix64 hashes
+            # collide in the surviving 63 bits (the top bit is the
+            # padding sentinel) would silently merge, corrupting every
+            # windowed value in both. The per-pair probability is 2^-63
+            # (~1e-19; even 1M partitions give ~5e7 pairs ≈ 5e-12 per
+            # query), while the alternative — one sort operand per key
+            # column — rides the lax.sort compile cliff (operand count
+            # is the compile-time driver: 6M×8 operands ≈ 218 s,
+            # PERF.md). A second independent hash operand would square
+            # the collision odds at +1 operand; revisit if this lane
+            # ever feeds billing-grade aggregation instead of analytics.
             phash = jnp.zeros(cap, jnp.uint64)
             for pi in range(grp["n_part_ops"]):
                 phash = hash_combine(

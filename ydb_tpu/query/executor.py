@@ -24,7 +24,10 @@ from ydb_tpu.core.block import ColumnData, HostBlock
 from ydb_tpu.core.schema import Column, Schema
 from ydb_tpu.ops import ir
 from ydb_tpu.ops import join as J
-from ydb_tpu.ops.device import DeviceBlock, bucket_capacity, to_device, to_host
+from ydb_tpu.ops.device import (
+    DeviceBlock, DeviceResultFuture, bucket_capacity, to_device, to_host,
+    to_host_async,
+)
 from ydb_tpu.ops.sort import sort_env
 from ydb_tpu.ops.xla_exec import (
     _trace_program, compress, compress_block, run_on_device,
@@ -151,6 +154,20 @@ class Executor:
 
     def execute(self, plan: QueryPlan,
                 snapshot: Snapshot = MAX_SNAPSHOT) -> HostBlock:
+        return self.execute_async(plan, snapshot).result()
+
+    def execute_async(self, plan: QueryPlan,
+                      snapshot: Snapshot = MAX_SNAPSHOT
+                      ) -> DeviceResultFuture:
+        """Dispatch phase of a SELECT: plan → compile-cache hit → device
+        enqueue, WITHOUT blocking on the device→host readout. Returns a
+        `DeviceResultFuture` whose `result()` performs the single pytree
+        `device_get` (plus host unpack / projection) — the engine drains
+        it lock-free, so query N+1 dispatches while query N's result
+        crosses the link (the ~35 ms post-readout dispatch cliff
+        pipelines down to ~10 ms when overlapped, PERF.md). Paths that
+        must materialize host-side mid-flight (distributed, tiled,
+        spill) resolve eagerly and return a completed future."""
         params = dict(plan.params)
         # precompute stage: uncorrelated scalar subqueries → params
         for (pname, subplan) in plan.init_subplans:
@@ -175,39 +192,45 @@ class Executor:
                                                     prebuilt)
                 if sj is not None:
                     self.last_path = "distributed-shuffle-join"
-                    return self._project_output(sj, plan.output)
+                    return DeviceResultFuture.completed(
+                        self._project_output(sj, plan.output))
                 self.last_path = "distributed"
                 merged = self._execute_distributed(plan, params, snapshot,
                                                    prebuilt)
-                return self._project_output(merged, plan.output)
+                return DeviceResultFuture.completed(
+                    self._project_output(merged, plan.output))
             if self._can_distribute_map(plan, snapshot):
                 self.last_path = "distributed-map"
                 merged = self._execute_distributed_map(plan, params,
                                                        snapshot)
-                return self._project_output(merged, plan.output)
+                return DeviceResultFuture.completed(
+                    self._project_output(merged, plan.output))
 
         with self._span("fused-attempt"):
-            fused = self._try_execute_fused(plan, params, snapshot) \
+            fused = self._try_execute_fused(plan, params, snapshot,
+                                            defer=True) \
                 if self.enable_fused else None
         if isinstance(fused, tuple):           # tiled path: (kind, block)
             kind, block = fused
             self.last_path = kind
-            return self._project_output(block, plan.output)
-        if isinstance(fused, HostBlock):
+            return DeviceResultFuture.completed(
+                self._project_output(block, plan.output))
+        if isinstance(fused, DeviceResultFuture):
             self.last_path = "fused"
-            return self._project_output(fused, plan.output)
+            return fused.map(
+                lambda b: self._project_output(b, plan.output))
 
         # fused path declined: it may have prepared the join builds already
         self.last_path = "portioned"
         partials = self._run_pipeline(plan.pipeline, params, snapshot,
                                       builds=fused)
-        merged = self._finalize(plan, partials, params)
-        return self._project_output(merged, plan.output)
+        fut = self._finalize(plan, partials, params, defer=True)
+        return fut.map(lambda b: self._project_output(b, plan.output))
 
     # -- fused whole-query path --------------------------------------------
 
     def _try_execute_fused(self, plan: QueryPlan, params: dict,
-                           snapshot: Snapshot):
+                           snapshot: Snapshot, defer: bool = False):
         """Run the query as ONE fused device program (`ops/fused.py`) when
         its shape allows: single device, joins unique-keyed where
         payloads attach (expanding duplicate-key probes need a
@@ -215,7 +238,9 @@ class Executor:
         path). Probes use a direct-address LUT when the build has one,
         an unrolled binary search otherwise (sparse spans, float keys).
 
-        Returns the merged HostBlock on success; on fallback, the list of
+        Returns the merged HostBlock on success (`defer=True`: a
+        `DeviceResultFuture` deferring the single-pytree readout — the
+        pipeline dispatch/readout seam); on fallback, the list of
         prepared join BuildTables (for `_run_pipeline` to reuse) or None
         if none were prepared."""
         from ydb_tpu.core.dtypes import DType, Kind as _K
@@ -341,47 +366,23 @@ class Executor:
             data_stacks, valid_stack, length = fn(arrays, valids, lengths,
                                                   build_inputs, dev_params)
 
-        # ONE device→host transfer for the whole result (length included):
-        # per-column fetches pay a full link round trip each. Large
-        # row-level outputs sync the length first and slice device-side
-        # so padding doesn't cross the link.
-        cap_out = (next(iter(data_stacks.values())).shape[1]
-                   if data_stacks else 0)
-        if cap_out > (1 << 16):
-            n = int(length)
-            m = max(n, 1)
-            data_stacks = {k: v[:, :m] for k, v in data_stacks.items()}
-            if valid_stack is not None:
-                valid_stack = valid_stack[:, :m]
-            host_stacks, host_valids = jax.device_get(
-                (data_stacks, valid_stack))
-        else:
-            host_stacks, host_valids, n = jax.device_get(
-                (data_stacks, valid_stack, length))
-            n = int(n)
+        # readout deferred into the result future: the dispatch above is
+        # async, and `fetch_fused_result` performs the ONE device→host
+        # pytree transfer when the result is consumed — concurrent
+        # queries dispatch while this one drains D2H
         out_dicts = {n2: d for n2, d in dicts.items() if out_schema.has(n2)}
         out_dicts.update({n2: d for n2, d in plan.result_dicts.items()
                           if out_schema.has(n2)})
-        valid_row = {nm: i for i, nm in enumerate(layout_box["valids"])}
-        cols = {}
-        out_cols = []
-        for (name, dtype_key, row) in layout_box["data"]:
-            if not out_schema.has(name):
-                continue
-            valid = (host_valids[valid_row[name]][:n]
-                     if name in valid_row and host_valids is not None
-                     else None)
-            from ydb_tpu.ops.device import host_column
-            cols[name] = host_column(host_stacks[dtype_key][row][:n], valid,
-                                     out_schema.dtype(name),
-                                     out_dicts.get(name))
-            out_cols.append(out_schema.col(name))
-        block = HostBlock(Schema(out_cols), cols, n)
         lo = plan.offset or 0
-        if lo:
-            hi = lo + plan.limit if plan.limit is not None else block.length
-            block = block.slice(lo, min(hi, block.length))
-        return block
+        limit = plan.limit
+
+        def fetch() -> HostBlock:
+            block = F.fetch_fused_result(data_stacks, valid_stack, length,
+                                         layout_box, out_schema, out_dicts)
+            return _apply_offset(block, lo, limit)
+
+        fut = DeviceResultFuture(fetch)
+        return fut if defer else fut.result()
 
     def _sort_setup_fused(self, plan: QueryPlan, schema: Schema,
                           dicts: dict):
@@ -1196,10 +1197,12 @@ class Executor:
 
     # -- fused finalize ----------------------------------------------------
 
-    def _finalize(self, plan: QueryPlan, dblocks: list,
-                  params: dict) -> HostBlock:
+    def _finalize(self, plan: QueryPlan, dblocks: list, params: dict,
+                  defer: bool = False) -> "HostBlock | DeviceResultFuture":
         """Concat partials + final program + sort + limit in ONE device
-        call, then one batched transfer. Partial-agg states too large to
+        call, then one batched transfer (`defer=True`: the transfer is
+        wrapped in a `DeviceResultFuture` and runs at `result()` time —
+        the pipeline readout phase). Partial-agg states too large to
         merge in one device concat (high-cardinality group-bys on the
         portioned path) route to the host-DRAM partitioned merge instead
         of compiling an HBM-sized program."""
@@ -1227,7 +1230,9 @@ class Executor:
                     store.feed(d)
                 GLOBAL.inc("executor/spilled_rows", store.spilled_rows)
                 GLOBAL.inc("executor/spilled_bytes", store.spilled_bytes)
-                return self._merge_spilled(plan, store, params)
+                merged = self._merge_spilled(plan, store, params)
+                return DeviceResultFuture.completed(merged) if defer \
+                    else merged
         sort_params, sort_spec, rank_assigns = self._sort_setup(
             plan, in_schema, dblocks)
         all_params = {**params, **sort_params}
@@ -1261,12 +1266,11 @@ class Executor:
         dicts = {n: dc for n, dc in dicts.items() if out_schema.has(n)}
         out_cap = (next(iter(out_d.values())).shape[0] if out_d else 0)
         dblock = DeviceBlock(out_schema, out_d, out_v, length, out_cap, dicts)
-        block = to_host(dblock)
         lo = plan.offset or 0
-        if lo:
-            hi = lo + plan.limit if plan.limit is not None else block.length
-            block = block.slice(lo, min(hi, block.length))
-        return block
+        limit = plan.limit
+        fut = to_host_async(dblock).map(
+            lambda block: _apply_offset(block, lo, limit))
+        return fut if defer else fut.result()
 
     def _sort_setup(self, plan: QueryPlan, in_schema: Schema, dblocks: list):
         """Rank-LUT params for string sort keys (lexicographic order over
@@ -1382,6 +1386,16 @@ class Executor:
             cols[lbl] = ColumnData(cd.data, cd.valid, cd.dictionary)
             schema_cols.append(Column(lbl, block.schema.dtype(internal)))
         return HostBlock(Schema(schema_cols), cols, block.length)
+
+
+def _apply_offset(block: HostBlock, lo: int, limit) -> HostBlock:
+    """Shared OFFSET/LIMIT tail slice of every deferred-readout path
+    (fused fetch + finalize) — one definition so the two lanes can't
+    silently diverge."""
+    if lo:
+        hi = lo + limit if limit is not None else block.length
+        block = block.slice(lo, min(hi, block.length))
+    return block
 
 
 def _remap_build_codes(built: HostBlock, key: str, probe_dict) -> HostBlock:

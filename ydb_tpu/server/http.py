@@ -68,6 +68,12 @@ class HttpFront:
                     self._send(400, {"error": f"bad request: {e}"})
                     return
                 req["token"] = self._token()
+                # ThreadingHTTPServer: one worker thread per request, so
+                # concurrent POSTs drive the engine's dispatch→readout
+                # pipeline in parallel (overlap shows up in the
+                # pipeline/* counters at /counters)
+                from ydb_tpu.utils.metrics import GLOBAL
+                GLOBAL.inc("server/http_queries")
                 resp = servicer.execute_query(req, None)
                 if "error" in resp:
                     code = 401 if "Unauthenticated" in resp["error"] \

@@ -38,6 +38,12 @@ def _deser(data: bytes):
 
 SERVICE = "ydb_tpu.QueryService"
 
+# every shuffle temp the router materializes via ChannelOpen carries this
+# prefix (`cluster/router.py` temp_of) — the channel RPCs refuse to touch
+# tables outside the namespace, so a (even authed) caller can never drop
+# or replace a real user table through the exchange plane
+SHUFFLE_TMP_PREFIX = "__xj_"
+
 
 def _result_payload(block, stats) -> dict:
     df = block.to_pandas()
@@ -88,6 +94,23 @@ class QueryServicer:
         # minimal bearer auth (ydb/core/security token check, radically
         # simplified): empty = open access; Ping/Health stay open (probes)
         self._token = token or os.environ.get("YDB_TPU_AUTH_TOKEN", "")
+        # concurrent-RPC gauge: worker threads drive the engine's query
+        # pipeline directly, so this also shows how many RPCs genuinely
+        # overlap dispatch/readout (exported with engine.counters())
+        self._rpc_mu = threading.Lock()
+        self._rpc_inflight = 0
+
+    def _rpc_enter(self, gauge: str) -> None:
+        from ydb_tpu.utils.metrics import GLOBAL
+        with self._rpc_mu:
+            self._rpc_inflight += 1
+            GLOBAL.set(gauge, self._rpc_inflight)
+
+    def _rpc_exit(self, gauge: str) -> None:
+        from ydb_tpu.utils.metrics import GLOBAL
+        with self._rpc_mu:
+            self._rpc_inflight -= 1
+            GLOBAL.set(gauge, self._rpc_inflight)
 
     def _authed(self, request) -> bool:
         import hmac
@@ -124,6 +147,10 @@ class QueryServicer:
         if not self._authed(request):
             return {"error": "Unauthenticated: invalid or missing token"}
         sql = request.get("sql", "")
+        # each worker thread drives the engine's dispatch→readout
+        # pipeline end to end; concurrent RPCs overlap inside the engine
+        # (bounded by engine.pipeline_window + memory admission)
+        self._rpc_enter("server/rpc_in_flight")
         try:
             with self._lock:
                 session = self._session(request.get("session_id"))
@@ -132,6 +159,8 @@ class QueryServicer:
             return _result_payload(block, stats)
         except Exception as e:               # noqa: BLE001 — wire boundary
             return {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            self._rpc_exit("server/rpc_in_flight")
 
     def counters(self, request, context):
         if not self._authed(request):
@@ -188,7 +217,17 @@ class QueryServicer:
             peers = request["peers"]
             block = self.engine.execute(sql)
             df = block.to_pandas()
-            parts = hash_partition(df, key, len(peers))
+            # the key's hash route comes from the SCHEMA, not the pandas
+            # dtype: nullable int keys widen to object dtype in pandas
+            # and would otherwise string-hash on this producer while a
+            # NOT NULL producer int-hashes — the same key landing on two
+            # consumers silently drops sharded-join matches
+            kind = None
+            if block.schema.has(key):
+                dt = block.schema.dtype(key)
+                kind = ("string" if dt.is_string
+                        else "float" if dt.is_float else "int")
+            parts = hash_partition(df, key, len(peers), kind=kind)
 
             def send(p):
                 frame = pack_frame(
@@ -211,13 +250,29 @@ class QueryServicer:
             return {"error": "Unauthenticated: invalid or missing token"}
         from ydb_tpu.core.block import HostBlock
         try:
+            name = request["table"]
+            if not str(name).startswith(SHUFFLE_TMP_PREFIX):
+                # drop the channel's queued frames too: a refused open
+                # must not leave them parked in the exchange buffer
+                # forever (repeated rejected opens would leak unbounded
+                # server memory)
+                self.exchange.drop(request.get("channel", ""))
+                return {"error": f"ChannelOpen: table {name!r} is outside "
+                                 f"the {SHUFFLE_TMP_PREFIX}* shuffle-temp "
+                                 "namespace"}
             df = self.exchange.take(request["channel"])
             columns = request.get("columns")
             if df.empty and columns:
                 df = _empty_typed_frame(columns)
             block = HostBlock.from_pandas(df)
-            name = request["table"]
             if self.engine.catalog.has(name):
+                # drop-and-recreate only ever replaces a transient temp:
+                # a durable table that happens to sit in the namespace is
+                # not ours to clobber
+                old = self.engine.catalog.table(name)
+                if not getattr(old, "transient", False):
+                    return {"error": f"ChannelOpen: refusing to replace "
+                                     f"non-transient table {name!r}"}
                 self.engine.catalog.drop_table(name)
             t = self.engine.catalog.create_table(
                 name, block.schema,
@@ -365,8 +420,32 @@ class QueryServicer:
         return {"gtx": sorted(j.in_doubt()) if j is not None else []}
 
     def channel_close(self, request, context):
+        # auth like every other mutating RPC (the r5 version skipped the
+        # check — an unauthenticated client could drop arbitrary tables)
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
         try:
-            for name in request.get("tables", []):
+            tables = [str(n) for n in request.get("tables", [])]
+            bad = [n for n in tables
+                   if not n.startswith(SHUFFLE_TMP_PREFIX)]
+            # same invariant as ChannelOpen: a durable table squatting in
+            # the namespace is not ours to clobber either
+            durable = [n for n in tables
+                       if n not in bad and self.engine.catalog.has(n)
+                       and not getattr(self.engine.catalog.table(n),
+                                       "transient", False)]
+            if bad or durable:
+                # refuse ALL table drops (the exchange plane only ever
+                # owns __xj_* transient temps) — but still free the
+                # request's channel buffers: close is the cleanup RPC,
+                # and a refusal must not leave frames parked forever
+                for ch in request.get("channels", []):
+                    self.exchange.drop(ch)
+                return {"error": f"ChannelClose: refusing "
+                                 f"{bad + durable} — outside the "
+                                 f"{SHUFFLE_TMP_PREFIX}* shuffle-temp "
+                                 "namespace or non-transient"}
+            for name in tables:
                 if self.engine.catalog.has(name):
                     self.engine.catalog.drop_table(name)
             for ch in request.get("channels", []):

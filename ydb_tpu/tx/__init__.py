@@ -1,2 +1,4 @@
 from ydb_tpu.tx.coordinator import Coordinator  # noqa: F401
-from ydb_tpu.tx.session import Session, Transaction, TxAborted  # noqa: F401
+from ydb_tpu.tx.session import (  # noqa: F401
+    Session, Transaction, TxAborted, TxCommitTorn,
+)

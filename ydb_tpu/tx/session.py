@@ -45,6 +45,17 @@ class TxAborted(Exception):
     """Optimistic lock broken: a conflicting commit landed since BEGIN."""
 
 
+class TxCommitTorn(Exception):
+    """Internal error: a multi-table COMMIT failed mid-apply. Tables
+    whose apply already landed keep their writes (stamped versions
+    cannot be recalled); everything not yet applied was force-aborted
+    and the session's transaction is cleared. Deliberately NOT a
+    `TxAborted` subclass: the standard `except TxAborted: retry` idiom
+    is only safe when nothing landed, and a torn commit re-run would
+    double-apply the tables that did — clients must handle it
+    explicitly (operator attention, not retry)."""
+
+
 class Transaction:
     def __init__(self, tx_id: int, snapshot: Snapshot,
                  begin_versions: dict):
@@ -144,34 +155,117 @@ class Session:
             raise
         coord = self.engine.coordinator
         version = coord.propose(tx.tx_id)
+        # group column writes + delete marks PER TABLE: one commit call
+        # carries both through one intent-journal record (an UPDATE's
+        # deletes and re-inserts must survive a crash together)
+        col_tables: dict = {}
+        for table, writes in tx.col_writes:
+            ent = col_tables.setdefault(id(table), [table, [], []])
+            ent[1].extend(writes)
+        for table, handles in tx.col_deletes:
+            ent = col_tables.setdefault(id(table), [table, [], []])
+            ent[2].extend(handles)
+        # keys are id(table) for BOTH kinds (col_tables is keyed that
+        # way too). A table is "landed" once its apply call returned;
+        # the table whose apply call is IN FLIGHT when an exception hits
+        # is in-doubt: stamp_tx stamps chains before its WAL append and
+        # table.commit's durable record (store.commit_table) precedes
+        # its dictionary/state saves — either may have landed, so the
+        # poison path must never roll an in-doubt table back (a WAL
+        # abort for committed wids would drop the rows at the next
+        # replay — silent durable loss); un-landed staged writes heal
+        # at boot.
+        landed: set = set()
+        in_doubt_key = None
         try:
             for table, ops in tx.row_writes:
+                in_doubt_key = id(table)
                 table.stamp_tx(tx.tx_id, version, ops_for_wal=ops)
-            # group column writes + delete marks PER TABLE: one commit call
-            # carries both through one intent-journal record (an UPDATE's
-            # deletes and re-inserts must survive a crash together)
-            col_tables: dict = {}
-            for table, writes in tx.col_writes:
-                ent = col_tables.setdefault(id(table), [table, [], []])
-                ent[1].extend(writes)
-            for table, handles in tx.col_deletes:
-                ent = col_tables.setdefault(id(table), [table, [], []])
-                ent[2].extend(handles)
-            for (table, writes, handles) in col_tables.values():
+                landed.add(id(table))
+                in_doubt_key = None
+            for key, (table, writes, handles) in col_tables.items():
                 hits = [(shard, portion, mark.rows)
                         for (shard, portion, mark) in handles]
                 for (_shard, portion, mark) in handles:
                     portion.drop_delete(mark)  # replaced by committed marks
+                in_doubt_key = key
                 table.commit(writes, version, deletes=hits)
+                landed.add(key)
+                in_doubt_key = None
+        except Exception as e:         # noqa: BLE001 — poison, don't tear
+            keep = set(landed)
+            if in_doubt_key is not None:
+                keep.add(in_doubt_key)
+            self._poison_torn_commit(tx, col_tables, keep, version, e)
+        # indexation is maintenance, not part of commit atomicity: run it
+        # only once every table's apply landed, and never let it poison a
+        # fully-committed transaction (the next commit/indexate retries)
+        for (table, _writes, _handles) in col_tables.values():
+            try:
                 table.indexate()
-        finally:
-            # read watermark advances only once every shard's apply landed
-            # (lock-free readers must never see a torn cross-table commit)
-            coord.publish(version.plan_step)
+            except Exception:          # noqa: BLE001 — best-effort
+                pass
+        # read watermark advances only once every shard's apply landed
+        # (lock-free readers must never see a torn cross-table commit)
+        coord.publish(version.plan_step)
         if self.engine.catalog.store is not None:
             self.engine.catalog.store.save_state(version.plan_step)
         self.engine.coordinator.unpin_snapshot(tx.tx_id)
         self.tx = None
+
+    def _poison_torn_commit(self, tx: Transaction, col_tables: dict,
+                            keep: set, version,
+                            cause: Exception) -> None:
+        """A multi-table apply failed partway. The r5 `finally` published
+        the half-applied step and left the tx open — readers saw a torn
+        cross-table commit forever and a retry double-applied. Instead:
+        force-abort everything not yet applied (`keep` holds the landed
+        tables — stamped versions cannot be recalled — plus the table
+        whose apply call was in flight: its stamps/durable record may
+        have landed, so rolling it back could destroy committed data),
+        publish the step so the read watermark never wedges behind it,
+        clear the session's tx, and surface a distinct internal error
+        naming what did (or may have) landed."""
+        applied = sorted({t.name for t, _ops in tx.row_writes
+                          if id(t) in keep}
+                         | {ent[0].name for k, ent in col_tables.items()
+                            if k in keep})
+        for table, _ops in tx.row_writes:
+            if id(table) in keep:
+                continue
+            try:
+                table.rollback_tx(tx.tx_id)
+            except Exception:          # noqa: BLE001 — best-effort abort
+                pass
+        for key, (table, writes, handles) in col_tables.items():
+            if key in keep:
+                continue
+            try:
+                table.rollback_deletes(handles)
+            except Exception:          # noqa: BLE001
+                pass
+            try:
+                table.rollback(writes)
+            except Exception:          # noqa: BLE001
+                pass
+        coord = self.engine.coordinator
+        coord.publish(version.plan_step)
+        coord.unpin_snapshot(tx.tx_id)
+        self.tx = None
+        if not keep:
+            # nothing landed and nothing is in doubt: every write was
+            # cleanly force-aborted, so the safe-retry contract of a
+            # plain TxAborted still holds — don't escalate to the
+            # must-not-retry torn error
+            raise TxAborted(
+                f"commit failed before any write landed "
+                f"({type(cause).__name__}: {cause}); transaction "
+                "force-aborted cleanly — safe to retry") from cause
+        raise TxCommitTorn(
+            f"internal: multi-table commit torn at plan step "
+            f"{version.plan_step} ({type(cause).__name__}: {cause}); "
+            f"applied (or in-doubt) tables: {applied or 'none'}; "
+            "everything else force-aborted") from cause
 
     def rollback(self) -> None:
         tx = self._require_tx()

@@ -53,6 +53,10 @@ class Config:
     window_device_min_rows: int = 1 << 16
     # auto-split threshold for column shards (rows); 0 = disabled
     shard_split_rows: int = 0
+    # concurrent-query pipeline: max SELECTs dispatched but not yet
+    # drained (device result buffers held in HBM). 1 = serialize
+    # dispatch→readout (the pre-pipeline behavior, a debug lever).
+    pipeline_window: int = 4
     feature_flags: dict = field(default_factory=lambda: dict(DEFAULT_FLAGS))
 
     def flag(self, name: str) -> bool:
@@ -83,7 +87,7 @@ class Config:
             raise ValueError(f"unknown feature flags: {sorted(unknown)}")
         known = {"block_rows", "grace_budget_bytes", "data_dir",
                  "server_port", "host_lane_max_rows", "shard_split_rows",
-                 "window_device_min_rows"}
+                 "window_device_min_rows", "pipeline_window"}
         bad = set(merged) - known
         if bad:
             raise ValueError(f"unknown config keys: {sorted(bad)}")

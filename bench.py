@@ -51,6 +51,11 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1450"))
 EMERGENCY_S = float(os.environ.get("BENCH_EMERGENCY_S", "1620"))
 QUERY_TIMEOUT = float(os.environ.get("BENCH_QUERY_TIMEOUT", "420"))
 SUITE_REPEATS = int(os.environ.get("BENCH_SUITE_REPEATS", "2"))
+# start-of-run platform-health probe: a tiny NOVEL-shape jit must finish
+# inside this window or the platform is declared wedged (a stuck remote
+# compile burns every suite's budget and reports 0/22 with no
+# explanation — BENCH_r05's bare zero)
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 GATE_BIG = ("q1", "q6", "q12", "q14")
 
 _T0 = time.perf_counter()
@@ -186,6 +191,72 @@ def child_main(sf: float, progress_path: str, skip: list,
 
 _HUNG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           ".bench_hung.json")
+_LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".bench_last_good.json")
+
+
+def _load_last_good() -> dict:
+    try:
+        with open(_LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+
+
+def _save_last_good(suites: dict) -> None:
+    """Persist the most recent SUCCESSFUL per-query numbers per suite —
+    a later wedged run still reports them under `last_known_good`."""
+    good = _load_last_good()
+    for key, out in suites.items():
+        if not out.get("per_query_ms"):
+            continue
+        prev = good.get(key, {})
+        merged = dict(prev.get("per_query_ms", {}))
+        merged.update(out["per_query_ms"])
+        good[key] = {
+            "per_query_ms": merged,
+            "geomean_ms": out.get("geomean_ms"),
+            "coverage": out.get("coverage"),
+            "recorded_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+    try:
+        with open(_LAST_GOOD_PATH, "w") as f:
+            json.dump(good, f)
+    except OSError:
+        pass
+
+
+def probe_main() -> None:
+    """Child (`bench.py --probe`): jit ONE tiny program with a novel
+    shape — prime-offset dims keyed on the pid, so the persistent compile
+    cache cannot satisfy it — and print a marker. A healthy platform
+    finishes in seconds; a wedged compile service hangs here instead of
+    eating a whole suite's watchdog budget."""
+    import jax
+    import jax.numpy as jnp
+    n = 1009 + (os.getpid() % 97) * 2
+    x = jnp.arange(n, dtype=jnp.float32)
+    y = jax.jit(lambda a: (a * 3.0 + 1.0).sum())(x)
+    got = float(y)
+    want = float(3.0 * (n - 1) * n / 2 + n)
+    assert abs(got - want) < 1e-3 * max(1.0, want), (got, want)
+    print("probe-ok", n, flush=True)
+
+
+def platform_probe() -> bool:
+    """Run the probe child under its watchdog. True = healthy."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--probe"]
+    try:
+        p = subprocess.run(cmd, timeout=PROBE_TIMEOUT_S,
+                           capture_output=True)
+    except subprocess.TimeoutExpired:
+        log(f"platform probe HUNG past {PROBE_TIMEOUT_S:.0f}s — wedged")
+        return False
+    if p.returncode != 0:
+        log(f"platform probe FAILED rc={p.returncode}: "
+            f"{p.stderr.decode(errors='replace')[-300:]}")
+        return False
+    return b"probe-ok" in p.stdout
 
 
 def _load_hung() -> dict:
@@ -377,7 +448,13 @@ def run_suite(sf: float, suite_deadline: float,
     }
 
 
+_WEDGED = {"v": False}
+
+
 def _emit(suites: dict) -> None:
+    """The artifact ALWAYS parses: real numbers when the platform
+    cooperated, else `platform_wedged: true` plus the `last_known_good`
+    per-query numbers — never again a bare 0/22 with no explanation."""
     sf1 = suites.get("sf1", {})
     q1_ms = sf1.get("per_query_ms", {}).get("q1")
     rows = sf1.get("lineitem_rows") or 0
@@ -388,6 +465,8 @@ def _emit(suites: dict) -> None:
         "value": round(value, 1),
         "unit": "rows/s",
         "vs_baseline": ratio,
+        "platform_wedged": _WEDGED["v"],
+        "last_known_good": _load_last_good(),
         "suites": suites,
     }), flush=True)
 
@@ -492,6 +571,12 @@ def main() -> None:
         os._exit(0)
 
     threading.Thread(target=emergency, daemon=True).start()
+    if not platform_probe():
+        # wedged platform: stamp it and report the last good numbers —
+        # running the suites would only burn the budget on watchdog kills
+        _WEDGED["v"] = True
+        _emit(suites)
+        return
     plan = [("tpch", sf) for sf in SUITE_SFS]
     if TPCDS_SF:
         plan.append(("tpcds", float(TPCDS_SF)))
@@ -515,13 +600,16 @@ def main() -> None:
         # incremental emission: every completed suite immediately lands a
         # full cumulative JSON line — if anything later wedges or the
         # driver kills us, the LAST printed line already carries it
+        _save_last_good({key: out})
         _emit(suites)
     if not suites:
         _emit(suites)
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "--concurrency":
+    if len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        probe_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--concurrency":
         sys.exit(concurrency_main(
             int(sys.argv[2]) if len(sys.argv) > 2 else 8,
             rows=int(os.environ.get("BENCH_CONCURRENCY_ROWS", "150000"))))

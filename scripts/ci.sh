@@ -42,4 +42,15 @@ if [ "$src" -ne 0 ]; then
     echo "pipeline concurrency smoke FAILED (rc=$src)" >&2
     exit "$src"
 fi
+
+echo "== DQ two-worker smoke (scan→join→agg over hash-shuffle edges) =="
+# two real OS worker processes; gates on result correctness AND the
+# dq/* counters being non-zero on router + workers (a refactor that
+# routes around the task runner fails loudly)
+JAX_PLATFORMS=cpu python scripts/dq_smoke.py
+drc=$?
+if [ "$drc" -ne 0 ]; then
+    echo "DQ smoke FAILED (rc=$drc)" >&2
+    exit "$drc"
+fi
 echo "== CI green =="

@@ -1,0 +1,97 @@
+"""Two-OS-worker DQ smoke: scan→join→agg over hash-shuffle edges.
+
+CI leg (`scripts/ci.sh`): spawns two real worker processes (the
+`tests/cluster_worker.py` harness at a tiny scale factor), runs one
+sharded×sharded shuffle-join aggregate through the DQ stage-graph path,
+checks the result against a pandas oracle, and GATES on the new `dq/*`
+counters being non-zero on both the router and the workers — a refactor
+that silently routes around the task runner (or stops shipping frames)
+fails here even if results stay right.
+
+Prints one JSON line; exit 0 = green.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SF = float(os.environ.get("DQ_SMOKE_SF", "0.002"))
+NW = 2
+
+
+def main() -> int:
+    import tempfile
+
+    import numpy as np
+    import pandas as pd
+
+    from tests.cluster_util import spawn_workers, stop_workers
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    root = tempfile.mkdtemp(prefix="dq_smoke_")
+    procs = []
+    try:
+        procs, ports = spawn_workers(root, NW, SF)
+        c = ShardedCluster([f"127.0.0.1:{port}" for port in ports])
+        c.key_columns["lineitem"] = ["l_orderkey", "l_linenumber"]
+        c.key_columns["orders"] = ["o_orderkey"]
+        c.replicated = {"customer", "nation", "region", "part",
+                        "partsupp", "supplier"}
+
+        # scan→join→agg→sort: both sides sharded by row index (NOT
+        # co-partitioned) — rows meet only through hash-shuffle edges
+        sql = ("select o_orderpriority, count(*) as n, "
+               "sum(l_extendedprice) as s from lineitem, orders "
+               "where l_orderkey = o_orderkey and l_discount > 0.02 "
+               "group by o_orderpriority order by o_orderpriority")
+        got = c.query(sql)
+
+        from ydb_tpu.bench.tpch_gen import TpchData
+        data = TpchData(SF)
+        li = pd.DataFrame(data.tables["lineitem"])
+        od = pd.DataFrame(data.tables["orders"])
+        j = li[li.l_discount > 0.02].merge(od, left_on="l_orderkey",
+                                           right_on="o_orderkey")
+        want = j.groupby("o_orderpriority").agg(
+            n=("o_orderpriority", "size"),
+            s=("l_extendedprice", "sum")).reset_index() \
+            .sort_values("o_orderpriority")
+        ok_result = (list(got.o_orderpriority) == list(want.o_orderpriority)
+                     and list(got.n) == list(want.n)
+                     and np.allclose(got.s, want.s, rtol=1e-9))
+
+        router = {k: v for k, v in GLOBAL.snapshot().items()
+                  if k.startswith("dq/")}
+        worker_dq = []
+        for w in c.workers:
+            wc = w.counters()
+            worker_dq.append({k: v for k, v in wc.items()
+                              if k.startswith("dq/")})
+        gate = {
+            "result_ok": ok_result,
+            "router_stages": router.get("dq/stages", 0) > 0,
+            "router_tasks": router.get("dq/tasks", 0) > 0,
+            "worker_frames": all(d.get("dq/frames", 0) > 0
+                                 for d in worker_dq),
+            "worker_bytes": all(d.get("dq/channel_bytes", 0) > 0
+                                for d in worker_dq),
+            "worker_stage_execs": all(d.get("dq/local_stage_execs", 0) > 0
+                                      for d in worker_dq),
+        }
+        ok = all(gate.values())
+        print(json.dumps({"metric": "dq_smoke", "ok": ok, "gate": gate,
+                          "router_counters": router,
+                          "worker_counters": worker_dq}), flush=True)
+        return 0 if ok else 1
+    finally:
+        stop_workers(procs)
+        import shutil
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

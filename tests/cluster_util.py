@@ -1,0 +1,64 @@
+"""Spawn/teardown for real-OS-process cluster workers.
+
+One copy of the startup-race and teardown discipline shared by
+`tests/test_cluster.py`, `tests/test_dq.py` and `scripts/dq_smoke.py`:
+each worker (`tests/cluster_worker.py`) writes its bound port to a
+port-file when ready; spawn polls those under one deadline and tears
+everything down if any worker dies or times out.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read(pf: str) -> str:
+    with open(pf) as f:
+        return f.read().strip()
+
+
+def spawn_workers(root, n_workers: int, sf: float,
+                  startup_timeout: float = 180.0):
+    """Start `n_workers` cluster_worker processes sharding TPC-H at
+    `sf`. Returns (procs, ports) with procs = [(Popen, port_file)];
+    the caller owns teardown via `stop_workers(procs)`."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    procs, ports = [], []
+    try:
+        for wid in range(n_workers):
+            pf = os.path.join(str(root), f"port{wid}")
+            p = subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "tests", "cluster_worker.py"),
+                 str(wid), str(n_workers), str(sf), pf],
+                env=env, cwd=REPO)
+            procs.append((p, pf))
+        deadline = time.time() + startup_timeout
+        for (p, pf) in procs:
+            while not os.path.exists(pf) or not _read(pf):
+                if p.poll() is not None:
+                    raise RuntimeError(
+                        f"worker died: rc={p.returncode}")
+                if time.time() > deadline:
+                    raise RuntimeError("worker startup timed out")
+                time.sleep(0.5)
+            ports.append(int(_read(pf)))
+    except BaseException:
+        stop_workers(procs)
+        raise
+    return procs, ports
+
+
+def stop_workers(procs) -> None:
+    for (p, _pf) in procs:
+        if p.poll() is None:
+            p.terminate()
+    for (p, _pf) in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()

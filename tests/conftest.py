@@ -13,15 +13,35 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# the suite is XLA-compile dominated; the persistent compile cache is
+# safe within one host (identical CPU features process-to-process, the
+# cross-host SIGILL caveat in ydb_tpu/__init__.py doesn't apply) and
+# makes warm reruns materially faster. Explicit env still wins.
+os.environ.setdefault("YDB_TPU_JIT_CACHE", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache"))
+
 import jax  # noqa: E402
 
 # the axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu"
 # via jax.config, which beats the env var — override it back to cpu for the
 # virtual 8-device mesh.
 jax.config.update("jax_platforms", "cpu")
+# cache mid-size executables too (default only >1s compiles) — the suite
+# compiles hundreds of 0.3-1s programs
+try:
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.25)
+except Exception:                        # noqa: BLE001 — cache is optional
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long soaks excluded from the tier-1 run "
+        "(-m 'not slow')")
 
 
 @pytest.fixture

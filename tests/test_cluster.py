@@ -1,16 +1,13 @@
 """Two-PROCESS cluster: shard router over worker engines via gRPC.
 
 VERDICT r3 item 4 ("a second process"): worker engine processes each own
-a shard of `lineitem` (other tables replicated for co-located joins); the
-router (`ydb_tpu/cluster/router.py`) scatters rewritten partial SQL over
-the workers' gRPC fronts and merges locally — TPC-H Q1 runs over shards
-split between real OS processes.
+a shard of `lineitem` (other tables replicated for co-located joins).
+Every SELECT here runs on the DQ path — the router lowers it to a
+`dq.StageGraph` (`ydb_tpu/dq/lower.py`) and `DqTaskRunner` executes one
+task per (stage, worker) with frames streamed over the exchange
+channels; the join tests additionally pin the lowered graph shape
+(hash-shuffle edges between worker stages) and the `dq/*` counters.
 """
-
-import os
-import subprocess
-import sys
-import time
 
 import numpy as np
 import pytest
@@ -27,33 +24,9 @@ NW = 2
 
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
+    from tests.cluster_util import spawn_workers, stop_workers
     root = tmp_path_factory.mktemp("cluster")
-    procs, ports = [], []
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
-    env.pop("XLA_FLAGS", None)
-    for wid in range(NW):
-        pf = root / f"port{wid}"
-        p = subprocess.Popen(
-            [sys.executable, os.path.join(os.path.dirname(__file__),
-                                          "cluster_worker.py"),
-             str(wid), str(NW), str(SF), str(pf)],
-            env=env, cwd=repo)
-        procs.append((p, pf))
-    deadline = time.time() + 180
-    try:
-        for (p, pf) in procs:
-            while not pf.exists() or not pf.read_text().strip():
-                if p.poll() is not None:
-                    raise RuntimeError(f"worker died: {p.returncode}")
-                if time.time() > deadline:
-                    raise RuntimeError("worker startup timed out")
-                time.sleep(0.5)
-            ports.append(int(pf.read_text()))
-    except BaseException:
-        for (p, _pf) in procs:
-            p.terminate()
-        raise
+    procs, ports = spawn_workers(root, NW, SF)
     c = ShardedCluster([f"127.0.0.1:{port}" for port in ports])
     # topology metadata the DDL path would have recorded: lineitem and
     # orders are SHARDED (cluster_worker splits them by row index — NOT
@@ -65,10 +38,7 @@ def cluster(tmp_path_factory):
     from ydb_tpu.bench.tpch_gen import TpchData
     c.tpch_data = TpchData(SF)          # same seed → the oracle dataset
     yield c
-    for (p, _pf) in procs:
-        p.terminate()
-    for (p, _pf) in procs:
-        p.wait(timeout=30)
+    stop_workers(procs)
 
 
 def test_tpch_q1_across_processes(cluster):
@@ -89,6 +59,13 @@ def test_join_agg_across_processes(cluster):
     # lineitem AND orders sharded (by row index — NOT co-partitioned):
     # q3 joins them through the worker<->worker hash shuffle, with
     # customer replicated joining worker-locally afterwards
+    from ydb_tpu.dq.graph import HASH_SHUFFLE, StageGraph
+    graph = cluster.plan(QUERIES["q3"])
+    assert isinstance(graph, StageGraph)
+    shuffles = [c for c in graph.channels.values()
+                if c.kind == HASH_SHUFFLE]
+    assert shuffles, "q3 must lower to a hash-shuffle edge"
+    assert all(not c.router_bound for c in shuffles)
     got = cluster.query(QUERIES["q3"])
     want = oracle("q3", cluster.tpch_data)
     want.columns = list(got.columns)
@@ -109,11 +86,25 @@ def test_shuffle_join_sharded_x_sharded(cluster):
                for w in cluster.workers]
         assert sum(per) == n_total
         assert all(0 < p < n_total for p in per), (t, per)
-    got = cluster.query(
-        "select o_orderpriority, count(*) as n, sum(l_extendedprice) as s "
-        "from lineitem, orders where l_orderkey = o_orderkey "
-        "and l_discount > 0.02 group by o_orderpriority "
-        "order by o_orderpriority")
+    sql = ("select o_orderpriority, count(*) as n, sum(l_extendedprice) as s "
+           "from lineitem, orders where l_orderkey = o_orderkey "
+           "and l_discount > 0.02 group by o_orderpriority "
+           "order by o_orderpriority")
+    # the DQ lowering co-partitions both sharded sides over a
+    # hash-shuffle edge into the join stage, then gathers partial aggs
+    from ydb_tpu.dq.graph import HASH_SHUFFLE, UNION_ALL
+    from ydb_tpu.utils.metrics import GLOBAL
+    graph = cluster.plan(sql)
+    kinds = {c.kind for c in graph.channels.values()}
+    assert HASH_SHUFFLE in kinds and UNION_ALL in kinds
+    stages0 = GLOBAL.get("dq/stages")
+    tasks0 = GLOBAL.get("dq/tasks")
+    got = cluster.query(sql)
+    assert GLOBAL.get("dq/stages") - stages0 == len(graph.stages)
+    # one task per (worker stage, worker)
+    assert GLOBAL.get("dq/tasks") - tasks0 == \
+        sum(NW if s.on == "workers" else 1
+            for s in graph.stages if s.on != "router")
     li = pd.DataFrame(cluster.tpch_data.tables["lineitem"])
     od = pd.DataFrame(cluster.tpch_data.tables["orders"])
     j = li[li.l_discount > 0.02].merge(od, left_on="l_orderkey",

@@ -69,9 +69,13 @@ def cluster(tmp_path):
     ws = _Workers(tmp_path)
     for wid in range(2):
         ws.spawn(wid)
+    # decision log mirrored to a standby sink (VERDICT Weak #11): a lost
+    # router disk must not strand prepared workers in-doubt
     c = ShardedCluster([f"127.0.0.1:{ws.ports[i]}" for i in range(2)],
-                       dtx_log=str(tmp_path / "router_dtx.jsonl"))
+                       dtx_log=str(tmp_path / "router_dtx.jsonl"),
+                       dtx_replica=str(tmp_path / "standby"))
     c._ws = ws
+    c._standby = tmp_path / "standby"
     yield c
     ws.stop()
 
@@ -147,3 +151,42 @@ def test_2pc_commit_and_crash_recovery(cluster):
     c.resolve_in_doubt()                 # unknown gtx → presumed abort
     n3 = _counts(c)
     assert sum(n3) == 60, n3            # the aborted tx left nothing
+
+
+def test_standby_decision_log_recovers_lost_router_disk(cluster, tmp_path):
+    """VERDICT Weak #11: the decision log mirrors synchronously to the
+    standby sink, so losing the router's disk mid-commit no longer
+    strands prepared workers — a NEW router booted from the standby copy
+    re-delivers the logged decision."""
+    import json
+
+    c = cluster
+    ws = c._ws
+    c.execute("create table kv (id Int64 not null, v Int64 not null, "
+              "primary key (id)) with (store = row)")
+    rows = ", ".join(f"({i}, {i})" for i in range(20))
+    assert c.execute(f"upsert into kv (id, v) values {rows}")["ok"]
+
+    # wedge worker 1 in-doubt: killed before applying the commit decision
+    victim = c.workers[1].endpoint
+    c.dtx_test_crash = {victim: "before_apply"}
+    rows = ", ".join(f"({i}, {i})" for i in range(20, 40))
+    assert c.execute(f"upsert into kv (id, v) values {rows}")["healed_later"]
+    ws.wait_dead(1)
+    ws.spawn(1, port=ws.ports[1])
+
+    # the standby mirror carries the commit decision the primary logged
+    mirror = c._standby / "router_dtx.jsonl"
+    assert mirror.exists()
+    recs = [json.loads(ln) for ln in mirror.read_text().splitlines()]
+    assert any(r.get("decision") == "commit" for r in recs)
+
+    # lost router disk: the primary log is GONE; a fresh router boots
+    # with the standby copy as its decision log and heals the worker
+    (tmp_path / "router_dtx.jsonl").unlink()
+    c2 = ShardedCluster([w.endpoint for w in c.workers],
+                        dtx_log=str(mirror))
+    healed = c2.resolve_in_doubt()
+    assert healed["resolved"] >= 1 and not healed["unreachable"]
+    n = _counts(c2)
+    assert sum(n) == 40, n              # the in-doubt commit landed

@@ -77,6 +77,82 @@ def test_engine_soak_live_executables_bounded():
         GLOBAL_BUDGET.max_entries = old_max
 
 
+def test_eviction_releases_executables():
+    """Evicted/overwritten/cleared entries must RELEASE their compiled
+    executables (clear_cache), not just drop the reference — the
+    lifecycle leak behind the r5 full-suite SIGSEGV."""
+    class FakeExec:
+        def __init__(self):
+            self.cleared = 0
+
+        def clear_cache(self):
+            self.cleared += 1
+
+    b = _Budget(2)
+    c = ExecCache("t", b)
+    e1, e2, e3, e4 = FakeExec(), FakeExec(), FakeExec(), FakeExec()
+    c["a"], c["b"] = e1, e2
+    c["c"] = e3                        # evicts e1
+    assert e1.cleared == 1 and e2.cleared == 0
+    c["c"] = e4                        # overwrite releases e3
+    assert e3.cleared == 1
+    assert c.released == 2
+    c.clear()
+    assert e2.cleared == 1 and e4.cleared == 1
+    assert c.released == 4
+    # composite entries (tuples, one level of object attrs) release too
+    class Holder:
+        def __init__(self, fn):
+            self.fn = fn
+    b2 = _Budget(1)
+    c2 = ExecCache("t2", b2)
+    inner1, inner2 = FakeExec(), FakeExec()
+    c2["x"] = (inner1, Holder(inner2), "schema")
+    c2["y"] = FakeExec()               # evicts the composite
+    assert inner1.cleared == 1 and inner2.cleared == 1
+
+
+@pytest.mark.slow
+def test_soak_compile_twice_the_lru_cap_releases():
+    """Soak (marked slow): compile 2× the LRU cap of DISTINCT query
+    shapes in ONE process — the live-executable count stays under the
+    cap, evictions actually release (released counter tracks them), and
+    results stay correct throughout. The full-suite-SIGSEGV scenario,
+    run deliberately."""
+    from ydb_tpu.ops.exec_cache import GLOBAL_BUDGET, live_executables
+    from ydb_tpu.query import QueryEngine
+
+    eng = QueryEngine(block_rows=1 << 12)
+    eng.execute("create table soak (k Int64 not null, a Int64, b Double, "
+                "primary key (k))")
+    eng.execute("insert into soak (k, a, b) values "
+                + ", ".join(f"({i}, {i % 13}, {i * 0.25})"
+                            for i in range(300)))
+    old_max = GLOBAL_BUDGET.max_entries
+    cap = 40
+    GLOBAL_BUDGET.max_entries = cap
+    released_before = sum(
+        c.released for ref in GLOBAL_BUDGET._caches
+        if (c := ref()) is not None)
+    try:
+        for i in range(2 * cap):
+            # distinct literals → distinct program fingerprints →
+            # distinct compiled executables
+            got = eng.query(
+                f"select count(*) as n, sum(b) as s from soak "
+                f"where a = {i % 13} and k >= {i * 3}")
+            expect = [k for k in range(300)
+                      if k % 13 == i % 13 and k >= i * 3]
+            assert int(got.n[0]) == len(expect), i
+            assert live_executables() <= cap, i
+        released_after = sum(
+            c.released for ref in GLOBAL_BUDGET._caches
+            if (c := ref()) is not None)
+        assert released_after > released_before
+    finally:
+        GLOBAL_BUDGET.max_entries = old_max
+
+
 def test_build_cache_hit_and_invalidation():
     from ydb_tpu.query import QueryEngine
 

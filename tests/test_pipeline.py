@@ -299,10 +299,10 @@ def test_hash_partition_kind_routes():
         hash_partition(pd.DataFrame({"k": [1.5]}), "k", 2, kind="float")
 
 
-def test_shuffle_write_uses_schema_kind():
-    """End to end through the servicer: a NULLABLE int key column (object
-    dtype after to_pandas) still int-hashes, so its partitions agree
-    with a NOT NULL producer's."""
+def test_dq_task_shuffle_uses_schema_kind():
+    """End to end through the servicer's DqRunTask: a NULLABLE int key
+    column (object dtype after to_pandas) still int-hashes, so its
+    partitions agree with a NOT NULL producer's."""
     from ydb_tpu.cluster.exchange import hash_partition, unpack_frame
     eng = QueryEngine(block_rows=1 << 10)
     eng.execute("create table s (id Int64 not null, k Int64, "
@@ -324,18 +324,24 @@ def test_shuffle_write_uses_schema_kind():
     orig = S.ExchangeClient
     S.ExchangeClient = FakeClient
     try:
-        resp = sv.shuffle_write({"sql": "select k from s", "key": "k",
-                                 "channel": "c", "peers": ["a", "b"]},
-                                None)
+        resp = sv.dq_run_task(
+            {"task_id": "t.s0.w0", "stage": "s0",
+             "sql": "select k from s", "src": "t.s0.w0.a0",
+             "outputs": [{"channel": "c", "kind": "hash_shuffle",
+                          "key": "k", "n_peers": 2,
+                          "peers": ["a", "b"]}]},
+            None)
     finally:
         S.ExchangeClient = orig
     assert resp.get("ok"), resp
     # partitions must match the int64 splitmix64 routing exactly
     df = pd.DataFrame({"k": np.arange(40, dtype=np.int64)})
     want = hash_partition(df, "k", 2)
-    got = {h["part"]: f for (h, f) in sent}
+    got = {}
+    for (h, f) in sent:
+        got.setdefault(h["part"], []).extend(int(v) for v in f["k"])
     for p in range(2):
-        assert sorted(int(v) for v in got[p]["k"]) \
+        assert sorted(got.get(p, [])) \
             == sorted(int(v) for v in want[p]["k"])
 
 

@@ -33,10 +33,20 @@ from typing import Optional
 
 class DtxJournal:
     """Append-only prepared-transaction journal (worker side), and the
-    decision log (router side) — same format, different record kinds."""
+    decision log (router side) — same format, different record kinds.
 
-    def __init__(self, path: str):
+    `sink` (a `cluster/replica.py` sink): every record ships SYNCHRONOUSLY
+    to the standby after the local fsync, mirrored as a JSON-lines file
+    under the standby root (this journal's basename). A lost router disk
+    then no longer strands prepared workers in-doubt: boot a new router
+    with `dtx_log=<standby>/<basename>` and `resolve_in_doubt()`
+    re-delivers every logged decision (re-shipping a record after a
+    crash-before-ack duplicates a line, which the `decisions()` /
+    `in_doubt()` folds absorb — both are last-record-wins per gtx)."""
+
+    def __init__(self, path: str, sink=None):
         self.path = path
+        self.sink = sink
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
     def append(self, rec: dict) -> None:
@@ -58,6 +68,12 @@ class DtxJournal:
             f.write(json.dumps(rec).encode() + b"\n")
             f.flush()
             os.fsync(f.fileno())
+        if self.sink is not None:
+            # after the local fsync, before the caller proceeds: a
+            # decision the protocol acts on is on both sides first
+            self.sink.ship({"op": "jsonl_append",
+                            "path": os.path.basename(self.path),
+                            "data": rec})
 
     def records(self) -> list:
         try:

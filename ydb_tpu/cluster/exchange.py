@@ -13,9 +13,21 @@ definitions, so every producer routes a key to the same consumer
 (`utils/hashing.py` — host and device agree bit-for-bit).
 
 Frame wire format: 4-byte big-endian header length | header JSON
-{channel, part, src, n_rows} | npz bytes (one array per column; object
-columns allow-pickle within the trusted cluster, the Interconnect trust
-model).
+{channel, part, src, seq, n_rows} | npz bytes (one array per column;
+object columns allow-pickle within the trusted cluster, the Interconnect
+trust model).
+
+The DQ runtime (`ydb_tpu/dq/`) adds two disciplines on top of the raw
+frame plane:
+
+  * idempotent delivery — every frame carries a (src, seq) pair unique
+    within its channel; the receiving `ExchangeBuffer` drops duplicates,
+    so a producer may RETRY a failed `ExchangePut` blindly (the reply
+    may have been lost after the frame landed);
+  * flow control — `ChannelWriter` splits a task's output into bounded
+    frames and caps the bytes in flight per channel, so one fat shuffle
+    cannot balloon sender memory or saturate a peer's buffer in one
+    burst (the output-channel watermarks of `dq_output_channel.cpp`).
 """
 
 from __future__ import annotations
@@ -37,8 +49,9 @@ def hash_partition(df: pd.DataFrame, key: str, n_parts: int,
     inner-join shuffle never matches them).
 
     `kind` ("int" | "string" | None) is the TABLE SCHEMA's verdict on
-    the key type, passed by `shuffle_write` from the stage result's
-    schema. Deciding from the pandas dtype alone (the r5 behavior) is
+    the key type, passed by the DQ task core (`ydb_tpu/dq/task.py
+    run_task`) from the stage result's schema. Deciding from the pandas
+    dtype alone (the r5 behavior) is
     wrong for nullable integer keys: `to_pandas` widens them to object
     dtype, so one producer hashed `str(7)` with crc32 while a NOT NULL
     producer hashed `7` with splitmix64 — the same key routed to two
@@ -127,28 +140,47 @@ def unpack_frame(data: bytes):
 
 class ExchangeBuffer:
     """Per-worker in-memory landing zone for incoming channel frames
-    (the input-channel buffer of a DQ compute actor)."""
+    (the input-channel buffer of a DQ compute actor). Frames carrying a
+    (src, seq) identity are deduplicated per channel, making a retried
+    `ExchangePut` idempotent — the retry may race a first attempt whose
+    reply was lost after the frame landed."""
 
     def __init__(self, budget_bytes: int = 1 << 30):
         import threading
         self._frames: dict = {}           # channel -> [(DataFrame, bytes)]
+        self._seen: dict = {}             # channel -> {(src, seq)}
         self.bytes = 0
+        self.dup_frames = 0
         self.budget = budget_bytes
         self._mu = threading.Lock()
 
-    def put(self, channel: str, df: pd.DataFrame, nbytes: int) -> None:
+    def put(self, channel: str, df: pd.DataFrame, nbytes: int,
+            src: str = "", seq=None) -> bool:
+        """Land one frame; returns False for a (src, seq) duplicate."""
         with self._mu:
+            seen = None
+            if seq is not None:
+                seen = self._seen.setdefault(channel, set())
+                if (src, seq) in seen:
+                    self.dup_frames += 1
+                    return False
             if self.bytes + nbytes > self.budget:
+                # NOT marked seen: a budget-rejected frame never landed,
+                # so the producer's retry must not dedup into a no-op
                 raise MemoryError(
                     f"exchange buffer over budget "
                     f"({self.bytes + nbytes} > {self.budget})")
+            if seen is not None:
+                seen.add((src, seq))
             self._frames.setdefault(channel, []).append((df, nbytes))
             self.bytes += nbytes
+            return True
 
     def take(self, channel: str) -> pd.DataFrame:
         """Drain and concatenate every frame of a channel."""
         with self._mu:
             frames = self._frames.pop(channel, [])
+            self._seen.pop(channel, None)
             self.bytes -= sum(nb for (_f, nb) in frames)
         if not frames:
             return pd.DataFrame()
@@ -157,5 +189,108 @@ class ExchangeBuffer:
     def drop(self, channel: str) -> None:
         with self._mu:
             frames = self._frames.pop(channel, None)
+            self._seen.pop(channel, None)
             if frames:
                 self.bytes -= sum(nb for (_f, nb) in frames)
+
+
+class ChannelWriter:
+    """Producer side of one output channel: splits DataFrames into
+    bounded frames, stamps each with (src, seq), and ships them with a
+    cap on in-flight bytes plus per-frame retry (safe — the receiver
+    dedups on (src, seq)).
+
+    `send(peer_idx, frame_bytes)` is the transport (gRPC ExchangePut to
+    a real peer, a direct buffer put for in-process workers)."""
+
+    def __init__(self, channel: str, src: str, send, n_peers: int,
+                 token: str = "", frame_rows: int = None,
+                 inflight_bytes: int = None, retries: int = 2,
+                 counters=None):
+        import itertools
+        import os
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+        self.channel = channel
+        self.src = src
+        self.token = token
+        self._send = send
+        self.frame_rows = frame_rows or int(os.environ.get(
+            "YDB_TPU_DQ_FRAME_ROWS", 1 << 16))
+        self.inflight_budget = inflight_bytes or int(os.environ.get(
+            "YDB_TPU_DQ_INFLIGHT_BYTES", 32 << 20))
+        self.retries = retries
+        self._counters = counters
+        self._seq = itertools.count()
+        self._inflight = 0
+        self.peak_inflight = 0
+        self.bytes_sent = 0
+        self.frames_sent = 0
+        self._cv = threading.Condition()
+        self._pool = ThreadPoolExecutor(
+            max_workers=min(8, max(2, n_peers)))
+        self._futures: list = []
+
+    def ship(self, peer: int, df: pd.DataFrame) -> None:
+        """Queue one peer's partition, split into flow-controlled frames.
+        An empty partition still ships one frame: the consumer learns the
+        channel's columns even when it received no rows."""
+        nrows = len(df)
+        lo = 0
+        while True:
+            chunk = df.iloc[lo:lo + self.frame_rows]
+            seq = next(self._seq)
+            frame = pack_frame({"channel": self.channel, "part": peer,
+                                "src": self.src, "seq": seq,
+                                "token": self.token}, chunk)
+            self._acquire(len(frame))
+            self._futures.append(
+                self._pool.submit(self._send_one, peer, frame))
+            lo += self.frame_rows
+            if lo >= nrows:
+                break
+
+    def _acquire(self, nbytes: int) -> None:
+        with self._cv:
+            # a frame larger than the whole budget still passes alone
+            while self._inflight and \
+                    self._inflight + nbytes > self.inflight_budget:
+                self._cv.wait()
+            self._inflight += nbytes
+            self.peak_inflight = max(self.peak_inflight, self._inflight)
+
+    def _send_one(self, peer: int, frame: bytes) -> None:
+        import time
+        try:
+            last = None
+            for attempt in range(self.retries + 1):
+                try:
+                    self._send(peer, frame)
+                    break
+                except Exception as e:       # noqa: BLE001 — retried
+                    last = e
+                    time.sleep(0.05 * (attempt + 1))
+            else:
+                raise last
+            with self._cv:
+                self.bytes_sent += len(frame)
+                self.frames_sent += 1
+        finally:
+            with self._cv:
+                self._inflight -= len(frame)
+                self._cv.notify_all()
+
+    def close(self) -> None:
+        """Wait for every queued frame; raise the first transport error."""
+        err = None
+        for f in self._futures:
+            try:
+                f.result()
+            except Exception as e:           # noqa: BLE001
+                err = err or e
+        self._pool.shutdown(wait=True)
+        if self._counters is not None:
+            self._counters.set_max("dq/channel_inflight_peak_bytes",
+                                   self.peak_inflight)
+        if err is not None:
+            raise err

@@ -53,6 +53,16 @@ def apply_op(root: str, op: dict) -> None:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+    elif kind == "jsonl_append":
+        # JSON-lines append (the 2PC decision-log mirror, cluster/dtx.py):
+        # one fsynced line per shipped record. A re-shipped record after a
+        # crash-before-ack duplicates a line; the dtx folds are per-gtx
+        # last-record-wins, so duplicates are harmless.
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "ab") as f:
+            f.write(json.dumps(op["data"]).encode() + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
     elif kind == "unlink":
         try:
             os.unlink(path)

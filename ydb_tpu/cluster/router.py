@@ -2,277 +2,55 @@
 
 The minimal cross-host story SURVEY §5.8 calls for ("ICI intra-pod, gRPC
 across"): N independent engine processes each own a shard of every
-sharded table's rows; a router scatters rewritten SQL over the workers'
-ordinary gRPC front (DCN seam — `ydb/core/grpc_services` +
-TxProxy/Hive routing, radically simplified) and gathers:
+sharded table's rows; a router scatters work over the workers' ordinary
+gRPC front (DCN seam — `ydb/core/grpc_services` + TxProxy/Hive routing,
+radically simplified) and gathers:
 
   * DDL broadcasts to every worker;
   * INSERT routes each VALUES row by primary-key hash (the DataShard
-    key-range analog, hash instead of ranges);
-  * aggregating SELECTs decompose into per-worker PARTIAL queries
-    (sum→sum, count→count, avg→sum+count, min/max→min/max) merged by a
-    local merge query over the gathered partials — the same
-    partial/final split the in-process mesh path uses, with SQL text as
-    the wire format instead of pickled plans;
-  * non-aggregating SELECTs push limit+offset down and re-sort the
-    union.
+    key-range analog, hash instead of ranges), with two-phase commit for
+    multi-worker UPSERTs (`cluster/dtx.py`);
+  * every SELECT lowers to a DQ STAGE GRAPH (`ydb_tpu/dq/`): partial/
+    merge aggregation, two-level distinct, order/limit scatter scans and
+    sharded×sharded hash-shuffle joins are all graph lowerings executed
+    by one task runner over the workers — the per-shape scatter/gather
+    rewrites this module used to hand-roll live in `dq/lower.py` now.
 
 Dimension tables can be created replicated (`replicated=` in
 create_table/ShardedCluster.execute routing): every worker holds a full
 copy, so joins against them stay worker-local (broadcast-join
 co-location, as the reference expects for reference tables).
+
+Workers may be gRPC endpoints ("host:port" → `server.Client`) or any
+object exposing the worker surface directly — `dq.runner.LocalWorker`
+wraps an in-process engine, making single-process execution the
+1-worker degenerate case of the same graph path.
 """
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Optional
 
 import numpy as np
 import pandas as pd
 
+from ydb_tpu.dq.lower import AGGS  # noqa: F401  (back-compat export)
 from ydb_tpu.sql import ast, parse, render
-
-AGGS = ("sum", "count", "min", "max", "avg")
 
 
 class ClusterError(Exception):
     pass
 
 
-class _AggCollector:
-    """Collect distinct aggregate calls in an expression tree and the
-    substitution from each call to its merge-side expression."""
-
-    def __init__(self):
-        self.partial_items: list = []     # [(alias, ast expr)]
-        self.merge_map: dict = {}         # FuncCall -> merge expr (ast)
-        self.has_distinct = False         # seen a DISTINCT aggregate
-        self._n = 0
-
-    def _alias(self) -> str:
-        self._n += 1
-        return f"__a{self._n}"
-
-    def visit(self, e):
-        if isinstance(e, ast.FuncCall) and e.name in AGGS:
-            if e in self.merge_map:
-                return
-            if e.distinct:
-                # recorded, not raised: detection passes (_has_agg) walk
-                # the same tree; only actual decomposition refuses
-                self.has_distinct = True
-                return
-            if e.name == "avg":
-                a_s, a_c = self._alias(), self._alias()
-                self.partial_items.append(
-                    (a_s, ast.FuncCall("sum", e.args)))
-                self.partial_items.append(
-                    (a_c, ast.FuncCall("count", e.args)))
-                self.merge_map[e] = ast.BinOp(
-                    "/",
-                    ast.FuncCall("sum", (ast.Name((a_s,)),)),
-                    ast.FuncCall("sum", (ast.Name((a_c,)),)))
-                return
-            a = self._alias()
-            self.partial_items.append((a, e))
-            merge_fn = {"sum": "sum", "count": "sum",
-                        "min": "min", "max": "max"}[e.name]
-            self.merge_map[e] = ast.FuncCall(merge_fn, (ast.Name((a,)),))
-            return
-        for f in getattr(e, "__dataclass_fields__", ()):
-            v = getattr(e, f)
-            if isinstance(v, tuple):
-                for x in v:
-                    if hasattr(x, "__dataclass_fields__"):
-                        self.visit(x)
-            elif hasattr(v, "__dataclass_fields__"):
-                self.visit(v)
-
-
-def _substitute(e, mapping: dict):
-    """Replace subtrees by the mapping (dataclass equality), recursively."""
-    if e in mapping:
-        return mapping[e]
-    if not hasattr(e, "__dataclass_fields__"):
-        return e
-
-    def rw(v):
-        if isinstance(v, tuple):
-            return tuple(rw(x) for x in v)
-        if hasattr(v, "__dataclass_fields__"):
-            return _substitute(v, mapping)
-        return v
-    try:
-        return dataclasses.replace(
-            e, **{f: rw(getattr(e, f)) for f in e.__dataclass_fields__})
-    except TypeError:
-        return e
-
-
-def _has_agg(sel: ast.Select) -> bool:
-    c = _AggCollector()
-    for it in sel.items:
-        c.visit(it.expr)
-    if sel.having is not None:
-        c.visit(sel.having)
-    return bool(c.merge_map) or c.has_distinct or bool(sel.group_by)
-
-
-def _contains_subquery(node) -> bool:
-    """Any nested SELECT (CTE, derived table, IN/EXISTS/scalar subquery):
-    shipping those verbatim would compute their aggregates shard-locally
-    — silently wrong — so the router refuses them."""
-    if isinstance(node, (ast.InSubquery, ast.Exists, ast.ScalarSubquery,
-                         ast.SubqueryRef)):
-        return True
-    if isinstance(node, ast.Select) and node.ctes:
-        return True
-    for fname in getattr(node, "__dataclass_fields__", ()):
-        v = getattr(node, fname)
-        vs = v if isinstance(v, (tuple, list)) else (v,)
-        for x in vs:
-            if isinstance(x, tuple):
-                if any(_contains_subquery(y) for y in x
-                       if hasattr(y, "__dataclass_fields__")):
-                    return True
-            elif hasattr(x, "__dataclass_fields__") \
-                    and _contains_subquery(x):
-                return True
-    return False
-
-
-def _table_names(rel) -> list:
-    if isinstance(rel, ast.TableRef):
-        return [rel.name]
-    if isinstance(rel, ast.Join):
-        return _table_names(rel.left) + _table_names(rel.right)
-    return []
-
-
-# -- shuffle-join plan helpers ---------------------------------------------
-
-
-def _has_outer_join(rel) -> bool:
-    if isinstance(rel, ast.Join):
-        return (rel.kind not in ("inner", "cross")
-                or _has_outer_join(rel.left) or _has_outer_join(rel.right))
-    return False
-
-
-def _relation_binds(rel) -> dict:
-    """FROM bindings: {bind name (alias or table): table name}."""
-    out: dict = {}
-    if isinstance(rel, ast.TableRef):
-        out[rel.alias or rel.name] = rel.name
-    elif isinstance(rel, ast.Join):
-        out.update(_relation_binds(rel.left))
-        out.update(_relation_binds(rel.right))
-    return out
-
-
-def _collect_names(node, out=None) -> list:
-    if out is None:
-        out = []
-    if isinstance(node, ast.Name):
-        out.append(node.parts)
-        return out
-    for f in getattr(node, "__dataclass_fields__", ()):
-        v = getattr(node, f)
-        vs = v if isinstance(v, (tuple, list)) else (v,)
-        for x in vs:
-            if isinstance(x, tuple):
-                for y in x:
-                    if hasattr(y, "__dataclass_fields__"):
-                        _collect_names(y, out)
-            elif hasattr(x, "__dataclass_fields__"):
-                _collect_names(x, out)
-    return out
-
-
-def _attribute(parts: tuple, binds: dict, table_cols: dict):
-    """Which TABLE a column reference binds to (None = unresolvable)."""
-    if len(parts) == 2:
-        t = binds.get(parts[0])
-        return t
-    hits = [t for t in set(binds.values())
-            if parts[-1] in table_cols.get(t, ())]
-    if len(hits) == 1:
-        return hits[0]
-    if len(hits) > 1:
-        raise ClusterError(f"ambiguous column {parts[-1]!r} across "
-                           f"{sorted(hits)} — qualify it")
-    return None
-
-
-def _conjuncts(e) -> list:
-    if e is None:
-        return []
-    if isinstance(e, ast.BinOp) and e.op == "and":
-        return _conjuncts(e.left) + _conjuncts(e.right)
-    return [e]
-
-
-def _join_ons(rel) -> list:
-    if isinstance(rel, ast.Join):
-        return (_conjuncts(rel.on) + _join_ons(rel.left)
-                + _join_ons(rel.right))
-    return []
-
-
-def _expr_tables(e, binds: dict, table_cols: dict) -> set:
-    out = set()
-    for parts in _collect_names(e):
-        t = _attribute(parts, binds, table_cols)
-        if t is not None:
-            out.add(t)
-    return out
-
-
-def _only_tables(e, allowed: set, binds: dict, table_cols: dict) -> bool:
-    ts = _expr_tables(e, binds, table_cols)
-    return bool(ts) and ts <= allowed
-
-
-def _cross_equality(e, a: str, b: str, binds: dict, table_cols: dict):
-    """`A.x = B.y` (either orientation) → (x, y); else None."""
-    if not (isinstance(e, ast.BinOp) and e.op == "="
-            and isinstance(e.left, ast.Name)
-            and isinstance(e.right, ast.Name)):
-        return None
-    lt = _attribute(e.left.parts, binds, table_cols)
-    rt = _attribute(e.right.parts, binds, table_cols)
-    if lt == a and rt == b:
-        return (e.left.parts[-1], e.right.parts[-1])
-    if lt == b and rt == a:
-        return (e.right.parts[-1], e.left.parts[-1])
-    return None
-
-
-def _rewrite_relation(rel, temp_of: dict):
-    """Swap sharded TableRefs for their shuffle-temp names, keeping the
-    original bind name as the alias so every column reference resolves
-    unchanged."""
-    if isinstance(rel, ast.TableRef):
-        if rel.name in temp_of:
-            return ast.TableRef(temp_of[rel.name],
-                                rel.alias or rel.name)
-        return rel
-    if isinstance(rel, ast.Join):
-        return dataclasses.replace(
-            rel, left=_rewrite_relation(rel.left, temp_of),
-            right=_rewrite_relation(rel.right, temp_of))
-    return rel
-
-
 class ShardedCluster:
     """Router over worker gRPC endpoints (one engine process per shard)."""
 
     def __init__(self, endpoints: list, merge_engine=None,
-                 dtx_log: Optional[str] = None):
+                 dtx_log: Optional[str] = None, dtx_replica=None):
         from ydb_tpu.query import QueryEngine
         from ydb_tpu.server import Client
-        self.workers = [Client(ep) for ep in endpoints]
+        self.workers = [ep if hasattr(ep, "execute") else Client(ep)
+                        for ep in endpoints]
         # local engine used for the merge stage (schema-free: merge runs
         # over the gathered partial frame registered as a temp table)
         self.engine = merge_engine or QueryEngine(block_rows=1 << 16)
@@ -280,8 +58,15 @@ class ShardedCluster:
         self.key_columns: dict = {}         # table -> [pk col]
         # durable coordinator decision log for cross-worker 2PC
         # (cluster/dtx.py). None = single-statement routing only.
+        # `dtx_replica` (a replica sink / directory / endpoint,
+        # cluster/replica.py) mirrors every decision record to a standby
+        # so a lost router disk cannot strand prepared workers in-doubt.
         from ydb_tpu.cluster.dtx import DtxJournal
-        self.dtx_log = DtxJournal(dtx_log) if dtx_log else None
+        sink = None
+        if dtx_replica is not None:
+            from ydb_tpu.cluster.replica import make_sink
+            sink = make_sink(dtx_replica)
+        self.dtx_log = DtxJournal(dtx_log, sink=sink) if dtx_log else None
 
     # -- DDL / DML ----------------------------------------------------------
 
@@ -427,40 +212,7 @@ class ShardedCluster:
                 unreachable.append((w.endpoint, str(e)[:80]))
         return {"resolved": n, "unreachable": unreachable}
 
-    # -- SELECT -------------------------------------------------------------
-
-    def query(self, sql: str) -> pd.DataFrame:
-        from ydb_tpu.query.window import has_window
-        stmt = parse(sql)
-        if not isinstance(stmt, ast.Select):
-            raise ClusterError("the router distributes SELECT; use "
-                               "execute() for DDL/DML")
-        if has_window(stmt):
-            raise ClusterError("window functions are not distributable "
-                               "over shards yet (per-shard windows would "
-                               "be silently wrong)")
-        if _contains_subquery(stmt):
-            raise ClusterError("CTEs/subqueries are not distributable "
-                               "over shards yet (their aggregates would "
-                               "compute shard-locally)")
-        # two sharded tables: hash-shuffle both sides worker<->worker so
-        # the join runs co-partitioned (the DQ HashShuffle connection,
-        # `dq_tasks_graph.h:43` / `dq_output_channel.cpp:31`); more than
-        # two still refuses (needs a multi-stage graph)
-        sharded = [n for n in _table_names(stmt.relation)
-                   if n not in self.replicated and n in self.key_columns]
-        if len(set(sharded)) == 2:
-            return self._shuffle_join_query(stmt, sorted(set(sharded)))
-        if len(set(sharded)) > 2:
-            raise ClusterError(
-                f"joining {len(set(sharded))} sharded tables "
-                f"({sorted(set(sharded))}) is not supported yet — at most "
-                "two shuffle; create dimensions with replicated=True")
-        if _has_agg(stmt):
-            return self._scatter_agg(stmt)
-        return self._scatter_scan(stmt)
-
-    # -- sharded x sharded shuffle join ------------------------------------
+    # -- SELECT (DQ stage-graph path) ---------------------------------------
 
     def _table_columns(self, table: str) -> list:
         """Column names of a worker table (cached; schema probe)."""
@@ -471,264 +223,36 @@ class ShardedCluster:
             cols = cache[table] = list(resp["columns"])
         return cols
 
-    def _shuffle_join_query(self, sel: ast.Select,
-                            sharded: list) -> pd.DataFrame:
-        """Join two sharded tables with a worker<->worker hash shuffle:
-
-          stage 1  each worker projects its shard of A and B (single-
-                   table WHERE conjuncts pushed down) and ships each
-                   row to hash(join key) % n_workers over the exchange
-                   channels — after the barrier every worker holds
-                   co-partitioned rows of BOTH tables;
-          stage 2  the channels materialize as transient tables aliased
-                   to the original names, and the ORIGINAL query —
-                   relation rewritten — runs through the normal
-                   scatter/merge paths (now a worker-local join).
-
-        Neither worker ever holds the other's full shard set, let alone
-        a replicated build — the contract the reference's ShuffleJoin
-        exists for (`dq_opt_join.cpp`)."""
-        import uuid
-
-        if any(isinstance(it.expr, ast.Star) for it in sel.items):
-            raise ClusterError("SELECT * is not supported in a shuffle "
-                               "join — name the columns")
-        if _has_outer_join(sel.relation):
-            # the shuffle drops NULL join keys (inner semantics); a
-            # LEFT/FULL join would silently lose its NULL-extended rows
-            raise ClusterError("outer joins between two sharded tables "
-                               "are not supported yet (inner only)")
-        binds = _relation_binds(sel.relation)       # bind name -> table
-        # column attribution for every Name in the statement
-        table_cols = {t: self._table_columns(t) for t in
-                      {tbl for tbl in binds.values()}}
-        refs = _collect_names(sel)
-        used: dict = {t: set() for t in binds.values()}
-        for parts in refs:
-            t = _attribute(parts, binds, table_cols)
-            if t is not None:
-                used[t].add(parts[-1])
-
-        # join key: the first WHERE/ON equality linking the two sharded
-        # tables (additional equalities stay as local filters — rows
-        # co-partitioned by the first key still satisfy them locally)
-        conjs = _conjuncts(sel.where) + _join_ons(sel.relation)
-        a, b = sharded
-        key_a = key_b = None
-        for c in conjs:
-            pair = _cross_equality(c, a, b, binds, table_cols)
-            if pair is not None:
-                key_a, key_b = pair
-                break
-        if key_a is None:
-            raise ClusterError(
-                f"no equality join condition between sharded tables "
-                f"{a!r} and {b!r} — a cross join cannot shuffle")
-        used[a].add(key_a)
-        used[b].add(key_b)
-
-        # stage 1: project + push down single-table conjuncts; every
-        # worker partitions its shard of both tables over the channels
-        from concurrent.futures import ThreadPoolExecutor
-        tag = uuid.uuid4().hex[:10]
-        endpoints = [w.endpoint for w in self.workers]
-        plans = {}
-        for t, key in ((a, key_a), (b, key_b)):
-            alias = next(al for al, tbl in binds.items() if tbl == t)
-            local = [c for c in _conjuncts(sel.where)
-                     if _only_tables(c, {t}, binds, table_cols)]
-            where = None
-            for c in local:
-                where = c if where is None else ast.BinOp("and", where, c)
-            items = [ast.SelectItem(ast.Name((alias, col)), col)
-                     for col in sorted(used[t])]
-            stage = ast.Select(items=items,
-                               relation=ast.TableRef(t, alias),
-                               where=where)
-            plans[t] = (render.select(stage), key, f"__xch_{tag}_{t}")
-
-        temp_of = {t: f"__xj_{tag}_{t}" for t in sharded}
+    def _lower(self, stmt: ast.Select):
+        from ydb_tpu.dq.lower import DqLowerError, DqTopology, lower_select
+        topo = DqTopology(n_workers=len(self.workers),
+                          replicated=set(self.replicated),
+                          key_columns=dict(self.key_columns))
         try:
-            for t, (sql, key, channel) in plans.items():
-                with ThreadPoolExecutor(
-                        max_workers=len(self.workers)) as pool:
-                    resps = list(pool.map(
-                        lambda w: w.shuffle_write(sql, key, channel,
-                                                  endpoints),
-                        self.workers))
-                dtypes: dict = {}
-                for r in resps:
-                    dtypes.update(r.get("dtypes") or {})
-                cols = [(c, dtypes.get(c, "float64"))
-                        for c in sorted(used[t])]
-                # barrier: every producer finished before any consumer
-                # drains its channel (the stage boundary of the graph)
-                with ThreadPoolExecutor(
-                        max_workers=len(self.workers)) as pool:
-                    list(pool.map(
-                        lambda w: w.channel_open(channel, temp_of[t],
-                                                 columns=cols),
-                        self.workers))
-            final = dataclasses.replace(
-                sel, relation=_rewrite_relation(sel.relation, temp_of))
-            return self.query(render.select(final))
-        finally:
-            for w in self.workers:
-                try:
-                    w.channel_close(tables=list(temp_of.values()),
-                                    channels=[ch for (_s, _k, ch)
-                                              in plans.values()])
-                except Exception:            # noqa: BLE001 — best effort
-                    pass
-
-    def _gather(self, worker_sql: str) -> pd.DataFrame:
-        """Scatter one SQL text over every worker CONCURRENTLY (they are
-        separate processes — a sequential loop would serialize the very
-        work the router distributes) and union the frames."""
-        from concurrent.futures import ThreadPoolExecutor
-        with ThreadPoolExecutor(max_workers=len(self.workers)) as pool:
-            resps = list(pool.map(lambda w: w.execute(worker_sql),
-                                  self.workers))
-        frames = [pd.DataFrame(r["rows"], columns=r["columns"])
-                  for r in resps]
-        return pd.concat(frames, ignore_index=True)
-
-    def _scatter_scan(self, sel: ast.Select) -> pd.DataFrame:
-        from ydb_tpu.query.window import apply_order_limit
-        lim = None if sel.limit is None else sel.limit + (sel.offset or 0)
-        worker_sel = dataclasses.replace(sel, limit=lim, offset=None)
-        df = self._gather(render.select(worker_sel))
-        if sel.distinct:
-            # per-shard DISTINCT leaves cross-shard duplicates
-            df = df.drop_duplicates(ignore_index=True)
-        # ORDER BY the pre-alias expression: rewrite to the output alias
-        # (the merge sorts the gathered frame by column name)
-        alias_of = {it.expr: it.alias for it in sel.items if it.alias}
-        order = [dataclasses.replace(o, expr=ast.Name((alias_of[o.expr],)))
-                 if o.expr in alias_of else o for o in sel.order_by]
-        try:
-            return apply_order_limit(df, order, sel.limit, sel.offset)
-        except ValueError as e:
+            return lower_select(stmt, topo, self._table_columns)
+        except DqLowerError as e:
             raise ClusterError(str(e)) from e
 
-    def _scatter_agg(self, sel: ast.Select) -> pd.DataFrame:
-        if sel.distinct or sel.ctes:
-            raise ClusterError("DISTINCT/CTE SELECTs are not "
-                               "distributable over shards yet")
-        cd = self._try_count_distinct(sel)
-        if cd is not None:
-            return cd
-        col = _AggCollector()
-        for it in sel.items:
-            col.visit(it.expr)
-        if sel.having is not None:
-            col.visit(sel.having)
-        for o in sel.order_by:
-            col.visit(o.expr)
-        if col.has_distinct:
-            # the distinct-only shape was handled above; mixtures of
-            # DISTINCT and plain aggregates need a per-agg shuffle plan
-            raise ClusterError(
-                "mixing DISTINCT aggregates with other aggregates is "
-                "not distributable over shards yet")
+    def plan(self, sql: str):
+        """Lower a SELECT to its DQ stage graph without running it
+        (EXPLAIN for the distributed plan)."""
+        stmt = parse(sql)
+        if not isinstance(stmt, ast.Select):
+            raise ClusterError("only SELECT lowers to a stage graph")
+        return self._lower(stmt)
 
-        # group keys become named partial columns
-        gmap = {}
-        gitems = []
-        for i, g in enumerate(sel.group_by):
-            a = f"__g{i}"
-            gmap[g] = ast.Name((a,))
-            gitems.append(ast.SelectItem(g, a))
-        items = gitems + [ast.SelectItem(e, a)
-                          for (a, e) in col.partial_items]
-        worker_sel = ast.Select(
-            items=items, relation=sel.relation, where=sel.where,
-            group_by=list(sel.group_by), ctes=list(sel.ctes))
-        partial = self._gather(render.select(worker_sel))
-
-        # merge locally: substitute agg calls and group exprs, run over
-        # the gathered frame as a temp table
-        sub = {**col.merge_map, **gmap}
-        def _label(it, i):
-            if it.alias:
-                return it.alias
-            if isinstance(it.expr, ast.Name):     # single-node naming
-                return it.expr.parts[-1]
-            return f"column{i}"
-
-        mitems = [ast.SelectItem(_substitute(it.expr, sub), _label(it, i))
-                  for i, it in enumerate(sel.items)]
-        morder = [dataclasses.replace(o, expr=_substitute(o.expr, sub))
-                  for o in sel.order_by]
-        mhaving = _substitute(sel.having, sub) \
-            if sel.having is not None else None
-        mgroup = [gmap[g] for g in sel.group_by]
-
-        from ydb_tpu.core.block import HostBlock
-        eng = self.engine
-        block = HostBlock.from_pandas(partial)
-        return self._merge_over_temp(block, sel, mitems, mgroup, mhaving,
-                                     morder)
-
-    def _try_count_distinct(self, sel: ast.Select):
-        """COUNT(DISTINCT x) distribution (the two-level distinct
-        shuffle): supported when every aggregate is a distinct count —
-        workers return SELECT DISTINCT keys+args, the merge counts.
-        Returns None when the shape doesn't apply."""
-        aggs = []
-        for it in sel.items:
-            if isinstance(it.expr, ast.FuncCall) \
-                    and it.expr.name in AGGS:
-                if not (it.expr.name == "count" and it.expr.distinct):
-                    return None
-                aggs.append(it)
-            elif it.expr not in sel.group_by:
-                return None
-        if not aggs:
-            return None
-        gitems = [ast.SelectItem(g, f"__g{i}")
-                  for i, g in enumerate(sel.group_by)]
-        ditems = [ast.SelectItem(a.expr.args[0], f"__d{k}")
-                  for k, a in enumerate(aggs)]
-        worker_sel = ast.Select(items=gitems + ditems,
-                                relation=sel.relation, where=sel.where,
-                                distinct=True)
-        partial = self._gather(render.select(worker_sel)) \
-            .drop_duplicates(ignore_index=True)     # cross-shard dups
-        gmap = {g: ast.Name((f"__g{i}",))
-                for i, g in enumerate(sel.group_by)}
-        mitems, k = [], 0
-        for i, it in enumerate(sel.items):
-            if it in aggs:
-                e = ast.FuncCall("count", (ast.Name((f"__d{k}",)),),
-                                 distinct=True)
-                k += 1
-            else:
-                e = _substitute(it.expr, gmap)
-            alias = it.alias or (it.expr.parts[-1]
-                                 if isinstance(it.expr, ast.Name)
-                                 else f"column{i}")
-            mitems.append(ast.SelectItem(e, alias))
-        morder = [dataclasses.replace(o, expr=_substitute(o.expr, gmap))
-                  for o in sel.order_by]
-        from ydb_tpu.core.block import HostBlock
-        block = HostBlock.from_pandas(partial)
-        return self._merge_over_temp(block, sel, mitems,
-                                     [gmap[g] for g in sel.group_by],
-                                     None, morder)
-
-    def _merge_over_temp(self, block, sel, mitems, mgroup, mhaving,
-                         morder) -> pd.DataFrame:
-        eng = self.engine
-        temps: list = []
+    def query(self, sql: str) -> pd.DataFrame:
+        """Distribute one SELECT: lower to a StageGraph, execute it with
+        the task runner (one task per (stage, worker), channels between
+        stages), merge router-side."""
+        from ydb_tpu.dq.runner import DqError, DqTaskRunner
+        stmt = parse(sql)
+        if not isinstance(stmt, ast.Select):
+            raise ClusterError("the router distributes SELECT; use "
+                               "execute() for DDL/DML")
+        graph = self._lower(stmt)
+        runner = DqTaskRunner(self.workers, self.engine)
         try:
-            tname = eng._register_temp(block, temps)
-            merge_sel = ast.Select(
-                items=mitems, relation=ast.TableRef(tname),
-                group_by=mgroup, having=mhaving, order_by=morder,
-                limit=sel.limit, offset=sel.offset)
-            return eng.query(render.select(merge_sel))
-        finally:
-            for tn in temps:
-                if eng.catalog.has(tn):
-                    eng.catalog.drop_table(tn)
+            return runner.run(graph)
+        except DqError as e:
+            raise ClusterError(str(e)) from e

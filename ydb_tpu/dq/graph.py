@@ -1,0 +1,130 @@
+"""Stage graph — the physical distribution plan.
+
+The analog of the reference's `TDqTasksGraph` (`dq_tasks_graph.h:43-165`):
+a query lowers to *stages* (each owning one program — here a rendered
+stage SQL the worker engine compiles to its `ir.Program` pipelines, or a
+router-side merge select) connected by typed *channels*:
+
+  hash_shuffle  every producer routes each row to hash(key) % n_workers
+                (the HashShuffle connection — co-partitions join sides);
+  broadcast     every producer ships its full output to every consumer
+                (the Broadcast connection — replicated build sides);
+  union_all     producers ship everything to the single consumer, order
+                irrelevant (the UnionAll connection — partial-agg gather);
+  merge         union_all whose producers emit sorted streams; the
+                consumer restores the total order (Merge connection).
+
+union_all / merge channels with an empty dst are *router-bound*: their
+frames return in the task response and the final router stage merges
+them locally. Worker-bound channels land in each consumer's exchange
+buffer and materialize as transient `__xj_*` tables before the consumer
+stage runs (the stage barrier).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+HASH_SHUFFLE = "hash_shuffle"
+BROADCAST = "broadcast"
+UNION_ALL = "union_all"
+MERGE = "merge"
+
+CHANNEL_KINDS = (HASH_SHUFFLE, BROADCAST, UNION_ALL, MERGE)
+
+# consumer-side temp tables must live inside the shuffle-temp namespace
+# the channel RPCs enforce (`server/service.py` SHUFFLE_TMP_PREFIX)
+DQ_TMP_PREFIX = "__xj_dq"
+
+
+@dataclass
+class Channel:
+    id: str
+    kind: str                       # one of CHANNEL_KINDS
+    src_stage: str
+    dst_stage: str = ""             # "" = router-bound (collected)
+    key: str = ""                   # hash_shuffle: routing column
+    columns: list = field(default_factory=list)   # produced column names
+    table: str = ""                 # consumer-side temp table name
+
+    @property
+    def router_bound(self) -> bool:
+        return not self.dst_stage
+
+
+@dataclass
+class Stage:
+    """One stage: the same program runs as one task per worker (or a
+    single task for `on="worker0"`), or router-locally for the final
+    merge stage (`on="router"`)."""
+    id: str
+    sql: str = ""                   # worker stage program (rendered SQL)
+    inputs: list = field(default_factory=list)    # channel ids consumed
+    outputs: list = field(default_factory=list)   # channel ids produced
+    on: str = "workers"             # workers | worker0 | router
+    # router merge stage: SELECT over the gathered frame registered as a
+    # temp table — relation is TableRef(INPUT_TABLE), swapped at run time
+    merge_sel: Optional[object] = None
+    # router stage host-side tail: {"distinct", "order", "limit",
+    # "offset"} applied via apply_order_limit (scan-shape merges whose
+    # ORDER BY refers to output columns)
+    post: Optional[dict] = None
+    dedup_input: bool = False       # drop cross-worker duplicate rows
+
+INPUT_TABLE = "__dq_partial__"      # merge_sel relation placeholder
+
+
+@dataclass
+class StageGraph:
+    """Stages in topological order (lowering emits producers first) +
+    the channel table. Exactly one router stage, last, produces the
+    statement result."""
+    stages: list = field(default_factory=list)
+    channels: dict = field(default_factory=dict)
+    tag: str = ""
+
+    def stage(self, sid: str) -> Stage:
+        for s in self.stages:
+            if s.id == sid:
+                return s
+        raise KeyError(sid)
+
+    def validate(self) -> None:
+        seen: set = set()
+        routers = [s for s in self.stages if s.on == "router"]
+        if len(routers) != 1 or self.stages[-1].on != "router":
+            raise ValueError("StageGraph needs exactly one router stage, "
+                             "last")
+        for ch in self.channels.values():
+            if ch.kind not in CHANNEL_KINDS:
+                raise ValueError(f"bad channel kind {ch.kind!r}")
+            if ch.kind in (HASH_SHUFFLE, BROADCAST) and ch.router_bound:
+                raise ValueError(f"{ch.kind} channel {ch.id} cannot be "
+                                 "router-bound")
+            if not ch.router_bound and not ch.table.startswith("__xj_"):
+                raise ValueError(f"channel temp {ch.table!r} outside the "
+                                 "__xj_* namespace")
+        for s in self.stages:
+            for cid in s.inputs:
+                ch = self.channels[cid]
+                if ch.src_stage not in seen:
+                    raise ValueError(
+                        f"stage {s.id} consumes {cid} before its producer "
+                        f"{ch.src_stage} (not topological)")
+            seen.add(s.id)
+
+    def explain(self) -> str:
+        lines = []
+        for s in self.stages:
+            outs = ", ".join(
+                f"{c}:{self.channels[c].kind}"
+                + (f"({self.channels[c].key})"
+                   if self.channels[c].key else "")
+                for c in s.outputs)
+            lines.append(f"stage {s.id} on={s.on}"
+                         + (f" inputs={s.inputs}" if s.inputs else "")
+                         + (f" -> {outs}" if outs else " -> result"))
+            if s.sql:
+                lines.append(f"  {s.sql}")
+        return "\n".join(lines)

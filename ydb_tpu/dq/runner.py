@@ -1,0 +1,335 @@
+"""DQ task runner — the executer actor over a StageGraph.
+
+Walks the graph in topological order; each worker stage runs as one task
+per worker (the reference's one-compute-actor-per-(stage, partition)),
+tracked through a pending → running → finished/failed state machine.
+Channel failures retry at STAGE granularity: the stage's output channels
+are dropped everywhere reachable and every task re-runs with the SAME
+frame src — stage programs are deterministic, so a timed-out first
+attempt that is still running ships byte-identical (src, seq) frames and
+the receiver's dedup absorbs whichever attempt lands second (a worker
+that stays dead turns into a clean error naming it — never a hang,
+never a torn result).
+
+`LocalWorker` adapts an in-process `QueryEngine` to the same worker
+surface the gRPC `server.Client` exposes, so a 1-worker graph is the
+degenerate case of the exact distributed code path (pinned byte-equal to
+the fused in-process path by `tests/test_dq.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import pandas as pd
+
+from ydb_tpu.dq.graph import StageGraph
+from ydb_tpu.sql import ast, render
+from ydb_tpu.utils.metrics import GLOBAL
+
+
+class DqError(Exception):
+    pass
+
+
+class DqTaskRunner:
+    def __init__(self, workers: list, engine, counters=None,
+                 stage_retries: int = 1, rpc_timeout: float = None):
+        self.workers = list(workers)
+        self.engine = engine                 # router-side merge engine
+        self.counters = counters or GLOBAL
+        self.stage_retries = stage_retries
+        self.rpc_timeout = rpc_timeout if rpc_timeout is not None else \
+            float(os.environ.get("YDB_TPU_DQ_RPC_TIMEOUT", 600.0))
+        self.task_log: list = []             # observability + tests
+        for w in self.workers:
+            if hasattr(w, "bind_peers"):
+                w.bind_peers(self.workers)
+
+    # -- public -------------------------------------------------------------
+
+    def run(self, graph: StageGraph) -> pd.DataFrame:
+        graph.validate()
+        self._dtypes: dict = {}              # channel id -> {col: dtype}
+        self._collected: dict = {}           # channel id -> {widx: frame}
+        try:
+            for stage in graph.stages:
+                if stage.on == "router":
+                    return self._run_router_stage(graph, stage)
+                self._run_worker_stage(graph, stage)
+            raise DqError("stage graph ended without a router stage")
+        finally:
+            self._cleanup(graph)
+
+    # -- worker stages ------------------------------------------------------
+
+    def _task_workers(self, stage) -> list:
+        if stage.on == "worker0":
+            return [(0, self.workers[0])]
+        return list(enumerate(self.workers))
+
+    def _run_worker_stage(self, graph, stage) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+        self.counters.inc("dq/stages")
+        tws = self._task_workers(stage)
+        self._materialize_inputs(graph, stage)
+        specs = []
+        for cid in stage.outputs:
+            ch = graph.channels[cid]
+            specs.append({"channel": ch.id, "kind": ch.kind,
+                          "key": ch.key, "n_peers": len(self.workers),
+                          "peers": [w.endpoint for w in self.workers]})
+        tasks = {i: {"task": f"{graph.tag}.{stage.id}.w{i}",
+                     "stage": stage.id, "worker": w.endpoint,
+                     "state": "pending", "attempts": 0}
+                 for (i, w) in tws}
+        self.task_log.extend(tasks.values())
+
+        for attempt in range(self.stage_retries + 1):
+            def one(iw):
+                i, w = iw
+                t = tasks[i]
+                t["state"], t["attempts"] = "running", attempt + 1
+                self.counters.inc("dq/tasks")
+                try:
+                    # src is attempt-INDEPENDENT on purpose: the stage
+                    # program is deterministic (same inputs, same frame
+                    # boundaries, same seq order), so a timed-out first
+                    # attempt still running concurrently with the retry
+                    # ships byte-identical (src, seq) frames — the
+                    # receiver dedups them instead of double-landing rows
+                    resp = w.dq_run_task(
+                        task_id=t["task"], stage=stage.id, sql=stage.sql,
+                        outputs=specs, src=t["task"],
+                        timeout=self.rpc_timeout)
+                    t["state"] = "finished"
+                    return (i, resp, None)
+                except Exception as e:       # noqa: BLE001 — per-task
+                    t["state"] = "failed"
+                    t["error"] = f"{type(e).__name__}: {e}"
+                    return (i, None, e)
+
+            with ThreadPoolExecutor(max_workers=len(tws)) as pool:
+                results = list(pool.map(one, tws))
+            failed = [(i, e) for (i, _r, e) in results if e is not None]
+            if not failed:
+                break
+            # stage-level retry: drop the half-delivered output channels
+            # everywhere reachable, then re-run every task of the stage
+            # under a new attempt id
+            if attempt < self.stage_retries:
+                self.counters.inc("dq/tasks_retried", len(tws))
+                self._drop_outputs(graph, stage)
+                time.sleep(0.1)
+                continue
+            names = ", ".join(f"{tasks[i]['worker']} "
+                              f"({tasks[i].get('error', '?')[:120]})"
+                              for (i, _e) in failed)
+            raise DqError(
+                f"stage {stage.id} failed after "
+                f"{self.stage_retries + 1} attempt(s) on: {names}")
+
+        for (i, resp, _e) in results:
+            for cid in stage.outputs:
+                ch = graph.channels[cid]
+                self._dtypes.setdefault(cid, {}).update(
+                    resp.get("dtypes") or {})
+                if ch.router_bound:
+                    frame = self._collected_frame(resp)
+                    if frame is not None:
+                        self._collected.setdefault(cid, {})[i] = frame
+            self.counters.inc("dq/channel_bytes",
+                              resp.get("bytes_shipped", 0))
+            self.counters.inc("dq/frames", resp.get("frames_shipped", 0))
+
+    def _materialize_inputs(self, graph, stage) -> None:
+        """Stage barrier, consumer side: every producer task finished (the
+        runner only reaches this stage afterwards), so drain each input
+        channel into its typed transient table on every task worker."""
+        from concurrent.futures import ThreadPoolExecutor
+        for cid in stage.inputs:
+            ch = graph.channels[cid]
+            dtypes = self._dtypes.get(cid, {})
+            cols = [(c, dtypes.get(c, "float64")) for c in ch.columns]
+            tws = self._task_workers(stage)
+
+            def open_one(iw, _ch=ch, _cols=cols):
+                _i, w = iw
+                try:
+                    return w.channel_open(_ch.id, _ch.table,
+                                          columns=_cols,
+                                          timeout=self.rpc_timeout)
+                except Exception as e:       # noqa: BLE001 — one surface:
+                    # a worker lost at the barrier must raise DqError so
+                    # the router maps it to ClusterError like every other
+                    # failure mode
+                    raise DqError(
+                        f"channel {_ch.id} barrier failed on "
+                        f"{w.endpoint}: {type(e).__name__}: "
+                        f"{str(e)[:200]}") from e
+            with ThreadPoolExecutor(max_workers=len(tws)) as pool:
+                list(pool.map(open_one, tws))
+
+    def _drop_outputs(self, graph, stage) -> None:
+        chans = list(stage.outputs)
+        for cid in chans:
+            self._collected.pop(cid, None)
+        for w in self.workers:
+            try:
+                w.channel_close(channels=chans, timeout=self.rpc_timeout)
+            except Exception:                # noqa: BLE001 — best effort
+                pass
+
+    @staticmethod
+    def _collected_frame(resp):
+        if "collected_df" in resp:
+            return resp["collected_df"]
+        c = resp.get("collected")
+        if c is None:
+            return None
+        return pd.DataFrame(c["rows"], columns=c["columns"])
+
+    # -- router (merge) stage ----------------------------------------------
+
+    def _run_router_stage(self, graph, stage) -> pd.DataFrame:
+        from ydb_tpu.query.window import apply_order_limit
+        self.counters.inc("dq/stages")
+        frames = []
+        for cid in stage.inputs:
+            got = self._collected.get(cid, {})
+            frames.extend(f for (_i, f) in sorted(got.items()))
+        if not frames:
+            raise DqError(f"router stage {stage.id} collected no frames")
+        df = pd.concat(frames, ignore_index=True) if len(frames) > 1 \
+            else frames[0].reset_index(drop=True)
+        if stage.dedup_input:
+            df = df.drop_duplicates(ignore_index=True)
+        if stage.merge_sel is not None:
+            return self._merge_over_temp(stage.merge_sel, df)
+        if stage.post is not None:
+            if stage.post.get("distinct"):
+                # per-worker DISTINCT leaves cross-worker duplicates
+                df = df.drop_duplicates(ignore_index=True)
+            try:
+                return apply_order_limit(df, stage.post.get("order") or [],
+                                         stage.post.get("limit"),
+                                         stage.post.get("offset"))
+            except ValueError as e:
+                raise DqError(str(e)) from e
+        return df
+
+    def _merge_over_temp(self, merge_sel: ast.Select,
+                         df: pd.DataFrame) -> pd.DataFrame:
+        from ydb_tpu.core.block import HostBlock
+        eng = self.engine
+        temps: list = []
+        try:
+            tname = eng._register_temp(HostBlock.from_pandas(df), temps)
+            final = dataclasses.replace(merge_sel,
+                                        relation=ast.TableRef(tname))
+            try:
+                return eng.query(render.select(final))
+            except Exception as e:           # noqa: BLE001 — one surface
+                raise DqError(f"router merge stage failed: "
+                              f"{type(e).__name__}: {e}") from e
+        finally:
+            for tn in temps:
+                if eng.catalog.has(tn):
+                    eng.catalog.drop_table(tn)
+
+    # -- cleanup ------------------------------------------------------------
+
+    def _cleanup(self, graph) -> None:
+        tables = [ch.table for ch in graph.channels.values() if ch.table]
+        chans = list(graph.channels)
+        if not tables and not chans:
+            return
+        for w in self.workers:
+            try:
+                w.channel_close(tables=tables, channels=chans,
+                                timeout=self.rpc_timeout)
+            except Exception:                # noqa: BLE001 — best effort
+                pass
+
+
+class LocalWorker:
+    """In-process worker: the same control surface `server.Client` gives
+    the runner (execute / dq_run_task / channel_open / channel_close /
+    counters), driving a local QueryEngine directly with an in-process
+    exchange buffer — the 1-worker degenerate case, and N-engine
+    single-process clusters in tests."""
+
+    def __init__(self, engine, name: str = ""):
+        from ydb_tpu.cluster.exchange import ExchangeBuffer
+        from ydb_tpu.utils.metrics import Counters
+        self.engine = engine
+        self.endpoint = f"local:{name or hex(id(engine))[2:]}"
+        self.exchange = ExchangeBuffer()
+        self._peers = [self]
+        self.tasks: dict = {}
+        # worker-side task counters go to a private sink: runner and
+        # worker share GLOBAL in-process, so counting on both sides
+        # would report 2x the real dq/tasks|frames|channel_bytes
+        self.task_counters = Counters()
+
+    def bind_peers(self, peers: list) -> None:
+        self._peers = list(peers)
+
+    # -- data plane ---------------------------------------------------------
+
+    def _land(self, frame: bytes) -> None:
+        from ydb_tpu.cluster.exchange import unpack_frame
+        header, df = unpack_frame(frame)
+        self.exchange.put(header["channel"], df, len(frame),
+                          src=header.get("src", ""),
+                          seq=header.get("seq"))
+
+    # -- worker surface -----------------------------------------------------
+
+    def execute(self, sql: str) -> dict:
+        from ydb_tpu.server.service import _result_payload
+        block = self.engine.execute(sql)
+        return _result_payload(block, getattr(self.engine, "last_stats",
+                                              None))
+
+    def dq_run_task(self, task_id: str, stage: str, sql: str,
+                    outputs: list, src: str, timeout=None) -> dict:
+        from ydb_tpu.dq import task as dq_task
+        rec = self.tasks.setdefault(task_id, {"stage": stage,
+                                              "attempts": 0})
+        rec["state"], rec["attempts"] = "running", rec["attempts"] + 1
+        try:
+            resp = dq_task.run_task(
+                self.engine, sql, outputs, src,
+                send=lambda _o, p, frame: self._peers[p]._land(frame),
+                counters=self.task_counters)
+            rec["state"] = "finished"
+            return resp
+        except Exception as e:
+            rec["state"], rec["error"] = "failed", str(e)
+            raise
+
+    def channel_open(self, channel: str, table: str, columns=None,
+                     timeout=None) -> dict:
+        from ydb_tpu.dq.task import materialize_channel
+        rows = materialize_channel(self.engine, self.exchange, channel,
+                                   table, columns)
+        return {"ok": True, "rows": rows}
+
+    def channel_close(self, tables=(), channels=(), timeout=None) -> dict:
+        for name in tables:
+            if self.engine.catalog.has(name) and \
+                    getattr(self.engine.catalog.table(name), "transient",
+                            False):
+                self.engine.catalog.drop_table(name)
+        for ch in channels:
+            self.exchange.drop(ch)
+        return {"ok": True}
+
+    def counters(self) -> dict:
+        return self.engine.counters()
+
+    def ping(self) -> bool:
+        return True

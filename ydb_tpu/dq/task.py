@@ -1,0 +1,125 @@
+"""Worker-side task execution — the DQ compute-actor seat.
+
+One *task* = (stage, worker): run the stage program through the local
+engine, then route the result over the stage's output channels —
+hash-partitioned to peers, broadcast to every peer, or collected back to
+the runner for a router-bound channel. Shared verbatim by the gRPC
+servicer (`server/service.py` DqRunTask) and the in-process
+`LocalWorker` (`dq/runner.py`), so the 1-worker degenerate case runs the
+exact code the cluster runs.
+"""
+
+from __future__ import annotations
+
+from ydb_tpu.utils.metrics import GLOBAL
+
+
+def run_task(engine, sql: str, outputs: list, src: str, send,
+             token: str = "", counters=None) -> dict:
+    """Execute one task. `outputs`: [{"channel", "kind", "key", "n_peers"}]
+    specs; `send(out, peer_idx, frame_bytes)` is the transport for
+    worker-bound channels. Returns {"ok", "rows_in", "dtypes",
+    "bytes_shipped", "frames_shipped"[, "collected_df"]} — the caller
+    serializes `collected_df` for the wire."""
+    from ydb_tpu.cluster.exchange import ChannelWriter, hash_partition
+    counters = counters or GLOBAL
+    executor = engine.executor
+    executor.dq_stage_depth += 1
+    try:
+        block = engine.execute(sql)
+    finally:
+        executor.dq_stage_depth -= 1
+    df = block.to_pandas()
+    resp = {"ok": True, "rows_in": len(df),
+            "dtypes": {c: str(df[c].dtype) for c in df.columns}}
+    total_bytes = total_frames = 0
+    for out in outputs:
+        kind = out["kind"]
+        if kind in ("union_all", "merge"):
+            resp["collected_df"] = df
+            continue
+        n_peers = int(out["n_peers"])
+        if kind == "hash_shuffle":
+            key = out["key"]
+            # the key's hash route comes from the SCHEMA, not the pandas
+            # dtype: nullable int keys widen to object dtype in pandas
+            # and would otherwise string-hash on this producer while a
+            # NOT NULL producer int-hashes — the same key landing on two
+            # consumers silently drops sharded-join matches
+            kkind = None
+            if block.schema.has(key):
+                dt = block.schema.dtype(key)
+                kkind = ("string" if dt.is_string
+                         else "float" if dt.is_float else "int")
+            parts = hash_partition(df, key, n_peers, kind=kkind)
+        elif kind == "broadcast":
+            parts = [df] * n_peers
+        else:
+            raise ValueError(f"bad output channel kind {kind!r}")
+        writer = ChannelWriter(
+            out["channel"], src,
+            lambda p, frame, _o=out: send(_o, p, frame),
+            n_peers, token=token, counters=counters)
+        try:
+            for p in range(n_peers):
+                writer.ship(p, parts[p])
+        finally:
+            writer.close()
+        total_bytes += writer.bytes_sent
+        total_frames += writer.frames_sent
+    resp["bytes_shipped"] = total_bytes
+    resp["frames_shipped"] = total_frames
+    counters.inc("dq/tasks")
+    if total_frames:
+        counters.inc("dq/frames", total_frames)
+        counters.inc("dq/channel_bytes", total_bytes)
+    return resp
+
+
+def materialize_channel(engine, exchange, channel: str, table: str,
+                        columns=None) -> int:
+    """Drain a channel's frames into a transient local table — the stage
+    barrier's consumer side (ChannelOpen). `columns`: [(name, dtype)] so
+    a worker that received no partitions still registers a typed temp.
+    Namespace/auth policy stays with the caller (the servicer)."""
+    from ydb_tpu.core.block import HostBlock
+    from ydb_tpu.storage.mvcc import WriteVersion
+    df = exchange.take(channel)
+    if df.empty and columns:
+        df = empty_typed_frame(columns)
+    block = HostBlock.from_pandas(df)
+    if engine.catalog.has(table):
+        # drop-and-recreate only ever replaces a transient temp: a
+        # durable table that happens to sit in the namespace is not ours
+        # to clobber
+        old = engine.catalog.table(table)
+        if not getattr(old, "transient", False):
+            raise ValueError(f"refusing to replace non-transient table "
+                             f"{table!r}")
+        engine.catalog.drop_table(table)
+    t = engine.catalog.create_table(
+        table, block.schema, [block.schema.names[0]], transient=True)
+    # the block's dictionaries BECOME the table's: the binder reads
+    # table-level dictionaries for group-by domains and rank LUTs —
+    # leaving the fresh empty ones in place makes every string key
+    # decode to code 0
+    t.dictionaries = {n: cd.dictionary
+                      for n, cd in block.columns.items()
+                      if cd.dictionary is not None}
+    t.commit(t.write(block), WriteVersion(1, 1))
+    t.indexate()
+    return block.length
+
+
+def empty_typed_frame(columns):
+    """Zero-row frame with the stage schema's dtypes — a worker whose
+    channel received no partitions still registers a typed temp table."""
+    import numpy as np
+    import pandas as pd
+    cols = {}
+    for (name, dtype) in columns:
+        if dtype in ("object", "str"):
+            cols[name] = np.empty(0, dtype=object)
+        else:
+            cols[name] = np.empty(0, dtype=np.dtype(dtype))
+    return pd.DataFrame(cols)

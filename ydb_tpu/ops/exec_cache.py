@@ -12,9 +12,18 @@ matter how many distinct query shapes pass through. The analog of the
 reference's computation pattern cache with its size limit
 (`mkql_computation_pattern_cache.h:56` — MaxPatternsSize/MaxCompiledSize).
 
-Eviction drops the last engine-side reference to a jitted callable; its
-underlying executables are freed when Python GC runs. A shared global
-budget (`GLOBAL_BUDGET`) spans every cache created in the process.
+Eviction RELEASES the executable, not just the reference: a jitted
+callable's compiled executables live in its own `jax.jit` cache, which a
+dropped Python reference only frees after the garbage collector breaks
+the closure↔cache reference cycles — under allocation pressure that lag
+was long enough for "evicted" executables to pile up live and SIGSEGV
+the platform (the r5 full-suite crash). `_release` therefore calls
+`clear_cache()` on evicted/overwritten/cleared entries (recursing into
+tuple entries and one level of object attributes for the composite
+distributed-path entries), and the budget runs a periodic `gc.collect()`
+every `YDB_TPU_EXEC_CACHE_GC` releases (default 16) so the cycle-bound
+remainder actually dies. A shared global budget (`GLOBAL_BUDGET`) spans
+every cache created in the process.
 """
 
 from __future__ import annotations
@@ -23,7 +32,8 @@ import os
 import threading
 from collections import OrderedDict
 
-__all__ = ["ExecCache", "GLOBAL_BUDGET", "live_executables"]
+__all__ = ["ExecCache", "GLOBAL_BUDGET", "live_executables",
+           "release_executable"]
 
 
 class _Budget:
@@ -61,24 +71,74 @@ class _Budget:
             return sum(len(c) for c in self._live())
 
     def evict_to_fit(self, incoming: int = 1) -> None:
-        """Evict globally-LRU entries until `incoming` new ones fit."""
+        """Evict globally-LRU entries until `incoming` new ones fit.
+        Victims are popped under the budget lock but RELEASED after it:
+        release runs a periodic full gc.collect(), which must not stall
+        every other thread's compile-cache insert."""
         with self._mu:
-            caches = self._live()
-            while sum(len(c) for c in caches) + incoming \
-                    > self.max_entries:
-                victim = None
-                oldest = None
-                for c in caches:
-                    t = c._oldest_tick()
-                    if t is not None and (oldest is None or t < oldest):
-                        oldest, victim = t, c
-                if victim is None:
-                    return
-                victim._evict_one()
+            dropped = self._evict_to_fit_locked(incoming)
+        for v in dropped:
+            release_executable(v)
+
+    def _evict_to_fit_locked(self, incoming: int) -> list:
+        dropped = []
+        caches = self._live()
+        while sum(len(c) for c in caches) + incoming \
+                > self.max_entries:
+            victim = None
+            oldest = None
+            for c in caches:
+                t = c._oldest_tick()
+                if t is not None and (oldest is None or t < oldest):
+                    oldest, victim = t, c
+            if victim is None:
+                break
+            v = victim._pop_oldest()
+            if v is not _MISSING:
+                dropped.append(v)
+        return dropped
 
 
 GLOBAL_BUDGET = _Budget(int(os.environ.get(
     "YDB_TPU_EXEC_CACHE_ENTRIES", 160)))
+
+_GC_EVERY = max(1, int(os.environ.get("YDB_TPU_EXEC_CACHE_GC", 16)))
+_gc_mu = threading.Lock()
+_released_since_gc = [0]
+
+
+def release_executable(value) -> None:
+    """Free a cached entry's compiled executables deterministically:
+    `clear_cache()` on jitted callables (tuple entries and one level of
+    object attributes covered — the finalize/dist-agg/shuffle-join caches
+    store composites), then a periodic gc to break the closure cycles
+    that would otherwise keep the remainder alive."""
+    import gc
+
+    def _clear(v, depth: int) -> None:
+        cc = getattr(v, "clear_cache", None)
+        if callable(cc):
+            try:
+                cc()
+            except Exception:                # noqa: BLE001 — best effort
+                pass
+            return
+        if isinstance(v, (tuple, list)):
+            for x in v:
+                _clear(x, depth)
+            return
+        if depth > 0 and hasattr(v, "__dict__"):
+            for x in vars(v).values():
+                _clear(x, depth - 1)
+
+    _clear(value, 1)
+    with _gc_mu:
+        _released_since_gc[0] += 1
+        run_gc = _released_since_gc[0] >= _GC_EVERY
+        if run_gc:
+            _released_since_gc[0] = 0
+    if run_gc:
+        gc.collect()
 
 _tick_mu = threading.Lock()
 _tick = [0]
@@ -110,6 +170,7 @@ class ExecCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.released = 0
         self._budget.register(self)
 
     def __len__(self) -> int:
@@ -138,14 +199,40 @@ class ExecCache:
         return v
 
     def __setitem__(self, key, value) -> None:
-        self._budget.evict_to_fit(1)
-        with self._mu:
-            self._entries[key] = (value, _next_tick())
-            self._entries.move_to_end(key)
+        # check + evict + insert are one atomic step under the budget
+        # lock (budget._mu -> cache._mu everywhere, get() takes only the
+        # cache lock): two concurrent misses for the same key must not
+        # each evict an unrelated entry for one net insert, and an
+        # eviction between the check and the insert must not land the
+        # entry without a reservation. Overwrites skip eviction — they
+        # replace in place without growing the cache.
+        dropped = []
+        with self._budget._mu:
+            with self._mu:
+                is_new = key not in self._entries
+            if is_new:
+                dropped = self._budget._evict_to_fit_locked(1)
+            with self._mu:
+                old = self._entries.get(key)
+                self._entries[key] = (value, _next_tick())
+                self._entries.move_to_end(key)
+                if old is not None and old[0] is not value:
+                    self.released += 1
+        for v in dropped:
+            release_executable(v)
+        if old is not None and old[0] is not value:
+            # an overwritten entry's executable must release like an
+            # evicted one — a recompile for the same key otherwise leaks
+            # the prior executable until (if ever) gc notices
+            release_executable(old[0])
 
     def clear(self) -> None:
         with self._mu:
+            dropped = [v for (v, _t) in self._entries.values()]
             self._entries.clear()
+        for v in dropped:
+            self.released += 1
+            release_executable(v)
 
     # -- budget hooks ------------------------------------------------------
 
@@ -156,11 +243,16 @@ class ExecCache:
             first = next(iter(self._entries.values()))
             return first[1]
 
-    def _evict_one(self) -> None:
+    def _pop_oldest(self):
+        """Pop the LRU entry, returning its value for the budget to
+        release outside the locks (or _MISSING when empty)."""
         with self._mu:
-            if self._entries:
-                self._entries.popitem(last=False)
-                self.evictions += 1
+            if not self._entries:
+                return _MISSING
+            _k, (victim, _t) = self._entries.popitem(last=False)
+            self.evictions += 1
+            self.released += 1
+            return victim
 
 
 class _Missing:

@@ -1,0 +1,25 @@
+"""shard_map across JAX API generations.
+
+`jax.shard_map` (with `check_vma=`) only exists from jax 0.6; on 0.4.x
+the same transform lives at `jax.experimental.shard_map.shard_map` and
+the replication-check kwarg is spelled `check_rep`.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma)
+
+else:
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _exp_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma)

@@ -40,6 +40,7 @@ from ydb_tpu.core.schema import Column, Schema
 from ydb_tpu.ops import ir
 from ydb_tpu.ops.device import bucket_capacity
 from ydb_tpu.ops.xla_exec import _trace_program, compress
+from ydb_tpu.parallel._compat import shard_map
 from ydb_tpu.utils.hashing import hash_combine, splitmix64
 
 AXIS = "shards"
@@ -242,7 +243,7 @@ class DistributedAgg:
             P(AXIS),
             {n: P() for n in param_names},
         )
-        shard_fn = jax.jit(jax.shard_map(
+        shard_fn = jax.jit(shard_map(
             wrapper, mesh=self.mesh, in_specs=pspec_in,
             out_specs=(P(AXIS, None), P(AXIS, None), P(AXIS), P(AXIS)),
             check_vma=False,

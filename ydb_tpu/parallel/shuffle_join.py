@@ -30,6 +30,7 @@ from ydb_tpu.ops import ir
 from ydb_tpu.ops.device import DeviceBlock, bucket_capacity
 from ydb_tpu.ops.join import _select_and_gather, build as build_table
 from ydb_tpu.ops.xla_exec import _trace_program, compress
+from ydb_tpu.parallel._compat import shard_map
 from ydb_tpu.parallel.shuffle import AXIS, _bucket_of, _fuse_device_blocks
 from ydb_tpu.utils.hashing import splitmix64
 
@@ -212,7 +213,7 @@ class ShuffleJoin:
             {n: P(AXIS, None) for n in pvalid_names},
             {n: P() for n in param_names},
         )
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             wrapper, mesh=self.mesh, in_specs=pspec_in,
             out_specs=(P(AXIS, None), P(AXIS, None), P(AXIS)),
             check_vma=False))

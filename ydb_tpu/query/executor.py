@@ -103,6 +103,20 @@ class Executor:
         self.build_cache = BuildCache(int(
             _os.environ.get("YDB_TPU_BUILD_CACHE_BUDGET", 2 << 30)),
             device_cache=self.device_cache)
+    # DQ task-graph runtime (`ydb_tpu/dq/`): >0 while THIS THREAD is
+    # running a statement as a stage program of a distributed task — the
+    # worker's share of a multi-process graph, or the 1-worker degenerate
+    # case. Thread-local: a worker serving a DQ task concurrently with a
+    # plain query on another thread must not count the plain query.
+    # Counted on /counters (`dq/local_stage_execs`) so workers show
+    # their stage traffic.
+    @property
+    def dq_stage_depth(self) -> int:
+        return getattr(self._tls, "dq_stage_depth", 0)
+
+    @dq_stage_depth.setter
+    def dq_stage_depth(self, v: int):
+        self._tls.dq_stage_depth = v
 
     @property
     def last_path(self) -> str:
@@ -168,6 +182,9 @@ class Executor:
         pipelines down to ~10 ms when overlapped, PERF.md). Paths that
         must materialize host-side mid-flight (distributed, tiled,
         spill) resolve eagerly and return a completed future."""
+        if self.dq_stage_depth:
+            from ydb_tpu.utils.metrics import GLOBAL
+            GLOBAL.inc("dq/local_stage_execs")
         params = dict(plan.params)
         # precompute stage: uncorrelated scalar subqueries → params
         for (pname, subplan) in plan.init_subplans:

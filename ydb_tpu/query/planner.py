@@ -186,6 +186,21 @@ class Planner:
         with self._mu:
             return self._plan_select_locked(sel)
 
+    def plan_dq(self, sel: ast.Select, topology):
+        """Lower a SELECT to a DQ stage graph (`ydb_tpu/dq/graph.py`) —
+        the distributed counterpart of `plan_select`: stages own the
+        programs (rendered stage SQL each worker engine compiles through
+        plan_select locally), edges are UnionAll / HashShuffle /
+        Broadcast / Merge channels. Column references resolve from THIS
+        catalog's schemas; the cross-process router passes an RPC schema
+        probe instead (`cluster/router.py`). `topology`: a
+        `dq.lower.DqTopology`."""
+        from ydb_tpu.dq.lower import lower_select
+
+        def table_cols(table: str) -> list:
+            return list(self.catalog.table(table).schema.names)
+        return lower_select(sel, topology, table_cols)
+
     def _plan_select_locked(self, sel: ast.Select) -> QueryPlan:
         if sel.relation is None:
             raise PlanError("SELECT without FROM is not supported yet")

@@ -182,9 +182,15 @@ def _as_float(v):
 
 def _ip_canon(s: str):
     try:
-        return str(ipaddress.ip_address(s.strip()))
+        ip = ipaddress.ip_address(s.strip())
     except ValueError:
         return None
+    # IPv4-mapped addresses render dotted (`::ffff:10.0.0.1`) on every
+    # Python only from 3.13 (cpython gh-87799); pin the dotted form
+    v4 = getattr(ip, "ipv4_mapped", None)
+    if v4 is not None:
+        return f"::ffff:{v4}"
+    return str(ip)
 
 
 def _ip_private(s: str):

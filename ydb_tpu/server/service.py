@@ -45,8 +45,8 @@ SERVICE = "ydb_tpu.QueryService"
 SHUFFLE_TMP_PREFIX = "__xj_"
 
 
-def _result_payload(block, stats) -> dict:
-    df = block.to_pandas()
+def _frame_rows(df) -> list:
+    """JSON-safe row lists (NaN/NaT → None, numpy scalars unboxed)."""
     rows = []
     for row in df.itertuples(index=False):
         out = []
@@ -58,6 +58,12 @@ def _result_payload(block, stats) -> dict:
             else:
                 out.append(v)
         rows.append(out)
+    return rows
+
+
+def _result_payload(block, stats) -> dict:
+    df = block.to_pandas()
+    rows = _frame_rows(df)
     return {
         "columns": list(df.columns),
         "rows": rows,
@@ -169,11 +175,14 @@ class QueryServicer:
 
     # -- worker<->worker exchange (DQ channel data plane) ------------------
     #
-    # The router drives a two-stage shuffle: ShuffleWrite runs a local
-    # stage SQL, hash-partitions the rows and ships each partition to its
-    # peer's ExchangePut (binary frames, cluster/exchange.py); ChannelOpen
-    # materializes a drained channel as a transient table so the final
-    # stage is ordinary local SQL over co-partitioned data.
+    # The DQ task runner (`ydb_tpu/dq/runner.py`) drives stage graphs:
+    # DqRunTask runs one task — a stage SQL whose output routes over the
+    # task's channels (hash-shuffled / broadcast to peers' ExchangePut as
+    # binary frames, or collected in the response for router-bound
+    # channels); ChannelOpen materializes a drained channel as a
+    # transient table so the next stage is ordinary local SQL over
+    # co-partitioned data; DqTasks lists task states (pending → running
+    # → finished/failed) for observability.
 
     @property
     def exchange(self):
@@ -197,58 +206,72 @@ class QueryServicer:
                 return {"error": "Unauthenticated: invalid or missing "
                                  "token"}
             header, df = unpack_frame(request)
-            self.exchange.put(header["channel"], df, len(request))
-            return {"ok": True, "rows": len(df)}
+            # (src, seq)-deduplicated: a retried put whose first attempt
+            # landed (reply lost) is dropped — idempotent redelivery
+            fresh = self.exchange.put(header["channel"], df, len(request),
+                                      src=str(header.get("src", "")),
+                                      seq=header.get("seq"))
+            return {"ok": True, "rows": len(df), "dup": not fresh}
         except Exception as e:               # noqa: BLE001 — wire boundary
             return {"error": f"{type(e).__name__}: {e}"}
 
-    def shuffle_write(self, request, context):
-        """Run a stage SQL locally, hash-partition by `key`, ship each
-        partition to peers[part] (loopback included — one code path)."""
+    def dq_run_task(self, request, context):
+        """Run one DQ task (stage program + output channel routing) —
+        the task-control RPC of the stage/task/channel runtime
+        (`ydb_tpu/dq/task.py` holds the shared execution core)."""
         if not self._authed(request):
             return {"error": "Unauthenticated: invalid or missing token"}
-        from concurrent.futures import ThreadPoolExecutor
+        from collections import OrderedDict
 
-        from ydb_tpu.cluster.exchange import hash_partition, pack_frame
+        from ydb_tpu.dq import task as dq_task
+        tid = str(request.get("task_id", ""))
+        with self._lock:
+            tasks = self.__dict__.setdefault("_dq_tasks", OrderedDict())
+            rec = tasks.setdefault(tid, {"stage": request.get("stage", ""),
+                                         "attempts": 0})
+            rec["state"] = "running"
+            rec["attempts"] += 1
+            tasks.move_to_end(tid)
+            while len(tasks) > 512:          # bounded task table
+                tasks.popitem(last=False)
         try:
-            sql = request["sql"]
-            key = request["key"]
-            channel = request["channel"]
-            peers = request["peers"]
-            block = self.engine.execute(sql)
-            df = block.to_pandas()
-            # the key's hash route comes from the SCHEMA, not the pandas
-            # dtype: nullable int keys widen to object dtype in pandas
-            # and would otherwise string-hash on this producer while a
-            # NOT NULL producer int-hashes — the same key landing on two
-            # consumers silently drops sharded-join matches
-            kind = None
-            if block.schema.has(key):
-                dt = block.schema.dtype(key)
-                kind = ("string" if dt.is_string
-                        else "float" if dt.is_float else "int")
-            parts = hash_partition(df, key, len(peers), kind=kind)
+            def send(out, p, frame):
+                ExchangeClient(out["peers"][p]).put(frame)
 
-            def send(p):
-                frame = pack_frame(
-                    {"channel": channel, "part": p, "token": self._token},
-                    parts[p])
-                ExchangeClient(peers[p]).put(frame)
-                return len(parts[p])
-
-            with ThreadPoolExecutor(max_workers=len(peers)) as pool:
-                sent = list(pool.map(send, range(len(peers))))
-            return {"ok": True, "rows_in": len(df),
-                    "rows_sent": sent,
-                    "dtypes": {c: str(df[c].dtype) for c in df.columns}}
+            resp = dq_task.run_task(
+                self.engine, request["sql"], request.get("outputs") or [],
+                str(request.get("src", "")), send, token=self._token)
+            if "collected_df" in resp:
+                df = resp.pop("collected_df")
+                resp["collected"] = {"columns": list(df.columns),
+                                     "rows": _frame_rows(df)}
+            with self._lock:
+                rec["state"] = "finished"
+            return resp
         except Exception as e:               # noqa: BLE001 — wire boundary
+            with self._lock:
+                rec["state"] = "failed"
+                rec["error"] = f"{type(e).__name__}: {e}"
             return {"error": f"{type(e).__name__}: {e}"}
+
+    def dq_tasks(self, request, context):
+        """Task table snapshot (state machine observability)."""
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        with self._lock:
+            # per-record copies: running task threads mutate the inner
+            # dicts under the same lock, so the reply serializes a
+            # consistent snapshot instead of racing json.dumps
+            tasks = {k: dict(v)
+                     for k, v in (self.__dict__.get("_dq_tasks")
+                                  or {}).items()}
+        return {"tasks": tasks}
 
     def channel_open(self, request, context):
         """Materialize a drained channel as a transient local table."""
         if not self._authed(request):
             return {"error": "Unauthenticated: invalid or missing token"}
-        from ydb_tpu.core.block import HostBlock
+        from ydb_tpu.dq.task import materialize_channel
         try:
             name = request["table"]
             if not str(name).startswith(SHUFFLE_TMP_PREFIX):
@@ -260,34 +283,10 @@ class QueryServicer:
                 return {"error": f"ChannelOpen: table {name!r} is outside "
                                  f"the {SHUFFLE_TMP_PREFIX}* shuffle-temp "
                                  "namespace"}
-            df = self.exchange.take(request["channel"])
-            columns = request.get("columns")
-            if df.empty and columns:
-                df = _empty_typed_frame(columns)
-            block = HostBlock.from_pandas(df)
-            if self.engine.catalog.has(name):
-                # drop-and-recreate only ever replaces a transient temp:
-                # a durable table that happens to sit in the namespace is
-                # not ours to clobber
-                old = self.engine.catalog.table(name)
-                if not getattr(old, "transient", False):
-                    return {"error": f"ChannelOpen: refusing to replace "
-                                     f"non-transient table {name!r}"}
-                self.engine.catalog.drop_table(name)
-            t = self.engine.catalog.create_table(
-                name, block.schema,
-                [block.schema.names[0]], transient=True)
-            # the block's dictionaries BECOME the table's: the binder
-            # reads table-level dictionaries for group-by domains and
-            # rank LUTs — leaving the fresh empty ones in place makes
-            # every string key decode to code 0
-            t.dictionaries = {n: cd.dictionary
-                              for n, cd in block.columns.items()
-                              if cd.dictionary is not None}
-            from ydb_tpu.storage.mvcc import WriteVersion
-            t.commit(t.write(block), WriteVersion(1, 1))
-            t.indexate()
-            return {"ok": True, "rows": block.length}
+            rows = materialize_channel(self.engine, self.exchange,
+                                       request["channel"], name,
+                                       request.get("columns"))
+            return {"ok": True, "rows": rows}
         except Exception as e:               # noqa: BLE001 — wire boundary
             return {"error": f"{type(e).__name__}: {e}"}
 
@@ -487,20 +486,6 @@ class QueryServicer:
         }
 
 
-def _empty_typed_frame(columns):
-    """Zero-row frame with the stage schema's dtypes — a worker whose
-    channel received no partitions still registers a typed temp table."""
-    import numpy as np
-    import pandas as pd
-    cols = {}
-    for (name, dtype) in columns:
-        if dtype in ("object", "str"):
-            cols[name] = np.empty(0, dtype=object)
-        else:
-            cols[name] = np.empty(0, dtype=np.dtype(dtype))
-    return pd.DataFrame(cols)
-
-
 def serve(engine, port: int = 2136, max_workers: int = 8,
           token: str = ""):
     """Start the gRPC server; returns (server, bound_port). `token`
@@ -528,8 +513,11 @@ def serve(engine, port: int = 2136, max_workers: int = 8,
         "ExchangePut": grpc.unary_unary_rpc_method_handler(
             servicer.exchange_put, request_deserializer=lambda b: b,
             response_serializer=_ser),
-        "ShuffleWrite": grpc.unary_unary_rpc_method_handler(
-            servicer.shuffle_write, request_deserializer=_deser,
+        "DqRunTask": grpc.unary_unary_rpc_method_handler(
+            servicer.dq_run_task, request_deserializer=_deser,
+            response_serializer=_ser),
+        "DqTasks": grpc.unary_unary_rpc_method_handler(
+            servicer.dq_tasks, request_deserializer=_deser,
             response_serializer=_ser),
         "ChannelOpen": grpc.unary_unary_rpc_method_handler(
             servicer.channel_open, request_deserializer=_deser,
@@ -599,7 +587,13 @@ class Client:
 
         self.endpoint = endpoint
         self.token = token
-        self._channel = grpc.insecure_channel(endpoint)
+        # same max-message override as the server: DqRunTask responses
+        # carry router-bound collected frames that can exceed gRPC's
+        # stock 4 MiB cap
+        self._channel = grpc.insecure_channel(
+            endpoint,
+            options=[("grpc.max_send_message_length", 256 << 20),
+                     ("grpc.max_receive_message_length", 256 << 20)])
         self._exec = self._channel.unary_unary(
             f"/{SERVICE}/ExecuteQuery", request_serializer=_ser,
             response_deserializer=_deser)
@@ -612,8 +606,11 @@ class Client:
         self._health = self._channel.unary_unary(
             f"/{SERVICE}/Health", request_serializer=_ser,
             response_deserializer=_deser)
-        self._shuffle = self._channel.unary_unary(
-            f"/{SERVICE}/ShuffleWrite", request_serializer=_ser,
+        self._dq_run = self._channel.unary_unary(
+            f"/{SERVICE}/DqRunTask", request_serializer=_ser,
+            response_deserializer=_deser)
+        self._dq_tasks = self._channel.unary_unary(
+            f"/{SERVICE}/DqTasks", request_serializer=_ser,
             response_deserializer=_deser)
         self._chopen = self._channel.unary_unary(
             f"/{SERVICE}/ChannelOpen", request_serializer=_ser,
@@ -643,26 +640,39 @@ class Client:
             raise RuntimeError(resp["error"])
         return resp["counters"]
 
-    def shuffle_write(self, sql: str, key: str, channel: str,
-                      peers: list) -> dict:
-        resp = self._shuffle({"sql": sql, "key": key, "channel": channel,
-                              "peers": peers, "token": self.token})
+    def dq_run_task(self, task_id: str, stage: str, sql: str,
+                    outputs: list, src: str = "",
+                    timeout: float = None) -> dict:
+        """Run one DQ task (stage program + channel routing) on the
+        worker; blocks until the task's frames are delivered."""
+        resp = self._dq_run({"task_id": task_id, "stage": stage,
+                             "sql": sql, "outputs": list(outputs),
+                             "src": src, "token": self.token},
+                            timeout=timeout)
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp
+
+    def dq_tasks(self, timeout: float = None) -> dict:
+        resp = self._dq_tasks({"token": self.token}, timeout=timeout)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["tasks"]
 
     def channel_open(self, channel: str, table: str,
-                     columns=None) -> dict:
+                     columns=None, timeout: float = None) -> dict:
         resp = self._chopen({"channel": channel, "table": table,
-                             "columns": columns, "token": self.token})
+                             "columns": columns, "token": self.token},
+                            timeout=timeout)
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp
 
-    def channel_close(self, tables=(), channels=()) -> dict:
+    def channel_close(self, tables=(), channels=(),
+                      timeout: float = None) -> dict:
         return self._chclose({"tables": list(tables),
                               "channels": list(channels),
-                              "token": self.token})
+                              "token": self.token}, timeout=timeout)
 
     def _dtx_call(self, method: str, body: dict) -> dict:
         stubs = self.__dict__.setdefault("_dtx_stubs", {})

@@ -37,6 +37,13 @@ class Counters:
         with self._mu:
             self._c[name] = value
 
+    def set_max(self, name: str, value: float) -> None:
+        """High-watermark gauge: keep the largest value ever reported
+        (e.g. `dq/channel_inflight_peak_bytes` from the channel writers)."""
+        with self._mu:
+            if value > self._c.get(name, float("-inf")):
+                self._c[name] = value
+
     def get(self, name: str) -> float:
         return self._c.get(name, 0)
 
@@ -46,6 +53,17 @@ class Counters:
 
 
 GLOBAL = Counters()
+
+# DQ task-graph runtime counters (`ydb_tpu/dq/`), one namespace on the
+# existing /counters surface — router side counts stages/tasks/retries,
+# worker side counts local stage executions and channel traffic:
+#   dq/stages                     stages executed (runner)
+#   dq/tasks                      tasks launched (runner + worker)
+#   dq/tasks_retried              tasks re-run by a stage-level retry
+#   dq/channel_bytes              frame bytes shipped over channels
+#   dq/frames                     frames shipped over channels
+#   dq/local_stage_execs          statements run as DQ stage programs
+#   dq/channel_inflight_peak_bytes  flow-control high watermark
 
 
 @dataclass

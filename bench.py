@@ -1,11 +1,18 @@
-"""Benchmark: TPC-H on the device — Q1 headline + full 22-query suites.
+"""Benchmark: TPC-H on the device — Q1 headline + full 22-query suites,
+plus TPC-DS and ClickBench legs.
 
 Runs the full SQL path (parse → plan → pushdown → fused/tiled device
 programs) over generated TPC-H data — the measured analog of the
 reference's `ydb workload tpch run` (no published numbers exist in-repo;
 see BASELINE.md). Suites at each scale factor in BENCH_SUITE_SFS
 (default "1,10"): best-of-N per query, geomean reported; at SF ≤ 1 every
-query is oracle-gated, above that a fast subset gates.
+query is oracle-gated, above that a fast subset gates. Queries whose
+FUSED compile is known to wedge the platform (q8/q10/q18) get one timed
+run through the portioned fallback, stamped `fallback: true`, so TPC-H
+coverage reports 22/22 honestly. The ClickBench leg
+(BENCH_CLICKBENCH_ROWS, default 1M rows; 0 disables) runs all 43
+queries over the generated hits table under the same watchdog /
+blacklist / last_known_good machinery.
 
 HANG-PROOF ORCHESTRATION: this platform's remote compile service can
 wedge indefinitely on a cold shape. The parent process NEVER touches the
@@ -57,6 +64,23 @@ SUITE_REPEATS = int(os.environ.get("BENCH_SUITE_REPEATS", "2"))
 # explanation — BENCH_r05's bare zero)
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 GATE_BIG = ("q1", "q6", "q12", "q14")
+# TPC-H queries whose FUSED compile historically wedges/crashes the
+# remote service (q8 7-join SIGSEGV, q10/q18 compile wedge): after the
+# main pass they get ONE timed run through the capped portioned path
+# (enable_fused off — many small per-portion programs, no giant fused
+# shape) so the suite can report 22/22 with honest `fallback: true`
+# numbers instead of a permanent coverage hole
+FALLBACK_QUERIES = [q for q in os.environ.get(
+    "BENCH_FALLBACK_QUERIES", "q8,q10,q18").split(",") if q]
+# ClickBench leg: the 43-query suite (tests/clickbench_util.py) over a
+# generated hits table at this row count — the UDF/LUT string engine's
+# on-chip numbers. Pandas-oracle-gated up to CLICKBENCH_ORACLE_ROWS;
+# 0 / "" disables the leg.
+CLICKBENCH_ROWS = int(os.environ.get("BENCH_CLICKBENCH_ROWS",
+                                     "1000000") or 0)
+CLICKBENCH_ORACLE_ROWS = int(os.environ.get("BENCH_CLICKBENCH_ORACLE_ROWS",
+                                            "5000000"))
+CLICKBENCH_TOTAL = 43
 
 _T0 = time.perf_counter()
 
@@ -78,7 +102,8 @@ def geomean(xs):
 
 
 def child_main(sf: float, progress_path: str, skip: list,
-               budget_s: float, workload: str = "tpch") -> None:
+               budget_s: float, workload: str = "tpch",
+               fallback: list = ()) -> None:
     import shutil
 
     from ydb_tpu.query import QueryEngine
@@ -88,6 +113,10 @@ def child_main(sf: float, progress_path: str, skip: list,
         from tests.tpch_util import assert_frames_match
         QUERIES = {k: ALL_Q[k] for k in TPCDS_BENCH if k in ALL_Q}
         fact_table, loader = "store_sales", "tpcds"
+    elif workload == "clickbench":
+        from tests.clickbench_util import QUERIES, oracle
+        from tests.tpch_util import assert_frames_match
+        fact_table, loader = "hits", "clickbench"
     else:
         from tests.tpch_util import QUERIES, assert_frames_match, oracle
         fact_table, loader = "lineitem", "tpch"
@@ -121,6 +150,9 @@ def child_main(sf: float, progress_path: str, skip: list,
         if loader == "tpcds":
             from ydb_tpu.bench.tpcds_gen import load_tpcds
             data = load_tpcds(eng.catalog, sf=sf)
+        elif loader == "clickbench":
+            from ydb_tpu.bench.clickbench_gen import load_hits
+            data = load_hits(eng.catalog, n_rows=int(sf))
         else:
             from ydb_tpu.bench.tpch_gen import load_tpch
             data = load_tpch(eng.catalog, sf=sf)
@@ -140,33 +172,39 @@ def child_main(sf: float, progress_path: str, skip: list,
             if loader == "tpcds":
                 from ydb_tpu.bench.tpcds_gen import gen_tpcds
                 data = gen_tpcds(sf)
+            elif loader == "clickbench":
+                from ydb_tpu.bench.clickbench_gen import gen_hits
+                data = gen_hits(int(sf))   # deterministic: same seed
             else:
                 from ydb_tpu.bench.tpch_gen import TpchData
                 data = TpchData(sf)  # deterministic: same seed
         return data
 
     deadline = _T0 + budget_s        # the parent passes REMAINING budget
-    for name in QUERIES:
-        if name in skip:
-            continue
-        if time.perf_counter() > deadline:
-            emit({"kind": "skip", "query": name, "reason": "budget"})
-            continue
-        emit({"kind": "start", "query": name})
+
+    def gated(name: str) -> bool:
+        if workload == "clickbench":
+            return int(sf) <= CLICKBENCH_ORACLE_ROWS
+        return sf <= 1 or name in GATE_BIG
+
+    done_ok: set = set()             # timed THIS run (fused or fallback)
+    oracle_failed: set = set()       # ran but WRONG — never fallback-rescue
+
+    def run_one(name: str, repeats: int, extra: dict) -> None:
         sql = QUERIES[name]
         try:
             t0 = time.perf_counter()
             got = eng.query(sql)                 # compile + first run
             times = [time.perf_counter() - t0]
-            for _ in range(SUITE_REPEATS):
+            for _ in range(repeats):
                 t0 = time.perf_counter()
                 got = eng.query(sql)
                 times.append(time.perf_counter() - t0)
             best = min(times)
             rec = {"kind": "result", "query": name,
                    "ms": round(best * 1000, 1),
-                   "path": eng.executor.last_path}
-            if sf <= 1 or name in GATE_BIG:
+                   "path": eng.executor.last_path, **extra}
+            if gated(name):
                 d = oracle_data()    # lazy gen OUTSIDE the timed window
                 t0 = time.perf_counter()
                 want = oracle(name, d)
@@ -176,10 +214,46 @@ def child_main(sf: float, progress_path: str, skip: list,
                                     rtol=1e-6 if sf > 1 else 1e-9)
                 rec["oracle"] = "ok"
                 rec["vs_pandas"] = round(cpu_t / best, 1)
+            done_ok.add(name)
             emit(rec)
         except Exception as e:                   # noqa: BLE001
+            if isinstance(e, AssertionError):
+                oracle_failed.add(name)
             emit({"kind": "result", "query": name, "ms": None,
+                  **extra,
                   "error": f"{type(e).__name__}: {str(e)[:160]}"})
+
+    for name in QUERIES:
+        if name in skip:
+            continue
+        if time.perf_counter() > deadline:
+            emit({"kind": "skip", "query": name, "reason": "budget"})
+            continue
+        emit({"kind": "start", "query": name})
+        run_one(name, SUITE_REPEATS, {})
+
+    # capped portioned fallback: queries the fused path cannot compile on
+    # this platform (the parent lists candidates — blacklisted/untimed
+    # only, `.bench_hung.json`-respecting via the `+fallback` key) get
+    # ONE timed run with whole-query fusion off, stamped `fallback: true`
+    # — 22/22 coverage with the cheat visible in the artifact
+    for name in fallback:
+        if name not in QUERIES or name in done_ok:
+            continue
+        if name in oracle_failed:
+            # the fused leg RAN and produced wrong rows: that is a
+            # correctness bug to report, not a coverage hole to paper
+            # over with a passing portioned number
+            continue                 # fused already timed it this run
+        if time.perf_counter() > deadline:
+            emit({"kind": "skip", "query": name, "reason": "budget"})
+            continue
+        emit({"kind": "start", "query": f"{name}+fallback"})
+        eng.executor.enable_fused = False
+        try:
+            run_one(name, 0, {"fallback": True})
+        finally:
+            eng.executor.enable_fused = True
     emit({"kind": "done"})
 
 
@@ -287,6 +361,7 @@ def run_suite(sf: float, suite_deadline: float,
     # burns a full watchdog window): pre-skip, they re-enter the pool
     # only when the hung file is deleted
     hung_key = f"sf{sf:g}" if workload == "tpch" \
+        else f"clickbench-r{int(sf)}" if workload == "clickbench" \
         else f"{workload}-sf{sf:g}"
     known_hung = _load_hung().get(hung_key, [])
     skip: list = list(known_hung)
@@ -301,11 +376,21 @@ def run_suite(sf: float, suite_deadline: float,
         if time.perf_counter() > suite_deadline:
             break
         remaining = max(suite_deadline - time.perf_counter(), 60)
+        # portioned-fallback candidates: FALLBACK_QUERIES not yet TIMED
+        # (an errored fused attempt leaves ms=None in results — still a
+        # candidate after a respawn), excluding oracle MISMATCHES (wrong
+        # rows is a bug to report, not a hole to rescue) and fallback
+        # attempts already blacklisted (`+fallback` in .bench_hung.json)
+        fb = [q for q in FALLBACK_QUERIES
+              if workload == "tpch" and not results.get(q, {}).get("ms")
+              and "AssertionError" not in (results.get(q, {}).get("error")
+                                           or "")
+              and f"{q}+fallback" not in skip]
         # completed queries are skipped too: a respawn must CONTINUE, not
         # redo minutes of timed runs + oracles per already-done query
         cmd = [sys.executable, os.path.abspath(__file__), "--suite-child",
                str(sf), progress, ",".join(skip + sorted(results)),
-               str(remaining), workload]
+               str(remaining), workload, ",".join(fb)]
         child = subprocess.Popen(cmd)
         pos = 0
         current = None
@@ -339,6 +424,7 @@ def run_suite(sf: float, suite_deadline: float,
                     current = None
                     log(f"sf={sf:g} {rec['query']}: "
                         + (f"{rec['ms']}ms [{rec.get('path', '')}]"
+                           + (" FALLBACK" if rec.get("fallback") else "")
                            + (f" oracle ok, {rec['vs_pandas']}x"
                               if "vs_pandas" in rec else "")
                            if rec["ms"] is not None
@@ -416,10 +502,19 @@ def run_suite(sf: float, suite_deadline: float,
     ok = {q: r["ms"] for q, r in results.items() if r.get("ms")}
     ratios = {q: r["vs_pandas"] for q, r in results.items()
               if "vs_pandas" in r}
-    total = 22 if workload == "tpch" else len(TPCDS_BENCH)
-    not_timed = sorted(set(hung)
-                       | {q for q, r in results.items() if not r.get("ms")}
-                       | (set(skipped_budget) - set(ok)))
+    total = (22 if workload == "tpch"
+             else CLICKBENCH_TOTAL if workload == "clickbench"
+             else len(TPCDS_BENCH))
+    # a query later rescued by the portioned fallback leaves the
+    # not-timed (penalized) set — coverage counts its honest number.
+    # Watchdog entries for a hung FALLBACK attempt carry the 'qN+fallback'
+    # pseudo-name (the .bench_hung.json key); fold them back to the base
+    # query so qN isn't penalized twice and no name outside the suite's
+    # query universe leaks into the artifact's hung/not_timed lists
+    hung = sorted({q.split("+", 1)[0] for q in hung})
+    not_timed = sorted((set(hung)
+                        | {q for q, r in results.items() if not r.get("ms")}
+                        | set(skipped_budget)) - set(ok))
     # honest aggregate (VERDICT r4): hung/failed/skipped queries count at
     # the watchdog-timeout penalty, so the blacklist cannot silently
     # flatter the geomean; `geomean_ms` over completed is still reported
@@ -439,6 +534,8 @@ def run_suite(sf: float, suite_deadline: float,
         "geomean_ms": round(geomean(list(ok.values())), 1),
         "geomean_penalized_ms": round(geomean(penalized), 1),
         "penalty_ms": QUERY_TIMEOUT * 1000.0,
+        "fallbacks": sorted(q for q, r in results.items()
+                            if r.get("fallback")),
         "per_query_ms": ok,
         "paths": {q: r.get("path", "") for q, r in results.items()},
         "oracle_checked": sorted(ratios),
@@ -580,6 +677,8 @@ def main() -> None:
     plan = [("tpch", sf) for sf in SUITE_SFS]
     if TPCDS_SF:
         plan.append(("tpcds", float(TPCDS_SF)))
+    if CLICKBENCH_ROWS:
+        plan.append(("clickbench", float(CLICKBENCH_ROWS)))
     for i, (workload, sf) in enumerate(plan):
         elapsed = time.perf_counter() - _T0
         if elapsed > BUDGET_S - 120:
@@ -590,6 +689,7 @@ def main() -> None:
         share = (BUDGET_S - elapsed) / (len(plan) - i)
         out = run_suite(sf, time.perf_counter() + share, workload)
         key = f"sf{sf:g}" if workload == "tpch" \
+            else f"clickbench_{int(sf)}" if workload == "clickbench" \
             else f"{workload}_sf{sf:g}"
         suites[key] = out
         log(f"suite {key}: {out['coverage']} ok, "
@@ -619,6 +719,8 @@ if __name__ == "__main__":
             if len(sys.argv) > 4 else []
         budget = float(sys.argv[5]) if len(sys.argv) > 5 else BUDGET_S
         workload = sys.argv[6] if len(sys.argv) > 6 else "tpch"
-        child_main(sf, sys.argv[3], skip, budget, workload)
+        fallback = [s for s in sys.argv[7].split(",") if s] \
+            if len(sys.argv) > 7 else []
+        child_main(sf, sys.argv[3], skip, budget, workload, fallback)
     else:
         main()

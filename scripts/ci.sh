@@ -11,7 +11,7 @@
 #                                 noise-tolerant; the deterministic gate
 #                                 is overlap_hits > 0 — raise the floor
 #                                 on quiet dedicated hardware)
-#   CI_SKIP_SMOKE=1               tier-1 only (e.g. on 1-core runners)
+#   CI_SKIP_SMOKE=1               tier-1 + gather gate only (1-core runners)
 
 set -u
 cd "$(dirname "$0")/.."
@@ -28,6 +28,19 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
 if [ "$rc" -ne 0 ]; then
     echo "tier-1 FAILED (rc=$rc)" >&2
     exit "$rc"
+fi
+
+echo "== sorted group-by gather budget gate (q3-shaped plan) =="
+# trace-time counter gate: the tiled/late-materialized sorted group-by
+# must emit NO gathers above the tile budget for a canonical q3 shape
+# (CI_GROUPBY_GATHER_BUDGET to loosen) and the legacy path must measure
+# >=4x more — a regression back to per-column scan-capacity gathers
+# fails loudly on the CPU runner
+JAX_PLATFORMS=cpu python scripts/groupby_gate.py
+grc=$?
+if [ "$grc" -ne 0 ]; then
+    echo "groupby gather gate FAILED (rc=$grc)" >&2
+    exit "$grc"
 fi
 
 if [ "${CI_SKIP_SMOKE:-0}" = "1" ]; then
@@ -53,4 +66,5 @@ if [ "$drc" -ne 0 ]; then
     echo "DQ smoke FAILED (rc=$drc)" >&2
     exit "$drc"
 fi
+
 echo "== CI green =="

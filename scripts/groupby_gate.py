@@ -1,0 +1,161 @@
+"""CI gate: traced gather-op budget for a canonical q3-shaped sorted
+group-by (CPU runner).
+
+Builds a fact⋈dim inner join grouped by the probe key + two build
+payload columns — the TPC-H q3 shape whose sorted group-by dominated
+the SF1 tail (PERF.md round-5 bisect) — with the tile budget forced
+small enough that tiling activates at this scale, then asserts on the
+TRACE-TIME counters (`ops/xla_exec.py`):
+
+  * `groupby/gather_ops` (gathers above the tile-row budget — the ~30 ms
+    full-capacity ops) stays within CI_GROUPBY_GATHER_BUDGET (default 0:
+    the tiled + join-bounded late-materialized path emits none);
+  * the legacy lowering (YDB_TPU_GROUPBY_LEGACY=1) measured on the SAME
+    plan emits at least 4x more of them — a regression that reverts to
+    per-column scan-capacity gathers trips either assertion loudly;
+  * no value-column gather exceeds the tile budget while tiling is
+    active, and the new path traces zero scatter ops;
+  * both legs return identical, pandas-verified results.
+
+Counters accrue at trace time only, so each leg's delta is read around
+a fresh compile (the tuning tuple is part of every program cache key —
+flipping the env in-process recompiles).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force tiling at the gate's small scale: cap 32768 → 4 tiles of 8192
+TILE_ROWS = int(os.environ.get("CI_GROUPBY_TILE_ROWS", "8192"))
+os.environ["YDB_TPU_GROUPBY_TILE_ROWS"] = str(TILE_ROWS)
+GATHER_BUDGET = int(os.environ.get("CI_GROUPBY_GATHER_BUDGET", "0"))
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+FACT_ROWS = 20_000
+DIM_ROWS = 5_000
+
+
+def build_engine():
+    from ydb_tpu.query import QueryEngine
+    eng = QueryEngine(block_rows=1 << 20)
+    eng.execute("create table li (lid Int64 not null, okey Int64 not null, "
+                "val Double not null, primary key (lid)) "
+                "with (store = column)")
+    eng.execute("create table ord (okey Int64 not null, odate Int64 not null, "
+                "oprio Int64 not null, primary key (okey)) "
+                "with (store = column)")
+    rng = np.random.default_rng(20260803)
+    li = pd.DataFrame({
+        "lid": np.arange(FACT_ROWS, dtype=np.int64),
+        "okey": rng.integers(0, DIM_ROWS, FACT_ROWS),
+        "val": rng.normal(size=FACT_ROWS) * 100,
+    })
+    od = pd.DataFrame({
+        "okey": np.arange(DIM_ROWS, dtype=np.int64),
+        "odate": rng.integers(8000, 11000, DIM_ROWS),
+        "oprio": rng.integers(0, 5, DIM_ROWS),
+    })
+    ver = eng._next_version()
+    for name, df in (("li", li), ("ord", od)):
+        t = eng.catalog.table(name)
+        t.bulk_upsert(df, ver)
+        t.indexate()
+    return eng, li, od
+
+
+# min/max ride along so the scatter-free assertion has teeth: only
+# min/max/some scatter on the legacy path, so a sum-only gate would pass
+# even if the round-8 lowering regressed to scatter-reduces
+SQL = ("select li.okey as okey, odate, oprio, sum(val) as rev, "
+       "min(val) as lo, max(val) as hi "
+       "from li join ord on li.okey = ord.okey "
+       "where odate < 9500 "
+       "group by li.okey, odate, oprio "
+       "order by rev desc, okey limit 10")
+
+
+def pandas_oracle(li, od):
+    j = li.merge(od[od.odate < 9500], on="okey")
+    g = (j.groupby(["okey", "odate", "oprio"], as_index=False)
+         .agg(rev=("val", "sum"), lo=("val", "min"), hi=("val", "max"))
+         .sort_values(["rev", "okey"], ascending=[False, True]).head(10))
+    return g.reset_index(drop=True)
+
+
+def run_leg(eng, legacy: bool) -> tuple:
+    from ydb_tpu.utils.metrics import GLOBAL
+    os.environ["YDB_TPU_GROUPBY_LEGACY"] = "1" if legacy else ""
+    names = ("groupby/gather_ops", "groupby/gather_ops_total",
+             "groupby/tiles", "groupby/traces", "groupby/scatter_ops",
+             "groupby/value_gather_rows_max", "groupby/batched_gathers")
+    before = {n: GLOBAL.get(n) for n in names}
+    got = eng.query(SQL)
+    delta = {n: GLOBAL.get(n) - before[n] for n in names}
+    # value_gather_rows_max is a high watermark, not a counter: read the
+    # per-statement trace snapshot instead
+    delta["value_gather_rows_max"] = (eng.last_stats.groupby or {}).get(
+        "value_gather_rows_max", 0)
+    del delta["groupby/value_gather_rows_max"]
+    return got, delta
+
+
+def main() -> int:
+    eng, li, od = build_engine()
+    want = pandas_oracle(li, od)
+
+    new_df, new_d = run_leg(eng, legacy=False)
+    legacy_df, legacy_d = run_leg(eng, legacy=True)
+    os.environ["YDB_TPU_GROUPBY_LEGACY"] = ""
+
+    report = {"tile_rows": TILE_ROWS, "budget": GATHER_BUDGET,
+              "new": new_d, "legacy": legacy_d}
+    print(json.dumps(report), flush=True)
+
+    errs = []
+    for tag, df in (("new", new_df), ("legacy", legacy_df)):
+        if len(df) != len(want) or any(
+                not np.allclose(df[c].to_numpy(), want[c].to_numpy(),
+                                rtol=1e-9) for c in ("rev", "lo", "hi")):
+            errs.append(f"{tag} leg result mismatch vs pandas")
+    if new_d["groupby/traces"] < 2:
+        errs.append("expected >=2 sorted group-by traces (partial + merge)")
+    if new_d["groupby/gather_ops"] > GATHER_BUDGET:
+        errs.append(
+            f"over-budget gathers: {new_d['groupby/gather_ops']} above the "
+            f"tile budget (budget {GATHER_BUDGET}) — the sorted group-by "
+            "regressed to scan-capacity gathers")
+    if new_d["groupby/scatter_ops"] != 0:
+        errs.append("new path traced scatter ops — must stay scatter-free")
+    if legacy_d["groupby/scatter_ops"] == 0:
+        errs.append(
+            "legacy leg traced no scatters — the gate plan must carry "
+            "min/max aggregates or the scatter-free assertion is toothless")
+    if new_d["groupby/tiles"] < 4:
+        errs.append(f"tiling inactive: {new_d['groupby/tiles']} tiles")
+    if new_d["value_gather_rows_max"] > TILE_ROWS:
+        errs.append(
+            f"value-column gather at {new_d['value_gather_rows_max']} rows "
+            f"exceeds the {TILE_ROWS}-row tile budget")
+    floor = 4 * max(new_d["groupby/gather_ops"], 1)
+    if legacy_d["groupby/gather_ops"] < floor:
+        errs.append(
+            f"legacy/new over-budget gather ratio below 4x "
+            f"({legacy_d['groupby/gather_ops']} vs "
+            f"{new_d['groupby/gather_ops']}) — the gate lost its teeth")
+    if errs:
+        for e in errs:
+            print(f"groupby gate FAILED: {e}", file=sys.stderr)
+        return 1
+    print("groupby gate ok", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,6 +71,10 @@ class Stage:
     # ORDER BY refers to output columns)
     post: Optional[dict] = None
     dedup_input: bool = False       # drop cross-worker duplicate rows
+    # partial-aggregate merge stage: its merge_sel GROUP BY re-plans
+    # through the engine and rides the tiled sorted group-by like any
+    # statement (counted as dq/merge_groupby_stages)
+    groupby_merge: bool = False
 
 INPUT_TABLE = "__dq_partial__"      # merge_sel relation placeholder
 

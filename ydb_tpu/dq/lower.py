@@ -421,8 +421,13 @@ def _lower_agg(b: _Builder, sel: ast.Select, inputs: list) -> None:
     ch = b.channel(UNION_ALL, src=s.id)
     s.outputs = [ch.id]
     b.stages.append(s)
+    # the merge GROUP BY re-plans through the router engine and therefore
+    # rides the same tiled/late-materialized sorted group-by as every
+    # statement (its key domains come from the landed temp table's
+    # dictionaries) — marked so the runner counts it on /counters
     b.stages.append(Stage(id="merge", inputs=[ch.id], on="router",
-                          merge_sel=merge_sel))
+                          merge_sel=merge_sel,
+                          groupby_merge=bool(sel.group_by)))
 
 
 def _lower_count_distinct(b: _Builder, sel: ast.Select,
@@ -472,9 +477,11 @@ def _lower_count_distinct(b: _Builder, sel: ast.Select,
     ch = b.channel(UNION_ALL, src=s.id)
     s.outputs = [ch.id]
     b.stages.append(s)
-    # cross-shard duplicate rows shrink before the merge aggregation
+    # cross-shard duplicate rows shrink before the merge aggregation;
+    # the distinct-count merge is a group-by merge like _lower_agg's
     b.stages.append(Stage(id="merge", inputs=[ch.id], on="router",
-                          merge_sel=merge_sel, dedup_input=True))
+                          merge_sel=merge_sel, dedup_input=True,
+                          groupby_merge=True))
     return True
 
 

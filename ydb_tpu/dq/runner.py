@@ -196,6 +196,10 @@ class DqTaskRunner:
     def _run_router_stage(self, graph, stage) -> pd.DataFrame:
         from ydb_tpu.query.window import apply_order_limit
         self.counters.inc("dq/stages")
+        if getattr(stage, "groupby_merge", False):
+            # partial-agg merges ride the tiled sorted group-by through
+            # the engine below; count them so /counters shows DQ's share
+            self.counters.inc("dq/merge_groupby_stages")
         frames = []
         for cid in stage.inputs:
             got = self._collected.get(cid, {})

@@ -246,6 +246,7 @@ def build_tile_fn(pipe, scan_cols: list, K: int, CAP: int,
 
 def tile_cache_key(pipe, scan_cols, K, CAP, sb_valid_names, builds_sig,
                    param_names):
+    from ydb_tpu.ops.xla_exec import groupby_tuning
     progs = []
     if pipe.pre_program is not None:
         progs.append(pipe.pre_program.fingerprint())
@@ -261,11 +262,16 @@ def tile_cache_key(pipe, scan_cols, K, CAP, sb_valid_names, builds_sig,
             tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
                   for c in scan_cols),
             K, CAP, tuple(sorted(sb_valid_names)), builds_sig,
-            tuple(param_names))
+            tuple(param_names), groupby_tuning())
 
 
 def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,
                     sort_spec, rank_assigns, param_names):
+    # the plan signature carries the group-by tuning (tile rows / gather
+    # batch cap / legacy flag): the cost gate for the tile count P runs
+    # at trace time from (capacity, tuning), so a knob flip must compile
+    # a fresh program rather than reuse one tiled differently
+    from ydb_tpu.ops.xla_exec import groupby_tuning
     pipe = plan.pipeline
     progs = []
     if pipe.pre_program is not None:
@@ -287,7 +293,8 @@ def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,
             sort_spec,
             ir.Program(rank_assigns).fingerprint() if rank_assigns else "",
             plan.limit, plan.offset,
-            tuple(n for (n, _lbl) in plan.output), tuple(param_names))
+            tuple(n for (n, _lbl) in plan.output), tuple(param_names),
+            groupby_tuning())
 
 
 def build_inputs_sig(bt) -> tuple:

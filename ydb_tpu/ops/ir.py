@@ -105,6 +105,16 @@ class GroupBy:
     # instead of sort-based segmentation. Part of the structural
     # fingerprint, so dictionary growth recompiles.
     key_domains: tuple = ()
+    # PROVEN static upper bound on the number of groups (0 = unbounded).
+    # The sorted lowering late-materializes per-group outputs at a bucket
+    # of this size instead of scan capacity, so per-group gathers run at
+    # output cardinality. An UNDERSTATED bound silently drops groups —
+    # only guaranteed sources may set it: the planner's key-domain
+    # products (dictionary/bool domains snapshot at plan time) and the
+    # executor's inner-join build cardinality (ngroups ≤ build rows when
+    # every key is the probe key or a unique build's payload). Part of
+    # the structural fingerprint.
+    out_bound: int = 0
 
 
 @dataclass(frozen=True)
@@ -128,9 +138,9 @@ class Program:
         return self
 
     def group_by(self, keys: list[str], aggs: list[Agg],
-                 key_domains: tuple = ()) -> "Program":
+                 key_domains: tuple = (), out_bound: int = 0) -> "Program":
         self.commands.append(GroupBy(tuple(keys), tuple(aggs),
-                                     tuple(key_domains)))
+                                     tuple(key_domains), out_bound))
         return self
 
     def project(self, names: list[str]) -> "Program":

@@ -33,6 +33,8 @@ scatters in the steady state.
 
 from __future__ import annotations
 
+import os
+import threading
 from functools import partial
 from typing import Optional
 
@@ -108,6 +110,128 @@ def _sentinel(dtype, for_min: bool):
 _SMALL_DOMAIN_BUCKETS = 1 << 9     # one-hot 2-D reduction path bound
 _CHUNK_W = 64                      # buckets per one-hot chunk
 _SCATTER_MAX_BUCKETS = 1 << 16    # medium-domain single-scatter path bound
+
+
+# --------------------------------------------------------------------------
+# sorted group-by tuning + trace-time instrumentation
+# --------------------------------------------------------------------------
+
+
+def groupby_tuning() -> tuple:
+    """(tile_rows, batch_cap, legacy) resolved from the environment.
+
+    * YDB_TPU_GROUPBY_TILE_ROWS — value-column gathers inside the sorted
+      group-by split into tiles of at most this many rows (default 4M:
+      the largest size at which 2-D gathers compile on the platform's
+      remote TPU compiler — PERF.md round-5/8; tiny values force many
+      tiles for tests);
+    * YDB_TPU_GATHER_BATCH_CAP — per-dtype batched (multi-column 2-D)
+      gathers are emitted only while a tile is at most this many rows;
+      0 disables batching entirely (per-column gathers, byte-identical
+      results);
+    * YDB_TPU_GROUPBY_LEGACY — any non-empty value other than "0" routes
+      to the pre-round-8 early-materializing lowering (A/B lever for the
+      CI gather-budget gate).
+
+    The tuple is a component of every compiled-program cache key
+    (ProgramCache, fused/tile/finalize/dist-agg keys), so flipping a knob
+    recompiles instead of serving a trace built under other settings."""
+    def _int(name: str, default: int) -> int:
+        try:
+            return int(os.environ.get(name, "") or default)
+        except ValueError:
+            return default
+    tile_rows = max(_int("YDB_TPU_GROUPBY_TILE_ROWS", 1 << 22), 8)
+    batch_cap = max(_int("YDB_TPU_GATHER_BATCH_CAP", 1 << 22), 0)
+    legacy = os.environ.get("YDB_TPU_GROUPBY_LEGACY", "") not in ("", "0")
+    return (tile_rows, batch_cap, legacy)
+
+
+class _TraceStats(threading.local):
+    """Per-thread accumulator of trace-time group-by/sort op counts —
+    the engine snapshots it per statement into QueryStats (EXPLAIN
+    ANALYZE); the same increments also land on the process /counters
+    registry under groupby/* and sort/*. Counts accrue at TRACE time:
+    a compile-cache hit re-runs no tracing, so deltas are only visible
+    for freshly compiled shapes (exactly what the CI gate wants)."""
+
+    def __init__(self):
+        self.stats: dict = {}
+
+
+_TRACE = _TraceStats()
+
+
+def groupby_trace_reset() -> None:
+    _TRACE.stats = {}
+
+
+def groupby_trace_snapshot() -> dict:
+    return dict(_TRACE.stats)
+
+
+def groupby_trace_mark() -> dict:
+    """Opaque marker for a delta window (`groupby_trace_delta`). The
+    engine brackets each statement with mark/delta instead of
+    reset/snapshot: the thread-local is never cleared mid-statement, so
+    a NESTED statement on the same thread (the DQ router's merge stage
+    re-enters `engine.query`) cannot wipe the outer statement's window —
+    its traces simply fold into the outer delta."""
+    return dict(_TRACE.stats)
+
+
+def groupby_trace_delta(mark: dict) -> dict:
+    """Trace activity since `mark`: counters subtract; `*_max` high
+    watermarks report their current value only if raised inside the
+    window (a statement that traced nothing yields {})."""
+    out = {}
+    for k, v in _TRACE.stats.items():
+        if k.endswith("_max"):
+            if v > mark.get(k, -1):
+                out[k] = v
+        else:
+            d = v - mark.get(k, 0)
+            if d:
+                out[k] = d
+    return out
+
+
+def _t_inc(name: str, by: int = 1, ns: str = "groupby") -> None:
+    from ydb_tpu.utils.metrics import GLOBAL
+    _TRACE.stats[name] = _TRACE.stats.get(name, 0) + by
+    GLOBAL.inc(f"{ns}/{name}", by)
+
+
+def _t_max(name: str, value: int, ns: str = "groupby") -> None:
+    from ydb_tpu.utils.metrics import GLOBAL
+    if value > _TRACE.stats.get(name, -1):
+        _TRACE.stats[name] = value
+    GLOBAL.set_max(f"{ns}/{name}", value)
+
+
+def _count_gather(rows: int, tile_budget: int, value: bool = False,
+                  batched: bool = False, ops: int = 1) -> None:
+    """Record `ops` traced gather ops of `rows` output rows each.
+
+    `groupby/gather_ops` counts only gathers ABOVE the tile-row budget —
+    the ~30 ms full-capacity ops the tiled/late-materialized lowering
+    exists to eliminate (each such op on the measured platform costs the
+    same as a whole tile's batch). `gather_ops_total` counts everything."""
+    _t_inc("gather_ops_total", ops)
+    if rows > tile_budget:
+        _t_inc("gather_ops", ops)
+    if batched:
+        _t_inc("batched_gathers", ops)
+    if value:
+        _t_max("value_gather_rows_max", rows)
+
+
+def record_sort(rows: int, operands: int) -> None:
+    """Called from every multi-operand device sort lowering (group-by and
+    ORDER BY alike): high-watermark of rows and operand count — the two
+    axes of the lax.sort compile cliff (PERF.md)."""
+    _t_max("rows_max", rows, ns="sort")
+    _t_max("operands_max", operands, ns="sort")
 
 
 def _acc_dtype(d):
@@ -302,21 +426,306 @@ def _groupby_medium_domain(cmd: ir.GroupBy, env, schema: Schema, sel,
                                strides)
 
 
+def _gather_sorted(cols: dict, perm, cap: int, tiles: int, tile_budget: int,
+                   batch_cap: int) -> dict:
+    """Materialize env columns in key-sorted order: the ONLY place value
+    columns are gathered at row-level granularity on the sorted path.
+
+    Tiled: the permutation splits into `tiles` static slices so no single
+    gather op exceeds cap/tiles rows — below the platform's ~4M 2-D-gather
+    compiler wedge (PERF.md round-5), which also re-unlocks the reverted
+    per-dtype BATCHED gather: all requested columns of one dtype fold into
+    one (m, tile) gather per tile (measured cost of a 2-8 column 2-D
+    gather equals ONE column's). `batch_cap` gates the batch by tile rows;
+    0 disables it (per-column gathers — byte-identical results)."""
+    T = cap // tiles
+    by_dt: dict = {}
+    for name, arr in cols.items():
+        by_dt.setdefault(str(arr.dtype), []).append(name)
+    out = {}
+    for _dt, names in by_dt.items():
+        arrs = [cols[n] for n in names]
+        m = len(arrs)
+        if batch_cap > 0 and m > 1 and T <= batch_cap:
+            stacked = jnp.stack(arrs)                    # (m, cap)
+            pieces = [stacked[:, perm[p * T:(p + 1) * T]]
+                      for p in range(tiles)]             # (m, T) each
+            _count_gather(T, tile_budget, value=True, batched=True,
+                          ops=tiles)
+            full = jnp.concatenate(pieces, axis=1) if tiles > 1 \
+                else pieces[0]
+            for i, n in enumerate(names):
+                out[n] = full[i]
+        else:
+            for n, arr in zip(names, arrs):
+                pieces = [arr[perm[p * T:(p + 1) * T]]
+                          for p in range(tiles)]
+                _count_gather(T, tile_budget, value=True, ops=tiles)
+                out[n] = jnp.concatenate(pieces) if tiles > 1 else pieces[0]
+    return out
+
+
+def _csum_diffs(per_rows: list, starts, ends, oc: int, tile_budget: int,
+                batch_cap: int) -> list:
+    """Per-group sums of sorted per-row arrays via cumulative-sum
+    endpoints, evaluated at OUTPUT capacity: diff = c[end] − c[start] +
+    v[start]. The cumsums stay 1-D (cheap on the platform; only 2-D ones
+    wedge); the endpoint gathers batch per accumulation dtype — one
+    (m, oc) gather triple instead of 3 gathers per aggregate. `batch_cap`
+    gates the batch by oc exactly as `_gather_sorted` gates by tile rows:
+    with no proven out_bound oc == scan capacity, and an (m, cap) 2-D
+    gather is the ~4M compiler-wedge shape this module exists to avoid."""
+    out: list = [None] * len(per_rows)
+    groups: dict = {}
+    for i, pr in enumerate(per_rows):
+        groups.setdefault(str(pr.dtype), []).append(i)
+    for _dt, idxs in groups.items():
+        csums = [jnp.cumsum(per_rows[i]) for i in idxs]
+        if batch_cap > 0 and len(idxs) > 1 and oc <= batch_cap:
+            cs = jnp.stack(csums)                        # (m, cap)
+            fs = jnp.stack([per_rows[i] for i in idxs])
+            ce, cst, f0 = cs[:, ends], cs[:, starts], fs[:, starts]
+            _count_gather(oc, tile_budget, batched=True, ops=3)
+            for k, i in enumerate(idxs):
+                out[i] = ce[k] - cst[k] + f0[k]
+        else:
+            for c, i in zip(csums, idxs):
+                out[i] = c[ends] - c[starts] + per_rows[i][starts]
+                _count_gather(oc, tile_budget, ops=3)
+    return out
+
+
+def _segment_scan(vals, boundary, kind: str):
+    """Running min/max within key segments of a sorted block: an
+    associative scan over (value, segment-start flag) pairs — log-depth
+    elementwise, NO scatter (the legacy path paid one ~70-100 ms
+    scatter-reduce per min/max aggregate, the platform's most taxed op
+    class). Read at segment END positions it yields the whole-segment
+    reduction."""
+    combine = jnp.minimum if kind == "min" else jnp.maximum
+
+    def op(a, b):
+        av, ab = a
+        bv, bb = b
+        return (jnp.where(bb, bv, combine(av, bv)), ab | bb)
+
+    out, _flags = jax.lax.associative_scan(op, (vals, boundary))
+    return out
+
+
 def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
                            length, cap):
-    """Unbounded-domain aggregation: sort (keys + row-id only), segment
-    boundaries from key changes, sums/counts via cumulative-sum differences
-    at segment endpoints, min/max via one scatter-reduce per aggregate.
+    """Unbounded-domain aggregation, round-8 shape: ONE key sort, then a
+    pre-aggregate → tile → LATE-MATERIALIZE pipeline, still inside one
+    dispatch (the WideCombiner workhorse, `mkql_wide_combine.cpp`, in the
+    partition-then-combine decomposition of DrJAX, arxiv 2403.07128):
 
-    The sort carries only key encodings and the row permutation — carrying
-    value columns through a wide multi-operand `lax.sort` explodes XLA
-    compile time on TPU (minutes at 1M+ rows); values are gathered by the
-    permutation instead.
+      * the sort carries only key encodings + the row permutation (wide
+        multi-operand sorts explode XLA compile time — PERF.md);
+      * per-row value materialization (the former 15-20 sequential ~30 ms
+        full-capacity gathers) happens tiled at ≤ YDB_TPU_GROUPBY_TILE_ROWS
+        rows per op and per-dtype batched (`_gather_sorted`), and ONLY for
+        columns that truly need sorted per-row values (sum/min/max data,
+        nullable-arg validity);
+      * everything per-GROUP — key values, csum endpoints, min/max scan
+        reads, `some` values — gathers at OUTPUT capacity: ngroups slots,
+        statically bounded by `cmd.out_bound` when the planner/executor
+        can prove one (key-domain products, inner-join build cardinality),
+        the scan capacity otherwise;
+      * min/max/some use a segmented associative scan (`_segment_scan`)
+        instead of scatter-reduces — the sorted path is now scatter-FREE.
+
+    `cmd.out_bound` is a PROVEN upper bound on ngroups: an understated
+    value would silently drop groups, so only guaranteed sources may set
+    it. Precision of csum diffs is unchanged from the legacy path (see
+    `_trace_group_by_sorted_legacy`)."""
+    tile_budget, batch_cap, legacy = groupby_tuning()
+    if legacy:
+        return _trace_group_by_sorted_legacy(cmd, env, schema, sel, length,
+                                             cap)
+    tiles = 1
+    while cap // tiles > tile_budget and cap % (tiles * 2) == 0 \
+            and cap // tiles > 1:
+        tiles *= 2
+    _t_inc("traces")
+    _t_inc("tiles", tiles)
+    _t_max("sort_rows_max", cap)
+    record_sort(cap, 2 * len(cmd.keys) + 2)
+
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    row_mask = iota < length
+    active = row_mask if sel is None else (row_mask & sel)
+
+    inactive = (~active).astype(jnp.int32)
+    sort_keys = [inactive]
+    for kname in cmd.keys:
+        d, v = env[kname]
+        enc = _sort_operand(d)
+        if v is not None:
+            enc = jnp.where(v, enc, _zero_like_operand(enc))
+            sort_keys.append(v.astype(jnp.int32))
+        else:
+            sort_keys.append(jnp.ones((cap,), jnp.int32))
+        sort_keys.append(enc)
+    # iota as the last key → deterministic total order, and the sort output
+    # IS the permutation (no carried operands)
+    out = jax.lax.sort(sort_keys + [iota], num_keys=len(sort_keys) + 1)
+    inactive_s = out[0]
+    keyparts_s = out[1:-1]
+    perm = out[-1]
+
+    active_s = inactive_s == 0
+    changed = jnp.zeros((cap,), jnp.bool_)
+    for kp in keyparts_s:
+        prev = jnp.concatenate([kp[:1], kp[:-1]])
+        neq = kp != prev
+        if np.issubdtype(np.dtype(kp.dtype), np.floating):
+            # NaN != NaN would split every NaN row into its own group;
+            # lax.sort places NaNs adjacently, so treat them as equal
+            neq = neq & ~(jnp.isnan(kp) & jnp.isnan(prev))
+        changed = changed | neq
+    boundary = active_s & ((iota == 0) | changed)
+    ngroups = jnp.sum(boundary.astype(jnp.int32))
+    nactive = jnp.sum(active_s.astype(jnp.int32))
+
+    # output capacity: the late-materialization granularity. Everything
+    # per-group below gathers at `oc` slots, not scan capacity.
+    oc = cap
+    if cmd.out_bound:
+        oc = min(bucket_capacity(max(int(cmd.out_bound), 1), minimum=128),
+                 cap)
+
+    # compact segment-start row indices to the front: starts[i] = sorted-row
+    # index where group i begins (argsort = 2-operand sort)
+    record_sort(cap, 2)
+    starts = jnp.argsort(jnp.where(boundary, iota, jnp.int32(cap))
+                         ).astype(jnp.int32)[:oc]
+    gi = jnp.arange(oc, dtype=jnp.int32)
+    next_start = jnp.concatenate([starts[1:], jnp.full((1,), cap, jnp.int32)])
+    # group i ends at the next group's start − 1; the LAST live group ends
+    # at nactive − 1. ngroups ≤ oc is guaranteed (out_bound contract), so
+    # slicing starts to oc cannot orphan a live group's end.
+    ends = jnp.where(gi + 1 < ngroups, next_start - 1, nactive - 1)
+    ends = jnp.clip(ends, 0, cap - 1)
+    live = gi < ngroups
+
+    # group-leader original row ids: ONE oc-sized gather shared by every
+    # late-materialized column (keys, `some` values)
+    lead = perm[jnp.clip(starts, 0, cap - 1)]
+    _count_gather(oc, tile_budget)
+
+    new_env = {}
+    for kname in cmd.keys:
+        d, v = env[kname]
+        kd = d[lead]
+        _count_gather(oc, tile_budget)
+        dt = schema.dtype(kname)
+        if dt.nullable:
+            if v is not None:
+                kv = v[lead]
+                _count_gather(oc, tile_budget)
+            else:
+                kv = jnp.ones((oc,), jnp.bool_)
+            new_env[kname] = (kd, kv & live)
+        else:
+            new_env[kname] = (kd, None)
+
+    # ---- sorted per-row materialization: only what aggregation truly
+    # needs (sum/min/max data; validity of nullable args)
+    need_data, need_valid = [], []
+    for a in cmd.aggs:
+        if a.func == "count_all":
+            continue
+        if env[a.arg][1] is not None:
+            need_valid.append(a.arg)
+        if a.func in ("sum", "min", "max"):
+            need_data.append(a.arg)
+    data_s = _gather_sorted(
+        {n: env[n][0] for n in dict.fromkeys(need_data)}, perm, cap, tiles,
+        tile_budget, batch_cap)
+    valid_s = _gather_sorted(
+        {n: env[n][1] for n in dict.fromkeys(need_valid)}, perm, cap, tiles,
+        tile_budget, batch_cap)
+
+    # ---- phase 1: register every cumulative-sum job so endpoint gathers
+    # batch per dtype across aggregates
+    jobs: list = []
+
+    def job(per_row) -> int:
+        jobs.append(per_row)
+        return len(jobs) - 1
+
+    agg_plan = []
+    for a in cmd.aggs:
+        if a.func == "count_all":
+            agg_plan.append(("count", a, job(active_s.astype(jnp.uint64)),
+                             None, None))
+            continue
+        v = valid_s.get(a.arg)
+        m = active_s if v is None else (active_s & v)
+        if a.func == "count":
+            agg_plan.append(("count", a, job(m.astype(jnp.uint64)), None,
+                             None))
+            continue
+        cnt_j = job(m.astype(jnp.int64))
+        if a.func == "sum":
+            d = data_s[a.arg]
+            acc = jnp.where(m, d, 0).astype(_acc_dtype(d))
+            agg_plan.append(("sum", a, job(acc), cnt_j, None))
+        elif a.func in ("min", "max", "some"):
+            agg_plan.append((a.func, a, None, cnt_j, m))
+        else:
+            raise ValueError(a.func)
+
+    diffs = _csum_diffs(jobs, starts, ends, oc, tile_budget, batch_cap)
+
+    # ---- phase 2: assemble per-group outputs at oc capacity
+    for (kind, a, data_j, cnt_j, m) in agg_plan:
+        if kind == "count":
+            new_env[a.out] = (jnp.where(live, diffs[data_j], 0), None)
+            continue
+        cnt = diffs[cnt_j]
+        any_valid = (cnt > 0) & live
+        if kind == "sum":
+            new_env[a.out] = (diffs[data_j], any_valid)
+        elif kind in ("min", "max"):
+            d = data_s[a.arg]
+            sent = _sentinel(np.dtype(d.dtype), kind == "min")
+            masked = jnp.where(m, d, sent)
+            data = _segment_scan(masked, boundary, kind)[ends]
+            _count_gather(oc, tile_budget)
+            data = jnp.where(any_valid, data, jnp.zeros((), d.dtype))
+            new_env[a.out] = (data, any_valid)
+        else:  # some: first valid value — late-materialized at oc
+            pos = jnp.where(m, iota, cap)
+            firstpos = _segment_scan(pos, boundary, "min")[ends]
+            _count_gather(oc, tile_budget)
+            rowid = perm[jnp.clip(firstpos, 0, cap - 1)]
+            _count_gather(oc, tile_budget)
+            data = env[a.arg][0][rowid]
+            _count_gather(oc, tile_budget)
+            new_env[a.out] = (data, any_valid)
+    return new_env, ngroups.astype(jnp.int32)
+
+
+def _trace_group_by_sorted_legacy(cmd: ir.GroupBy, env, schema: Schema, sel,
+                                  length, cap):
+    """Pre-round-8 sorted aggregation (YDB_TPU_GROUPBY_LEGACY=1): sort
+    (keys + row-id only), EARLY value materialization (every key and
+    aggregate column gathered at scan capacity), sums/counts via
+    cumulative-sum differences, min/max via one scatter-reduce per
+    aggregate. Kept as the A/B baseline for the CI gather-budget gate
+    and the byte-equality differential tests.
 
     Precision note: a segment sum is csum[end] − csum[start] + v[start];
     for a tiny group inside a huge total the cancellation costs ~(total /
     group_sum)·1e-16 relative error — acceptable for SQL doubles and the
     test oracles' 1e-6 tolerances."""
+    tile_budget, _batch_cap, _legacy = groupby_tuning()
+    _t_inc("traces")
+    _t_inc("tiles", 1)
+    _t_max("sort_rows_max", cap)
+    record_sort(cap, 2 * len(cmd.keys) + 2)
     iota = jnp.arange(cap, dtype=jnp.int32)
     row_mask = iota < length
     active = row_mask if sel is None else (row_mask & sel)
@@ -345,6 +754,8 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
         got = env_s.get(name)
         if got is None:
             d, v = env[name]
+            _count_gather(cap, tile_budget, value=True,
+                          ops=1 if v is None else 2)
             got = (d[perm], v[perm] if v is not None else None)
             env_s[name] = got
         return got
@@ -365,6 +776,7 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
 
     # compact segment-start row indices to the front: starts[i] = sorted-row
     # index where group i begins
+    record_sort(cap, 2)
     starts = jnp.argsort(jnp.where(boundary, iota, jnp.int32(cap))
                          ).astype(jnp.int32)
     gi = jnp.arange(cap, dtype=jnp.int32)
@@ -377,9 +789,14 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
     for kname in cmd.keys:
         d, v = sorted_col(kname)
         kd = d[starts]
+        _count_gather(cap, tile_budget)
         dt = schema.dtype(kname)
         if dt.nullable:
-            kv = (v[starts] if v is not None else jnp.ones((cap,), jnp.bool_))
+            if v is not None:
+                kv = v[starts]
+                _count_gather(cap, tile_budget)
+            else:
+                kv = jnp.ones((cap,), jnp.bool_)
             new_env[kname] = (kd, kv & live)
         else:
             new_env[kname] = (kd, None)
@@ -391,6 +808,7 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
         """Per-group sum of a sorted per-row array via cumsum endpoints."""
         c = jnp.cumsum(per_row)
         first = per_row[starts]
+        _count_gather(cap, tile_budget, ops=3)
         return c[ends] - c[starts] + first
 
     for a in cmd.aggs:
@@ -413,6 +831,7 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
             sent = _sentinel(np.dtype(d.dtype), a.func == "min")
             masked = jnp.where(m, d, sent)
             init = jnp.full((cap + 1,), sent, d.dtype)
+            _t_inc("scatter_ops")
             upd = (init.at[seg_safe].min(masked, mode="drop")
                    if a.func == "min"
                    else init.at[seg_safe].max(masked, mode="drop"))
@@ -423,8 +842,10 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
             # sorted, so scan for the first m-true position per segment
             pos = jnp.where(m, iota, cap)
             init = jnp.full((cap + 1,), cap, jnp.int32)
+            _t_inc("scatter_ops")
             firstpos = init.at[seg_safe].min(pos, mode="drop")[:cap]
             data = d[jnp.clip(firstpos, 0, cap - 1)]
+            _count_gather(cap, tile_budget)
             new_env[a.out] = (data, any_valid)
         else:
             raise ValueError(a.func)
@@ -515,7 +936,11 @@ class ProgramCache:
         self.misses = 0
 
     def get(self, program: ir.Program, sig, cap, param_names):
-        key = (program.fingerprint(), sig, cap, param_names)
+        # groupby tuning is part of the identity: a program traced under
+        # one tile/batch setting must not serve another (tests flip the
+        # env knobs in-process)
+        key = (program.fingerprint(), sig, cap, param_names,
+               groupby_tuning())
         fn = self._cache.get(key)
         if fn is None:
             self.misses += 1

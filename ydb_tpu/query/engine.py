@@ -416,6 +416,13 @@ class QueryEngine:
         session = session or self._default_session
         t = Timer()
         stats = QueryStats(sql=sql)
+        # per-statement group-by trace window (thread-local): whatever
+        # sorted group-bys THIS statement freshly compiles lands in
+        # stats.groupby for EXPLAIN ANALYZE / query history. Mark/delta,
+        # not reset/snapshot — a nested same-thread statement (DQ router
+        # merge stage) must not wipe the outer statement's window
+        from ydb_tpu.ops.xla_exec import groupby_trace_mark
+        stats._gb_mark = groupby_trace_mark()
         with self.tracer.span("parse"):
             stmt = parse(sql)
         stats.parse_ms = t.lap()
@@ -721,12 +728,14 @@ class QueryEngine:
         return HostBlock.from_arrays(Schema(cols), arrays, valids, dicts)
 
     def _finish_stats(self, stats, t, block) -> None:
+        from ydb_tpu.ops.xla_exec import groupby_trace_delta
         from ydb_tpu.utils.metrics import GLOBAL
         stats.execute_ms = t.lap()
         stats.total_ms = stats.parse_ms + stats.plan_ms + stats.execute_ms
         stats.rows_out = block.length
         stats.fused = self.executor.last_path == "fused"
         stats.distributed = self.executor.last_path == "distributed"
+        stats.groupby = groupby_trace_delta(getattr(stats, "_gb_mark", {}))
         GLOBAL.inc("engine/rows_out", block.length)
         GLOBAL.inc("engine/queries")
         self.query_history.append(stats)
@@ -747,10 +756,17 @@ class QueryEngine:
             "coordinator/plan_step": self.coordinator.last_plan_step,
             "pipeline/window": self.pipeline_window,
         })
-        # pipeline stage counters are always visible (zero before the
-        # first SELECT), so dashboards/probes never see missing keys
+        # pipeline stage + group-by trace counters are always visible
+        # (zero before the first SELECT / fresh compile), so
+        # dashboards/probes never see missing keys
         for k in ("pipeline/dispatched", "pipeline/in_flight",
-                  "pipeline/overlap_hits", "pipeline/readout_ms"):
+                  "pipeline/overlap_hits", "pipeline/readout_ms",
+                  "groupby/traces", "groupby/tiles", "groupby/gather_ops",
+                  "groupby/gather_ops_total", "groupby/batched_gathers",
+                  "groupby/scatter_ops", "groupby/sort_rows_max",
+                  "groupby/value_gather_rows_max",
+                  "groupby/join_bounded_plans", "dq/merge_groupby_stages",
+                  "sort/rows_max", "sort/operands_max"):
             c.setdefault(k, 0)
         return c
 

@@ -30,7 +30,7 @@ from ydb_tpu.ops.device import (
 )
 from ydb_tpu.ops.sort import sort_env
 from ydb_tpu.ops.xla_exec import (
-    _trace_program, compress, compress_block, run_on_device,
+    _trace_program, compress, compress_block, groupby_tuning, run_on_device,
 )
 from ydb_tpu.query.plan import JoinStep, Pipeline, QueryPlan, SortKey
 from ydb_tpu.storage.mvcc import MAX_SNAPSHOT, Snapshot
@@ -328,6 +328,12 @@ class Executor:
         if plan.final_program is not None:
             schema = ir.infer_schema(plan.final_program, schema)
 
+        # join-derived group-bound: when every group key is pinned by an
+        # inner/semi join's build side, ngroups ≤ build rows — stamp the
+        # sorted group-by with the proven bound so per-group gathers run
+        # at output cardinality (the q3/q9/q13 late-materialization win)
+        plan, pipe = self._bounded_groupby_rewrite(plan, builds, join_metas)
+
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
 
@@ -425,6 +431,96 @@ class Executor:
                 spec.append((sk.name, sk.ascending, sk.nulls_first))
         return sort_params, tuple(spec), rank_assigns
 
+    def _bounded_groupby_rewrite(self, plan: QueryPlan, builds: list,
+                                 join_metas: list):
+        """Stamp a PROVEN `out_bound` on the partial (and matching merge)
+        GroupBy when join structure bounds the group count: after an
+        INNER probe against a unique-keyed build, surviving probe keys
+        are a subset of the build's keys, so a group-by whose keys are
+        all drawn from {probe key} ∪ build payload has ngroups ≤ build
+        rows (semi joins bound the probe key the same way without
+        payloads). The bound is bucket-quantized so data growth
+        recompiles at capacity-bucket granularity, like everything else.
+
+        Names reassigned AFTER the bounding join (later program Assigns,
+        later join payloads/mark columns, partial-program Assigns) void
+        the guarantee for that join and are excluded. Returns the
+        (possibly rewritten) plan and its pipeline; the rewrite copies —
+        cached plans are never mutated."""
+        import dataclasses as _dc
+        pipe = plan.pipeline
+        if pipe.partial is None or not pipe.partial.commands:
+            return plan, pipe
+        gb = pipe.partial.commands[-1]
+        if not isinstance(gb, ir.GroupBy) or not gb.keys:
+            return plan, pipe
+        keys = set(gb.keys)
+        partial_assigned = {c.name for c in pipe.partial.commands[:-1]
+                            if isinstance(c, ir.Assign)}
+        best = None
+        bi = 0
+        for si, (kind, step) in enumerate(pipe.steps):
+            if kind != "join":
+                continue
+            bt = builds[bi]
+            meta = join_metas[bi]
+            bi += 1
+            if step.not_in:
+                continue
+            if step.kind == "inner" and getattr(bt, "unique", False):
+                allowed = {step.probe_key} | set(meta["payload_names"])
+            elif step.kind == "left_semi":
+                allowed = {step.probe_key}
+            else:
+                continue
+            # names invalidated downstream of THIS join
+            later = set(partial_assigned)
+            bj = bi
+            for sj in range(si + 1, len(pipe.steps)):
+                k2, s2 = pipe.steps[sj]
+                if k2 == "join":
+                    later |= set(join_metas[bj]["payload_names"])
+                    if s2.kind == "mark":
+                        later.add(s2.mark_col or "__mark")
+                    bj += 1
+                else:
+                    later |= {c.name for c in s2.commands
+                              if isinstance(c, ir.Assign)}
+            if keys <= (allowed - later):
+                n = max(int(bt.n), 1)
+                best = n if best is None else min(best, n)
+        if best is None:
+            return plan, pipe
+        if gb.out_bound:
+            # a planner domain-product bound may be far looser than the
+            # join bound (10^9-key-product vs an 8k-row build) — keep the
+            # tighter of the two, and skip only when the planner's is
+            # already at least as tight
+            if int(gb.out_bound) <= best:
+                return plan, pipe
+        bound = bucket_capacity(best, minimum=128)
+        rows = max(int(getattr(self.catalog.table(pipe.scan.table),
+                               "num_rows", 0)), 1)
+        if bound >= bucket_capacity(rows):
+            return plan, pipe          # no smaller than the scan anyway
+        gb2 = _dc.replace(gb, out_bound=bound)
+        pipe = _dc.replace(pipe, partial=ir.Program(
+            list(pipe.partial.commands[:-1]) + [gb2]))
+        fp = plan.final_program
+        if fp is not None and fp.commands \
+                and isinstance(fp.commands[0], ir.GroupBy) \
+                and fp.commands[0].keys == gb.keys \
+                and (not fp.commands[0].out_bound
+                     or int(fp.commands[0].out_bound) > bound):
+            # the merge GroupBy sees the union of partials over the SAME
+            # keys — the bound carries over
+            fgb = _dc.replace(fp.commands[0], out_bound=bound)
+            fp = ir.Program([fgb] + list(fp.commands[1:]))
+        plan = _dc.replace(plan, pipeline=pipe, final_program=fp)
+        from ydb_tpu.utils.metrics import GLOBAL
+        GLOBAL.inc("groupby/join_bounded_plans")
+        return plan, pipe
+
     # -- tiled fused path (scan > HBM budget) ------------------------------
 
     def _execute_fused_tiled(self, plan: QueryPlan, params: dict, pipe,
@@ -482,6 +578,16 @@ class Executor:
                     nb *= d + 1
                 if nb + 1 <= _SCATTER_MAX_BUCKETS:
                     tile_out_cap = bucket_capacity(nb, minimum=128)
+            if last.out_bound:
+                # proven ngroups bound (join-derived or an out-of-scatter-
+                # range domain product): the sorted lowering emits its
+                # per-group outputs at this bucket, so the partials really
+                # are this small — don't let a tile-cap-sized estimate
+                # trigger a needless host-DRAM spill
+                tile_out_cap = min(
+                    tile_out_cap,
+                    bucket_capacity(max(int(last.out_bound), 1),
+                                    minimum=128))
         prow = sum(np.dtype(c.dtype.np).itemsize + 1
                    for c in partial_schema.columns)
         est_partial = n_tiles * min(tile_out_cap, tile_cap) * prow
@@ -934,7 +1040,7 @@ class Executor:
         in_schema = per_dev[0][0].schema
         key = (merge_prog.fingerprint(),
                tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
-                     for c in in_schema.columns), ndev)
+                     for c in in_schema.columns), ndev, groupby_tuning())
         dag = self._dist_aggs.get(key)
         if dag is None:
             dag = DistributedAgg(merge_prog, merge_prog, in_schema,
@@ -1263,7 +1369,7 @@ class Executor:
                tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
                      for c in in_schema.columns),
                tuple(sorted(all_params)),
-               tuple(n for (n, _lbl) in plan.output))
+               tuple(n for (n, _lbl) in plan.output), groupby_tuning())
         entry = self._finalize_cache.get(key)
         if entry is None:
             entry = self._build_finalize(plan, in_schema, blocks_sig,

@@ -1422,9 +1422,11 @@ class Planner:
             # dedup per block, then globally; sort expressions are computed
             # after the final dedup (they would be dropped by the GroupBy)
             domains = self._key_domains(uniq_outs)
-            prog.group_by(uniq_outs, [], domains)
+            dbound = self._groups_bound(domains)
+            prog.group_by(uniq_outs, [], domains, out_bound=dbound)
             plan.pipeline.partial = prog
-            final = ir.Program().group_by(uniq_outs, [], domains)
+            final = ir.Program().group_by(uniq_outs, [], domains,
+                                          out_bound=dbound)
             sort_keys, _extra = self._bind_sort(sel, binder.bind, out_names,
                                                 final, alias_deref=deref)
             plan.final_program = final
@@ -1609,29 +1611,33 @@ class Planner:
             register(call)
 
         domains = self._key_domains(key_names)
+        gbound = self._groups_bound(domains)
         sealed[0] = True
         if dcol is None:
-            partial.group_by(key_names, partial_aggs, domains)
+            partial.group_by(key_names, partial_aggs, domains,
+                             out_bound=gbound)
             plan.pipeline.partial = partial
             # -- final stage: merge aggs, having, outputs, sort -----------
-            final = ir.Program().group_by(key_names, final_aggs, domains)
+            final = ir.Program().group_by(key_names, final_aggs, domains,
+                                          out_bound=gbound)
             for (dec, expr) in string_agg_decodes:
                 final.assign(dec, expr)
         else:
             ddom = self._key_domains([dcol])
+            dbound = self._groups_bound(domains + ddom)
             partial.group_by(key_names + [dcol], partial_aggs,
-                             domains + ddom)
+                             domains + ddom, out_bound=dbound)
             plan.pipeline.partial = partial
             # first final GroupBy completes the global dedup by
             # (keys + arg); the second collapses to the group keys, counting
             # the deduplicated arg and re-merging the regular aggregates
             # (associative, so the double merge is exact)
             final = ir.Program().group_by(key_names + [dcol], final_aggs,
-                                          domains + ddom)
+                                          domains + ddom, out_bound=dbound)
             final.group_by(
                 key_names,
                 [ir.Agg(a.out, a.func, a.out) for a in final_aggs]
-                + final2_aggs, domains)
+                + final2_aggs, domains, out_bound=gbound)
             for (dec, expr) in string_agg_decodes:
                 final.assign(dec, expr)
 
@@ -1719,6 +1725,24 @@ class Planner:
         if b is not None and b.dtype.is_string and b.dictionary is not None:
             return b.dictionary
         return None
+
+    @staticmethod
+    def _groups_bound(domains: tuple) -> int:
+        """Guaranteed ngroups upper bound from bounded key domains: the
+        mixed-radix bucket count prod(domain+1) (each key contributes its
+        domain plus the NULL slot). Feeds `ir.GroupBy.out_bound` so the
+        sorted lowering late-materializes per-group gathers at output
+        cardinality when the bounded product overflows the scatter paths
+        (multi-string-key group-bys, q16-class). 0 = no guarantee (any
+        unbounded key, or a product too large to ever matter)."""
+        bound = 1
+        for d in domains:
+            if d <= 0:
+                return 0
+            bound *= d + 1
+            if bound > (1 << 40):
+                return 0
+        return bound
 
     def _key_domains(self, key_names: list) -> tuple:
         """Static key-domain sizes for the scatter aggregation path:

@@ -64,6 +64,28 @@ GLOBAL = Counters()
 #   dq/frames                     frames shipped over channels
 #   dq/local_stage_execs          statements run as DQ stage programs
 #   dq/channel_inflight_peak_bytes  flow-control high watermark
+#   dq/merge_groupby_stages       router merge stages that are partial-agg
+#                                 merges (ride the tiled sorted group-by)
+#
+# Sorted group-by trace counters (`ops/xla_exec.py`, accrued at TRACE
+# time — compile-cache hits re-trace nothing, so deltas show up only for
+# freshly compiled shapes; the CI gather-budget gate relies on that):
+#   groupby/traces                sorted group-by lowerings traced
+#   groupby/tiles                 tiles across those traces (P per trace)
+#   groupby/gather_ops            gathers ABOVE the tile-row budget — the
+#                                 ~30 ms full-capacity ops the round-8
+#                                 tiled path exists to eliminate
+#   groupby/gather_ops_total      every traced gather
+#   groupby/batched_gathers       per-dtype multi-column (2-D) gathers
+#   groupby/scatter_ops           scatter-reduces (legacy path only; the
+#                                 round-8 path is scatter-free)
+#   groupby/sort_rows_max         high watermark of group-by sort rows
+#   groupby/value_gather_rows_max high watermark of per-op value-column
+#                                 gather rows (≤ tile budget when tiling)
+#   groupby/join_bounded_plans    fused plans whose group count was
+#                                 bounded by an inner-join build side
+#   sort/rows_max, sort/operands_max  lax.sort compile-cliff axes across
+#                                 all device sorts (group-by + ORDER BY)
 
 
 @dataclass
@@ -80,16 +102,30 @@ class QueryStats:
     fused: bool = False            # whole-query single-dispatch path
     distributed: bool = False      # mesh hash-shuffle path
     tables: list = field(default_factory=list)
+    # sorted group-by trace breakdown (tiles/gather_ops/…, the
+    # `xla_exec.groupby_trace_delta` window for this statement) —
+    # non-empty only when it compiled a fresh group-by shape
+    groupby: dict = field(default_factory=dict)
 
     def render(self) -> str:
         path = ("mesh-distributed" if self.distributed
                 else "fused single-dispatch" if self.fused
                 else "portioned")
-        return (f"-- stats: total {self.total_ms:.1f}ms "
-                f"(parse {self.parse_ms:.1f}, plan {self.plan_ms:.1f}"
-                f"{' [cache hit]' if self.plan_cache_hit else ''}, "
-                f"execute {self.execute_ms:.1f}) | "
-                f"rows out {self.rows_out} | path {path}")
+        out = (f"-- stats: total {self.total_ms:.1f}ms "
+               f"(parse {self.parse_ms:.1f}, plan {self.plan_ms:.1f}"
+               f"{' [cache hit]' if self.plan_cache_hit else ''}, "
+               f"execute {self.execute_ms:.1f}) | "
+               f"rows out {self.rows_out} | path {path}")
+        if self.groupby:
+            g = self.groupby
+            out += (f"\n-- groupby trace: tiles {g.get('tiles', 0)} | "
+                    f"gathers {g.get('gather_ops_total', 0)} "
+                    f"({g.get('gather_ops', 0)} over tile budget, "
+                    f"{g.get('batched_gathers', 0)} batched) | "
+                    f"sort rows max {g.get('sort_rows_max', 0)} | "
+                    f"value gather rows max "
+                    f"{g.get('value_gather_rows_max', 0)}")
+        return out
 
 
 class Timer:

@@ -652,6 +652,155 @@ def concurrency_main(n: int, rows: int = 150_000) -> int:
     return 0 if ok else 1
 
 
+def storm_main(n: int, rows: int = 8192) -> int:
+    """Point-lookup storm (`bench.py --storm N`): N literal-varying
+    point lookups — N DISTINCT SQL texts, `... where id = X limit 1` —
+    through one engine per lane, measured steady-state (texts warmed so
+    the plan cache serves the measured rounds — the millions-of-clients
+    traffic shape):
+
+      * lane OFF (`YDB_TPU_BATCH_WINDOW=0`): the PR-1 pipelined
+        baseline — per-query dispatch + readout, overlapped;
+      * lane ON: same storm coalesced into stacked executions.
+
+    Emits ONE JSON line: compile counts (the param-lifting pin: the
+    whole literal-varying storm costs exactly 1 fused executable on the
+    baseline engine), batch/* counters, best-of-round wall clocks, the
+    wall speedup, the DISPATCH AMORTIZATION (mean queries per stacked
+    device execution — the deterministic form of the throughput win: on
+    the tunneled chip every per-query dispatch+readout costs ~15-35 ms
+    (PERF.md), so wall throughput tracks this ratio there, while a
+    2-core CPU runner's wall clock is floored by thread/GIL overhead
+    either way), and a byte-equality verdict between the lanes.
+    `scripts/batch_gate.py` asserts on these fields. rc 0 = storm ran,
+    results byte-equal, 1 compile, real coalescing; the thresholds are
+    the gate's job."""
+    import threading
+
+    import numpy as np
+    import pandas as pd
+
+    window_ms = os.environ.get("BENCH_BATCH_WINDOW_MS", "500")
+    rounds = max(1, int(os.environ.get("BENCH_STORM_ROUNDS", "3")))
+    n_batch = min(n, int(os.environ.get("YDB_TPU_BATCH_MAX", "64") or 64))
+
+    def mk_engine(window: str):
+        os.environ["YDB_TPU_BATCH_WINDOW"] = window
+        os.environ["YDB_TPU_BATCH_MAX"] = str(n_batch)
+        from ydb_tpu.query import QueryEngine
+        eng = QueryEngine(block_rows=1 << 17)
+        eng.execute("create table st (id Int64 not null, k Int64 not null,"
+                    " v Double not null, primary key (id)) "
+                    "with (store = column)")
+        ids = np.arange(rows, dtype=np.int64)
+        df = pd.DataFrame({"id": ids, "k": ids % 97, "v": ids * 0.25})
+        t = eng.catalog.table("st")
+        t.bulk_upsert(df, eng._next_version())
+        t.indexate()
+        eng.prewarm()
+        return eng
+
+    texts = [f"select k, v from st where id = {(37 + i * 101) % rows} "
+             "limit 1" for i in range(n)]
+
+    def warm(eng):
+        for q in texts:
+            eng.query(q)
+
+    def run_threaded(eng):
+        errs: list = []
+        results: dict = {}
+        barrier = threading.Barrier(n)
+
+        def one(i, sql):
+            try:
+                barrier.wait()
+                results[i] = eng.query(sql)
+            except Exception as e:         # noqa: BLE001
+                errs.append(f"{type(e).__name__}: {e}")
+        threads = [threading.Thread(target=one, args=(i, q))
+                   for i, q in enumerate(texts)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        return time.perf_counter() - t0, results, errs
+
+    # lane OFF: the pipelined per-query baseline (best of N rounds — a
+    # 64-thread storm on a small shared runner is scheduling-noisy)
+    base = mk_engine("0")
+    fused0 = len(base.executor._fused_cache)
+    warm(base)
+    storm_compiles = len(base.executor._fused_cache) - fused0
+    base_s, base_res, base_errs = run_threaded(base)
+    for _ in range(rounds - 1):
+        s2, r2, e2 = run_threaded(base)
+        if not e2 and s2 < base_s:
+            base_s, base_res = s2, r2
+        base_errs += e2
+
+    # lane ON: batched dispatch (same best-of-N; the first round also
+    # warms the stacked-bucket executable)
+    eng = mk_engine(window_ms)
+    warm(eng)                              # plan cache + per-query program
+    _w_s, _w_res, w_errs = run_threaded(eng)   # warms the batched bucket
+    batch_s, batch_res, batch_errs = run_threaded(eng)
+    for _ in range(rounds - 1):
+        s2, r2, e2 = run_threaded(eng)
+        if not e2 and s2 < batch_s:
+            batch_s, batch_res = s2, r2
+        batch_errs += e2
+    c = eng.counters()
+
+    equal = not base_errs and not batch_errs and not w_errs
+    for i in range(n):
+        if not equal:
+            break
+        a, b = base_res.get(i), batch_res.get(i)
+        if a is None or b is None or list(a.columns) != list(b.columns) \
+                or not all(np.array_equal(a[col].to_numpy(),
+                                          b[col].to_numpy())
+                           for col in a.columns):
+            equal = False
+    speedup = base_s / batch_s if batch_s else 0.0
+    batches = c.get("batch/batches", 0)
+    coalesced = c.get("batch/coalesced_queries", 0)
+    # queries per stacked device execution: the per-query
+    # dispatch+readout round trips the lane eliminated
+    amortization = (coalesced / batches) if batches else 0.0
+    out = {
+        "metric": "storm_batched_speedup",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "storm_n": n,
+        "rows": rows,
+        "window_ms": float(window_ms),
+        "rounds": rounds,
+        "storm_compiles": storm_compiles,
+        "baseline_s": round(base_s, 4),
+        "batched_s": round(batch_s, 4),
+        "qps_baseline": round(n / base_s, 1) if base_s else 0.0,
+        "qps_batched": round(n / batch_s, 1) if batch_s else 0.0,
+        "dispatch_amortization": round(amortization, 1),
+        "byte_equal": equal,
+        "batches": batches,
+        "coalesced_queries": coalesced,
+        "batch_max_size": c.get("batch/max_size", 0),
+        "batch_fallbacks": c.get("batch/fallbacks", 0),
+        "batch_trace_errors": c.get("batch/trace_errors", 0),
+        "lift_hits": c.get("batch/lift_hits", 0),
+        "errors": (base_errs + w_errs + batch_errs)[:5],
+    }
+    print(json.dumps(out), flush=True)
+    ok = equal and storm_compiles == 1 and coalesced >= 2
+    if not ok:
+        log(f"storm FAILED: byte_equal={equal} "
+            f"compiles={storm_compiles} coalesced={coalesced} "
+            f"errors={out['errors']}")
+    return 0 if ok else 1
+
+
 def main() -> None:
     import threading
     suites: dict = {}
@@ -674,6 +823,28 @@ def main() -> None:
         _WEDGED["v"] = True
         _emit(suites)
         return
+    # point-lookup storm leg (batched dispatch lane vs the pipelined
+    # baseline): its own child + watchdog like every other leg — a
+    # wedged storm costs one QUERY_TIMEOUT window, not the suites'
+    storm_n = int(os.environ.get("BENCH_STORM", "64") or 0)
+    if storm_n:
+        cmd = [sys.executable, os.path.abspath(__file__), "--storm",
+               str(storm_n)]
+        try:
+            p = subprocess.run(cmd, timeout=QUERY_TIMEOUT,
+                               capture_output=True)
+            line = p.stdout.decode(errors="replace").strip() \
+                .splitlines()[-1] if p.stdout.strip() else "{}"
+            suites["storm"] = json.loads(line)
+            suites["storm"]["rc"] = p.returncode
+            log(f"storm: {suites['storm'].get('value')}x batched speedup, "
+                f"{suites['storm'].get('storm_compiles')} compile(s), "
+                f"byte_equal={suites['storm'].get('byte_equal')}")
+        except (subprocess.TimeoutExpired, json.JSONDecodeError,
+                IndexError) as e:
+            suites["storm"] = {"error": f"{type(e).__name__}"}
+            log(f"storm leg failed: {type(e).__name__}")
+        _emit(suites)
     plan = [("tpch", sf) for sf in SUITE_SFS]
     if TPCDS_SF:
         plan.append(("tpcds", float(TPCDS_SF)))
@@ -713,6 +884,10 @@ if __name__ == "__main__":
         sys.exit(concurrency_main(
             int(sys.argv[2]) if len(sys.argv) > 2 else 8,
             rows=int(os.environ.get("BENCH_CONCURRENCY_ROWS", "150000"))))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--storm":
+        sys.exit(storm_main(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 64,
+            rows=int(os.environ.get("BENCH_STORM_ROWS", "8192"))))
     elif len(sys.argv) > 1 and sys.argv[1] == "--suite-child":
         sf = float(sys.argv[2])
         skip = [s for s in sys.argv[4].split(",") if s] \

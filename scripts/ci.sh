@@ -56,6 +56,19 @@ if [ "$src" -ne 0 ]; then
     exit "$src"
 fi
 
+echo "== batched-dispatch storm gate (lift: 1 compile; lane: >=5x dispatch amortization, byte-equal) =="
+# deterministic gates: the 64-literal storm compiles ONE fused program
+# (parameter lifting), the batched lane coalesces >=5 queries per
+# stacked device execution, and results are byte-equal with the lane
+# off. Wall-clock floor defaults noise-tolerant (CI_STORM_MIN_SPEEDUP,
+# like BENCH_MIN_SPEEDUP above) — raise it on quiet on-chip hardware.
+JAX_PLATFORMS=cpu python scripts/batch_gate.py
+brc=$?
+if [ "$brc" -ne 0 ]; then
+    echo "batched-dispatch storm gate FAILED (rc=$brc)" >&2
+    exit "$brc"
+fi
+
 echo "== DQ two-worker smoke (scan→join→agg over hash-shuffle edges) =="
 # two real OS worker processes; gates on result correctness AND the
 # dq/* counters being non-zero on router + workers (a refactor that

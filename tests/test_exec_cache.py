@@ -46,13 +46,16 @@ def test_get_refresh_protects_across_caches():
     assert "x" in c1 and "y" not in c2
 
 
-def test_engine_soak_live_executables_bounded():
+def test_engine_soak_live_executables_bounded(monkeypatch):
     """Many distinct query shapes through ONE engine: the live-executable
     count stays under the global budget and results stay correct (the
-    r4 segfault scenario, minus the segfault)."""
+    r4 segfault scenario, minus the segfault). Lifting pinned OFF so the
+    distinct literals really are distinct executables — the storm-shares-
+    one-program property has its own pin above."""
     from ydb_tpu.ops.exec_cache import GLOBAL_BUDGET, live_executables
     from ydb_tpu.query import QueryEngine
 
+    monkeypatch.setenv("YDB_TPU_PARAM_LIFT", "0")
     eng = QueryEngine(block_rows=1 << 12)
     eng.execute("create table s (k Int64 not null, a Int64, b Double, "
                 "c Int64, primary key (k))")
@@ -112,16 +115,58 @@ def test_eviction_releases_executables():
     assert inner1.cleared == 1 and inner2.cleared == 1
 
 
+def test_literal_storm_compiles_one_program():
+    """THE param-lifting regression pin (the PR-6 tentpole vs the Weak #3
+    executable-accumulation class): a 64-query literal-varying
+    point-lookup storm — every statement a distinct SQL text — compiles
+    EXACTLY ONE fused program after warmup, the per-stage ProgramCache
+    takes zero new misses, and the exec-cache footprint stays flat.
+    Before lifting, every distinct literal was a distinct program
+    fingerprint: 64 clients = 64 executables of cache pressure."""
+    from ydb_tpu.ops.exec_cache import live_executables
+    from ydb_tpu.ops.xla_exec import _GLOBAL_CACHE
+    from ydb_tpu.query import QueryEngine
+
+    eng = QueryEngine(block_rows=1 << 12)
+    eng.execute("create table pt (k Int64 not null, a Int64, b Double, "
+                "primary key (k))")
+    eng.execute("insert into pt (k, a, b) values "
+                + ", ".join(f"({i}, {i % 7}, {i * 0.5})"
+                            for i in range(200)))
+    warm = eng.query("select a, b from pt where k = 0")
+    assert warm.a[0] == 0
+    fused0 = len(eng.executor._fused_cache)
+    prog_misses0 = _GLOBAL_CACHE.misses
+    live0 = live_executables()
+    for i in range(1, 64):
+        df = eng.query(f"select a, b from pt where k = {i}")
+        assert df.a[0] == i % 7 and abs(df.b[0] - i * 0.5) < 1e-9, i
+    assert len(eng.executor._fused_cache) == fused0, \
+        "literal variants must share ONE compiled fused program"
+    assert _GLOBAL_CACHE.misses == prog_misses0
+    assert live_executables() == live0, "exec-cache size must stay flat"
+    # the lifted-LIMIT bucket shares too: limit 3 and limit 5 both live
+    # inside the 128-row bucket → one executable, distinct results
+    df3 = eng.query("select k from pt where a = 1 order by k limit 3")
+    n1 = len(eng.executor._fused_cache)
+    df5 = eng.query("select k from pt where a = 1 order by k limit 5")
+    assert len(eng.executor._fused_cache) == n1
+    assert list(df3.k) == [1, 8, 15] and list(df5.k) == [1, 8, 15, 22, 29]
+
+
 @pytest.mark.slow
-def test_soak_compile_twice_the_lru_cap_releases():
+def test_soak_compile_twice_the_lru_cap_releases(monkeypatch):
     """Soak (marked slow): compile 2× the LRU cap of DISTINCT query
     shapes in ONE process — the live-executable count stays under the
     cap, evictions actually release (released counter tracks them), and
     results stay correct throughout. The full-suite-SIGSEGV scenario,
-    run deliberately."""
+    run deliberately. Parameter lifting is pinned OFF: it would collapse
+    the distinct literals into one shape and starve the eviction path
+    this soak exists to exercise."""
     from ydb_tpu.ops.exec_cache import GLOBAL_BUDGET, live_executables
     from ydb_tpu.query import QueryEngine
 
+    monkeypatch.setenv("YDB_TPU_PARAM_LIFT", "0")
     eng = QueryEngine(block_rows=1 << 12)
     eng.execute("create table soak (k Int64 not null, a Int64, b Double, "
                 "primary key (k))")

@@ -36,6 +36,11 @@ from ydb_tpu.ops.join import probe_lut_traced
 from ydb_tpu.ops.sort import sort_env
 from ydb_tpu.ops.xla_exec import _eval, _trace_program, compress
 
+# the executor-lifted LIMIT+OFFSET device input (companion of the
+# query/paramlift.py literal lift; defined here because the ops layer
+# must not import the query layer at trace time)
+LIMIT_PARAM = "__lim2"
+
 
 def apply_join_schema(schema: Schema, payload_cols: list) -> Schema:
     """Schema effect of a join probe: payload columns replace any existing
@@ -46,30 +51,25 @@ def apply_join_schema(schema: Schema, payload_cols: list) -> Schema:
                   + list(payload_cols))
 
 
-def build_fused_fn(pipe, final_program: Optional[ir.Program],
-                   scan_cols: list, K: int, CAP: int,
-                   sb_valid_names: frozenset, join_metas: list,
-                   rank_assigns: list, sort_spec: tuple,
-                   limit: Optional[int], offset: Optional[int],
-                   keep: tuple):
-    """Compile the full single-node query pipeline into one jitted fn.
+def _fused_body(pipe, final_program: Optional[ir.Program],
+                scan_cols: list, K: int, CAP: int,
+                sb_valid_names: frozenset, join_metas: list,
+                rank_assigns: list, sort_spec: tuple,
+                limit: Optional[int], offset: Optional[int],
+                keep: tuple, lift_limit: bool = False):
+    """Un-jitted trace body shared by the single-query fused program
+    (`build_fused_fn`) and the multi-query batched lane
+    (`build_fused_batched_fn`, which vmaps it over stacked params).
 
-    scan_cols: [Column] of the flattened scan env (internal names).
-    join_metas: per join step, the static meta dict for
-    `probe_lut_traced` plus "payload_cols" ([Column] appended to the
-    schema by the probe).
-
-    Returns (fn, layout_box); fn(sb, sbv, lengths, builds, params) →
-    (data_stacks {dtype: (k, cap)}, valid_stack (m, cap) | None, length).
-    Outputs are STACKED by dtype so the result crosses the link in a
-    handful of transfers instead of one per column (each device→host
-    round trip costs ~15 ms on this platform — PERF.md); `layout_box`
-    is filled at trace time with {"data": [(name, dtype_str, row)],
-    "valids": [name]} describing the stacking."""
+    `lift_limit`: LIMIT+OFFSET arrives as the `__lim2` device input
+    (paramlift.LIMIT_PARAM) instead of a baked constant — the length
+    clamp becomes runtime, while the output slice stays static at the
+    limit's capacity bucket (identical to the baked path's bucket, so
+    results are byte-equal); callers key the compiled program on the
+    bucket, and every limit inside it shares one executable."""
     lim2 = None if limit is None else limit + (offset or 0)
     layout_box: dict = {}
 
-    @jax.jit
     def fn(sb, sbv, lengths, builds, params):
         cap = K * CAP
         env = {}
@@ -121,7 +121,8 @@ def build_fused_fn(pipe, final_program: Optional[ir.Program],
                 tuple(arrays.keys()))
             env = {n: (arrays2[n], valids2.get(n)) for n in arrays2}
         if lim2 is not None:
-            length = jnp.minimum(length, jnp.int32(lim2))
+            bound = params[LIMIT_PARAM] if lift_limit else jnp.int32(lim2)
+            length = jnp.minimum(length, bound)
             out_cap = min(bucket_capacity(lim2, minimum=128), cap)
             env = {n: (d[:out_cap], v[:out_cap] if v is not None else None)
                    for n, (d, v) in env.items()}
@@ -144,32 +145,65 @@ def build_fused_fn(pipe, final_program: Optional[ir.Program],
     return fn, layout_box
 
 
-def fetch_fused_result(data_stacks, valid_stack, length, layout_box: dict,
+def build_fused_fn(pipe, final_program: Optional[ir.Program],
+                   scan_cols: list, K: int, CAP: int,
+                   sb_valid_names: frozenset, join_metas: list,
+                   rank_assigns: list, sort_spec: tuple,
+                   limit: Optional[int], offset: Optional[int],
+                   keep: tuple, lift_limit: bool = False):
+    """Compile the full single-node query pipeline into one jitted fn.
+
+    scan_cols: [Column] of the flattened scan env (internal names).
+    join_metas: per join step, the static meta dict for
+    `probe_lut_traced` plus "payload_cols" ([Column] appended to the
+    schema by the probe).
+
+    Returns (fn, layout_box); fn(sb, sbv, lengths, builds, params) →
+    (data_stacks {dtype: (k, cap)}, valid_stack (m, cap) | None, length).
+    Outputs are STACKED by dtype so the result crosses the link in a
+    handful of transfers instead of one per column (each device→host
+    round trip costs ~15 ms on this platform — PERF.md); `layout_box`
+    is filled at trace time with {"data": [(name, dtype_str, row)],
+    "valids": [name]} describing the stacking."""
+    fn, layout_box = _fused_body(pipe, final_program, scan_cols, K, CAP,
+                                 sb_valid_names, join_metas, rank_assigns,
+                                 sort_spec, limit, offset, keep,
+                                 lift_limit=lift_limit)
+    return jax.jit(fn), layout_box
+
+
+def build_fused_batched_fn(pipe, final_program: Optional[ir.Program],
+                           scan_cols: list, K: int, CAP: int,
+                           sb_valid_names: frozenset, join_metas: list,
+                           rank_assigns: list, sort_spec: tuple,
+                           limit: Optional[int], offset: Optional[int],
+                           keep: tuple, param_axes: dict, axis_size: int,
+                           lift_limit: bool = False):
+    """The multi-query batched dispatch program: ONE executable running
+    `axis_size` same-shape queries as a vmap over their stacked lifted
+    params (DrJAX's mapped-over-a-fixed-program composition, arxiv
+    2403.07128). Scan superblock, build tables, and any param whose
+    value is batch-invariant broadcast (in_axes None); only the
+    per-member params carry the leading batch axis (`param_axes`:
+    {name: 0 | None}). Outputs gain a leading batch axis; each client's
+    result is its slice (`fetch_fused_batch`)."""
+    fn, layout_box = _fused_body(pipe, final_program, scan_cols, K, CAP,
+                                 sb_valid_names, join_metas, rank_assigns,
+                                 sort_spec, limit, offset, keep,
+                                 lift_limit=lift_limit)
+    batched = jax.vmap(fn, in_axes=(None, None, None, None, param_axes),
+                       axis_size=axis_size)
+    return jax.jit(batched), layout_box
+
+
+def _unpack_fused_host(host_stacks, host_valids, n: int, layout_box: dict,
                        out_schema: Schema, out_dicts: dict):
-    """Device→host readout of one fused dispatch: ONE `jax.device_get`
-    for the whole result (length included) — per-column fetches pay a
-    full link round trip each (PERF.md). Large row-level outputs sync
-    the length first and slice device-side so padding doesn't cross the
-    link. This is the deferred half of the device-result future: the
-    dispatch returns immediately and this runs when the result is
-    consumed, so concurrent queries overlap compute with D2H drains."""
+    """Host-side assembly of one query's result from already-transferred
+    dtype-stacked arrays (shared by the single-query fetch and each
+    member slice of a batched fetch)."""
     from ydb_tpu.core.block import HostBlock
     from ydb_tpu.ops.device import host_column
 
-    cap_out = (next(iter(data_stacks.values())).shape[1]
-               if data_stacks else 0)
-    if cap_out > (1 << 16):
-        n = int(length)
-        m = max(n, 1)
-        data_stacks = {k: v[:, :m] for k, v in data_stacks.items()}
-        if valid_stack is not None:
-            valid_stack = valid_stack[:, :m]
-        host_stacks, host_valids = jax.device_get(
-            (data_stacks, valid_stack))
-    else:
-        host_stacks, host_valids, n = jax.device_get(
-            (data_stacks, valid_stack, length))
-        n = int(n)
     valid_row = {nm: i for i, nm in enumerate(layout_box["valids"])}
     cols = {}
     out_cols = []
@@ -184,6 +218,52 @@ def fetch_fused_result(data_stacks, valid_stack, length, layout_box: dict,
                                  out_dicts.get(name))
         out_cols.append(out_schema.col(name))
     return HostBlock(Schema(out_cols), cols, n)
+
+
+def fetch_fused_result(data_stacks, valid_stack, length, layout_box: dict,
+                       out_schema: Schema, out_dicts: dict):
+    """Device→host readout of one fused dispatch: ONE `jax.device_get`
+    for the whole result (length included) — per-column fetches pay a
+    full link round trip each (PERF.md). Large row-level outputs sync
+    the length first and slice device-side so padding doesn't cross the
+    link. This is the deferred half of the device-result future: the
+    dispatch returns immediately and this runs when the result is
+    consumed, so concurrent queries overlap compute with D2H drains."""
+    cap_out = (next(iter(data_stacks.values())).shape[1]
+               if data_stacks else 0)
+    if cap_out > (1 << 16):
+        n = int(length)
+        m = max(n, 1)
+        data_stacks = {k: v[:, :m] for k, v in data_stacks.items()}
+        if valid_stack is not None:
+            valid_stack = valid_stack[:, :m]
+        host_stacks, host_valids = jax.device_get(
+            (data_stacks, valid_stack))
+    else:
+        host_stacks, host_valids, n = jax.device_get(
+            (data_stacks, valid_stack, length))
+        n = int(n)
+    return _unpack_fused_host(host_stacks, host_valids, n, layout_box,
+                              out_schema, out_dicts)
+
+
+def fetch_fused_batch(data_stacks, valid_stack, lengths, layout_box: dict,
+                      out_schema: Schema, out_dicts: dict,
+                      member_rows: list):
+    """Device→host readout of one BATCHED dispatch: still ONE
+    `jax.device_get` — for the whole batch — then each member unpacks
+    its slice host-side. `member_rows[i]` is member i's batch-axis row
+    (identical-query dedup maps every member to row 0; padded rows are
+    never read). Returns [HostBlock], one per member."""
+    host_stacks, host_valids, ns = jax.device_get(
+        (data_stacks, valid_stack, lengths))
+    out = []
+    for b in member_rows:
+        hs = {k: v[b] for k, v in host_stacks.items()}
+        hv = host_valids[b] if host_valids is not None else None
+        out.append(_unpack_fused_host(hs, hv, int(ns[b]), layout_box,
+                                      out_schema, out_dicts))
+    return out
 
 
 def build_tile_fn(pipe, scan_cols: list, K: int, CAP: int,
@@ -266,11 +346,14 @@ def tile_cache_key(pipe, scan_cols, K, CAP, sb_valid_names, builds_sig,
 
 
 def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,
-                    sort_spec, rank_assigns, param_names):
+                    sort_spec, rank_assigns, param_names, lim_key=None):
     # the plan signature carries the group-by tuning (tile rows / gather
     # batch cap / legacy flag): the cost gate for the tile count P runs
     # at trace time from (capacity, tuning), so a knob flip must compile
-    # a fresh program rather than reuse one tiled differently
+    # a fresh program rather than reuse one tiled differently.
+    # `lim_key`: lifted-LIMIT plans key on the limit's capacity bucket
+    # (("limB", bucket)) instead of the exact values — every LIMIT inside
+    # one bucket shares one executable, the clamp rides in as __lim2
     from ydb_tpu.ops.xla_exec import groupby_tuning
     pipe = plan.pipeline
     progs = []
@@ -286,13 +369,14 @@ def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,
         progs.append(pipe.partial.fingerprint())
     if plan.final_program is not None:
         progs.append(plan.final_program.fingerprint())
+    lim = (plan.limit, plan.offset) if lim_key is None else lim_key
     return (tuple(progs),
             tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
                   for c in scan_cols),
             K, CAP, tuple(sorted(sb_valid_names)), builds_sig,
             sort_spec,
             ir.Program(rank_assigns).fingerprint() if rank_assigns else "",
-            plan.limit, plan.offset,
+            lim,
             tuple(n for (n, _lbl) in plan.output), tuple(param_names),
             groupby_tuning())
 

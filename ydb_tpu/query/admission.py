@@ -63,6 +63,23 @@ class MemoryAdmission:
                 self._cv.notify_all()
 
 
+def batch_reservation_bytes(est_bytes: int, n_members: int,
+                            member_floor: int = 1 << 20) -> int:
+    """ONE reservation for a coalesced batch (`query/batch_lane.py`).
+
+    Charging each member its full scan+build estimate as an independent
+    nominal-slot reservation would both multiply-count the shared
+    superblock AND risk deadlocking the pipeline window against
+    admission; charging only the leader's estimate would under-count the
+    vmapped execution, which materializes one cap-sized copy of every
+    intermediate PER MEMBER. The honest size of the stacked execution is
+    therefore one reservation of ~N x the per-member estimate (floored
+    for tiny scans); estimates above the whole budget clamp there and
+    serialize against everything, like any giant query."""
+    return int(est_bytes) + max(0, n_members - 1) * \
+        max(int(member_floor), int(est_bytes))
+
+
 def estimate_plan_bytes(catalog, plan, snapshot) -> int:
     """Device-byte estimate for a SELECT plan: the driving scan's columns
     at the table's row count, plus each join build's scan (one level deep

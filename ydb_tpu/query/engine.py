@@ -156,6 +156,19 @@ class QueryEngine:
         self._pipe_sem = threading.BoundedSemaphore(self.pipeline_window)
         self._pipe_mu = threading.Lock()
         self._pipe_inflight = 0
+        # multi-query batched dispatch lane (query/batch_lane.py): with
+        # YDB_TPU_BATCH_WINDOW=<ms> > 0, same-shape SELECTs arriving
+        # inside the window coalesce into ONE stacked fused execution
+        # (one dispatch + one readout + one admission reservation for B
+        # clients). 0 = off, byte-identical to the per-query path.
+        self.batch_window_ms = float(
+            os.environ.get("YDB_TPU_BATCH_WINDOW", "0") or 0)
+        self._batch_lane = None
+        if self.batch_window_ms > 0:
+            from ydb_tpu.query.batch_lane import BatchLane
+            self._batch_lane = BatchLane(
+                self, self.batch_window_ms / 1000.0,
+                max_batch=int(os.environ.get("YDB_TPU_BATCH_MAX", "64")))
 
     # -- per-thread statement metadata -------------------------------------
 
@@ -557,7 +570,7 @@ class QueryEngine:
             block = self._execute_materialized(stmt, snap)
             self._finish_stats(stats, t, block)
             return block
-        fp = self._table_fingerprint(stmt)
+        fp = self._table_fingerprint(stmt, stats.tables)
         cached = self._plan_cache.get(sql) \
             if self.config.flag("enable_plan_cache") else None
         if cached is not None and cached[0] == fp:
@@ -581,7 +594,14 @@ class QueryEngine:
         # nominal slot so admission can actually bound concurrency
         est = max(estimate_plan_bytes(self.catalog, plan, snap), 1 << 20)
         try:
-            block = self._dispatch_and_drain(plan, snap, est)
+            block = None
+            if self._batch_lane is not None:
+                # batched dispatch lane: same-shape arrivals coalesce
+                # into one stacked execution (window + admission handled
+                # by the batch leader — members hold neither)
+                block = self._batch_lane.try_run(plan, snap, est, stats)
+            if block is None:
+                block = self._dispatch_and_drain(plan, snap, est)
         except AdmissionTimeout as e:
             raise QueryError(str(e)) from e
         self._finish_stats(stats, t, block)
@@ -755,12 +775,18 @@ class QueryEngine:
             "program_cache/misses": _GLOBAL_CACHE.misses,
             "coordinator/plan_step": self.coordinator.last_plan_step,
             "pipeline/window": self.pipeline_window,
+            "batch/window_ms": self.batch_window_ms,
         })
-        # pipeline stage + group-by trace counters are always visible
-        # (zero before the first SELECT / fresh compile), so
+        # pipeline stage + group-by trace + batching counters are always
+        # visible (zero before the first SELECT / fresh compile), so
         # dashboards/probes never see missing keys
         for k in ("pipeline/dispatched", "pipeline/in_flight",
                   "pipeline/overlap_hits", "pipeline/readout_ms",
+                  "batch/batches", "batch/coalesced_queries",
+                  "batch/max_size", "batch/singles", "batch/fallbacks",
+                  "batch/declined", "batch/trace_errors",
+                  "batch/reservations", "batch/window_timeouts",
+                  "batch/lift_hits", "batch/lift_misses",
                   "groupby/traces", "groupby/tiles", "groupby/gather_ops",
                   "groupby/gather_ops_total", "groupby/batched_gathers",
                   "groupby/scatter_ops", "groupby/sort_rows_max",
@@ -1062,12 +1088,15 @@ class QueryEngine:
         """Execute and return a pandas DataFrame (tests / CLI)."""
         return self.execute(sql).to_pandas()
 
-    def _table_fingerprint(self, sel: ast.Select):
+    def _table_fingerprint(self, sel: ast.Select, names=None):
         """(name, uid, data_version) of every table the statement touches —
         the plan-cache validity key (reference keys its compile cache on
-        query text + schema version, `kqp_compile_service.cpp:411`)."""
+        query text + schema version, `kqp_compile_service.cpp:411`).
+        `names`: pass an already-computed `_referenced_tables` set so the
+        hot SELECT path walks the AST once, not twice."""
         out = []
-        for n in sorted(self._referenced_tables(sel)):
+        for n in sorted(names if names is not None
+                        else self._referenced_tables(sel)):
             if self.catalog.has(n):
                 t = self.catalog.table(n)
                 out.append((n, t.uid, t.data_version))

@@ -260,7 +260,6 @@ class Executor:
         pipeline dispatch/readout seam); on fallback, the list of
         prepared join BuildTables (for `_run_pipeline` to reuse) or None
         if none were prepared."""
-        from ydb_tpu.core.dtypes import DType, Kind as _K
         from ydb_tpu.ops import fused as F
 
         pipe = plan.pipeline
@@ -279,60 +278,8 @@ class Executor:
                     not bt.unique and step.kind in ("inner", "left", "mark")):
                 return builds   # partitioned / expanding probe
 
-        scan_cols = [Column(i, table.schema.dtype(s))
-                     for (s, i) in pipe.scan.columns]
-
-        # one schema walk over the pipeline: collects join metas (incl.
-        # the LUT-vs-bsearch probe choice per build) and lands on the
-        # final schema used for sort setup and output selection
-        dicts = {}
-        join_metas = []
-        bi = 0
-        schema = Schema(list(scan_cols))
-        if pipe.pre_program is not None:
-            schema = ir.infer_schema(pipe.pre_program, schema)
-        for kind, step in pipe.steps:
-            if kind != "join":
-                schema = ir.infer_schema(step, schema)
-                continue
-            bt = builds[bi]
-            bi += 1
-            payload_cols = []
-            for name in bt.schema.names:
-                payload_cols.append(
-                    Column(name, bt.schema.dtype(name).with_nullable(True)))
-                if name in bt.dictionaries:
-                    dicts[name] = bt.dictionaries[name]
-            if step.kind == "mark":
-                payload_cols.append(Column(step.mark_col or "__mark",
-                                           DType(_K.BOOL, False)))
-            join_metas.append({
-                "probe_key": step.probe_key,
-                "kind": step.kind,
-                "src_names": tuple(bt.schema.names),
-                "payload_names": tuple(bt.schema.names),
-                "mark_col": step.mark_col,
-                "not_in": step.not_in,
-                "payload_cols": payload_cols,
-                # sparse key spans have no LUT; float PROBES must not
-                # truncate through an integer LUT — both take the
-                # unrolled binary search in the trace
-                "bsearch": bt.lut is None
-                or schema.dtype(step.probe_key).kind in (_K.FLOAT64,
-                                                         _K.FLOAT32),
-            })
-            schema = F.apply_join_schema(schema, payload_cols)
-        if pipe.partial is not None:
-            schema = ir.infer_schema(pipe.partial, schema)
-        partial_schema = schema            # tile-output schema (pre-final)
-        if plan.final_program is not None:
-            schema = ir.infer_schema(plan.final_program, schema)
-
-        # join-derived group-bound: when every group key is pinned by an
-        # inner/semi join's build side, ngroups ≤ build rows — stamp the
-        # sorted group-by with the proven bound so per-group gathers run
-        # at output cardinality (the q3/q9/q13 late-materialization win)
-        plan, pipe = self._bounded_groupby_rewrite(plan, builds, join_metas)
+        (plan, pipe, scan_cols, schema, partial_schema, dicts,
+         join_metas) = self._fused_plan_setup(plan, builds)
 
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
@@ -365,16 +312,22 @@ class Executor:
             plan, schema, dicts)
         all_params = {**params, **sort_params}
 
+        # lifted LIMIT (paramlift plans only): the clamp rides in as the
+        # __lim2 device input and the program keys on the limit's
+        # capacity bucket — `limit 3` and `limit 5` share one executable
+        lift_limit, lim_key = self._lift_limit_setup(plan, all_params)
+
         builds_sig = tuple(F.build_inputs_sig(bt) for bt in builds)
         key = F.fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names,
                                 builds_sig, sort_spec, rank_assigns,
-                                tuple(sorted(all_params)))
+                                tuple(sorted(all_params)), lim_key=lim_key)
         entry = self._fused_cache.get(key)
         if entry is None:
             fn, layout_box = F.build_fused_fn(
                 pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
                 join_metas, rank_assigns, sort_spec, plan.limit, plan.offset,
-                tuple(dict.fromkeys(n for (n, _lbl) in plan.output)))
+                tuple(dict.fromkeys(n for (n, _lbl) in plan.output)),
+                lift_limit=lift_limit)
             keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
             out_cols = [c for c in schema.columns if c.name in keep] \
                 or list(schema.columns)
@@ -430,6 +383,258 @@ class Executor:
             else:
                 spec.append((sk.name, sk.ascending, sk.nulls_first))
         return sort_params, tuple(spec), rank_assigns
+
+    def _fused_plan_setup(self, plan: QueryPlan, builds: list):
+        """Shared front half of the fused paths (single-query and
+        batched): one schema walk over the pipeline collecting join
+        metas (incl. the LUT-vs-bsearch probe choice per build) and
+        landing on the final schema, plus the join-derived group-bound
+        rewrite. Returns (plan, pipe, scan_cols, schema, partial_schema,
+        dicts, join_metas) — plan/pipe possibly rewritten (copies; a
+        cached plan is never mutated)."""
+        from ydb_tpu.core.dtypes import DType, Kind as _K
+        from ydb_tpu.ops import fused as F
+
+        pipe = plan.pipeline
+        table = self.catalog.table(pipe.scan.table)
+        scan_cols = [Column(i, table.schema.dtype(s))
+                     for (s, i) in pipe.scan.columns]
+
+        dicts = {}
+        join_metas = []
+        bi = 0
+        schema = Schema(list(scan_cols))
+        if pipe.pre_program is not None:
+            schema = ir.infer_schema(pipe.pre_program, schema)
+        for kind, step in pipe.steps:
+            if kind != "join":
+                schema = ir.infer_schema(step, schema)
+                continue
+            bt = builds[bi]
+            bi += 1
+            payload_cols = []
+            for name in bt.schema.names:
+                payload_cols.append(
+                    Column(name, bt.schema.dtype(name).with_nullable(True)))
+                if name in bt.dictionaries:
+                    dicts[name] = bt.dictionaries[name]
+            if step.kind == "mark":
+                payload_cols.append(Column(step.mark_col or "__mark",
+                                           DType(_K.BOOL, False)))
+            join_metas.append({
+                "probe_key": step.probe_key,
+                "kind": step.kind,
+                "src_names": tuple(bt.schema.names),
+                "payload_names": tuple(bt.schema.names),
+                "mark_col": step.mark_col,
+                "not_in": step.not_in,
+                "payload_cols": payload_cols,
+                # sparse key spans have no LUT; float PROBES must not
+                # truncate through an integer LUT — both take the
+                # unrolled binary search in the trace
+                "bsearch": bt.lut is None
+                or schema.dtype(step.probe_key).kind in (_K.FLOAT64,
+                                                         _K.FLOAT32),
+            })
+            schema = F.apply_join_schema(schema, payload_cols)
+        if pipe.partial is not None:
+            schema = ir.infer_schema(pipe.partial, schema)
+        partial_schema = schema            # tile-output schema (pre-final)
+        if plan.final_program is not None:
+            schema = ir.infer_schema(plan.final_program, schema)
+
+        # join-derived group-bound: when every group key is pinned by an
+        # inner/semi join's build side, ngroups ≤ build rows — stamp the
+        # sorted group-by with the proven bound so per-group gathers run
+        # at output cardinality (the q3/q9/q13 late-materialization win)
+        plan, pipe = self._bounded_groupby_rewrite(plan, builds, join_metas)
+        return plan, pipe, scan_cols, schema, partial_schema, dicts, \
+            join_metas
+
+    @staticmethod
+    def _lift_limit_setup(plan: QueryPlan, all_params=None,
+                          force: bool = False):
+        """(lift_limit, lim_key) for a fused compile: lifted plans with a
+        LIMIT pass limit+offset as the __lim2 device input and key the
+        program on its capacity bucket; everything else keeps the baked
+        constants (byte-identical compile key to the pre-lift path).
+
+        `force`: the batched lane ALWAYS lifts a LIMIT — its shape sig
+        groups on the bucket, so members whose only difference is the
+        LIMIT/OFFSET value must still clamp per member (a zero-literal
+        `limit 3` and `limit 5` coalesce; baking the leader's value
+        would hand every member the leader's row count).
+        `all_params`: when given, the leader's __lim2 is injected (the
+        batched lane instead injects per member)."""
+        from ydb_tpu.ops.fused import LIMIT_PARAM
+        if plan.limit is None or not (
+                force or getattr(plan, "lift_names", ())):
+            return False, None
+        lim2 = plan.limit + (plan.offset or 0)
+        if all_params is not None:
+            all_params[LIMIT_PARAM] = np.int32(lim2)
+        return True, ("limB", bucket_capacity(lim2, minimum=128))
+
+    # -- multi-query batched dispatch --------------------------------------
+
+    def execute_fused_batched(self, plan: QueryPlan, members: list,
+                              snapshot: Snapshot):
+        """ONE stacked fused execution for a batch of same-shape queries
+        (the inference-serving lane, `query/batch_lane.py`): the shared
+        scan superblock and join builds broadcast, each member's lifted
+        literals stack along a leading batch axis, and a single vmapped
+        executable (`ops/fused.build_fused_batched_fn`) serves the whole
+        batch — one dispatch + one device→host readout instead of B.
+
+        `plan`: the leader's plan with scan pruning STRIPPED (pruning is
+        literal-dependent and cannot partition a shared execution; the
+        lane already verified every member sees identical source sets).
+        `members`: [(member_plan, member_params)] — same `lift_sig`,
+        verified by the lane. Returns [HostBlock] projected per member,
+        or None when this shape cannot batch (caller falls back to
+        per-member execution)."""
+        from ydb_tpu.ops import fused as F
+        from ydb_tpu.storage.device_cache import (
+            enumerate_scan_sources, estimate_scan_bytes,
+        )
+        from ydb_tpu.utils.metrics import GLOBAL
+
+        pipe = plan.pipeline
+        table = self.catalog.table(pipe.scan.table)
+        join_steps = [step for kind, step in pipe.steps if kind == "join"]
+        if len(join_steps) > self.fuse_max_joins:
+            return None
+        params0 = dict(members[0][1])
+        with self._span("join-builds", n=len(join_steps)):
+            builds = self._prepare_builds(pipe, params0, snapshot)
+        for step, bt in zip(join_steps, builds):
+            if isinstance(bt, J.PartitionedBuild) or (
+                    not bt.unique and step.kind in ("inner", "left",
+                                                    "mark")):
+                return None
+        (plan, pipe, scan_cols, schema, partial_schema, dicts,
+         join_metas) = self._fused_plan_setup(plan, builds)
+
+        storage_names = [s for (s, _i) in pipe.scan.columns]
+        rename = {s: i for (s, i) in pipe.scan.columns}
+        sources, src_ids = enumerate_scan_sources(table, snapshot, None)
+        if not sources or estimate_scan_bytes(sources, storage_names) \
+                > self.fused_scan_budget_bytes:
+            return None                  # empty / tiled-class scan
+        with self._span("superblock-upload"):
+            sb = self.device_cache.superblock(table, storage_names, rename,
+                                              snapshot, None, sources,
+                                              src_ids)
+        if sb is None:
+            return None
+        arrays, valids, lengths, K, CAP, sb_dicts = sb
+        sb_valid_names = frozenset(valids.keys())
+        dicts.update(sb_dicts)
+
+        sort_params, sort_spec, rank_assigns = self._sort_setup_fused(
+            plan, schema, dicts)
+
+        # per-member param dicts (sort params are batch-invariant; a
+        # LIMIT always lifts here — see _lift_limit_setup — so each
+        # member clamps to ITS OWN limit+offset, not the leader's)
+        lift_limit, lim_key = self._lift_limit_setup(plan, force=True)
+        mem_params = []
+        for (mp, prms) in members:
+            p = {**prms, **sort_params}
+            if lift_limit:
+                p[F.LIMIT_PARAM] = np.int32(mp.limit + (mp.offset or 0))
+            mem_params.append(p)
+        names = sorted(mem_params[0])
+        for p in mem_params[1:]:
+            if sorted(p) != names:
+                return None              # shape drift — lane sig was stale
+
+        # stack only the params whose values actually differ across the
+        # batch; batch-invariant ones (rank LUTs, shared pool arrays)
+        # broadcast via in_axes=None instead of B device copies
+        axes, stacked = {}, {}
+        for n in names:
+            vals = [p[n] for p in mem_params]
+            if all(_param_values_equal(vals[0], v) for v in vals[1:]):
+                axes[n] = None
+                stacked[n] = vals[0]
+            else:
+                arrs = [np.asarray(v) for v in vals]
+                if any(a.shape != arrs[0].shape or a.dtype != arrs[0].dtype
+                       for a in arrs[1:]):
+                    # array params whose SHAPES vary with the literal
+                    # (integer IN lists) — Param fingerprints carry no
+                    # shape, so the sig can't split these; decline
+                    return None
+                axes[n] = 0
+                stacked[n] = np.stack(arrs)
+        B = len(members)
+        mapped = tuple(n for n in names if axes[n] == 0)
+        if mapped:
+            Bb = 1
+            while Bb < B:
+                Bb *= 2                  # batch-size buckets: one
+            #                              executable per power-of-two size
+            if Bb > B:
+                pad = Bb - B             # pad by repeating the last member
+                for n in mapped:
+                    stacked[n] = np.concatenate(
+                        [stacked[n]] + [stacked[n][-1:]] * pad)
+            member_rows = list(range(B))
+        else:
+            # every member identical (a same-text storm): one execution,
+            # every member unpacks row 0
+            Bb = 1
+            member_rows = [0] * B
+
+        builds_sig = tuple(F.build_inputs_sig(bt) for bt in builds)
+        base_key = F.fused_cache_key(plan, scan_cols, K, CAP,
+                                     sb_valid_names, builds_sig, sort_spec,
+                                     rank_assigns, tuple(names),
+                                     lim_key=lim_key)
+        key = ("batched", base_key, Bb, mapped)
+        keep = tuple(dict.fromkeys(n for (n, _lbl) in plan.output))
+        cached = self._fused_cache.get(key)
+        if cached is None:
+            fn, layout_box = F.build_fused_batched_fn(
+                pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
+                join_metas, rank_assigns, sort_spec, plan.limit,
+                plan.offset, keep, dict(axes), Bb, lift_limit=lift_limit)
+            out_cols = [c for c in schema.columns if c.name in keep] \
+                or list(schema.columns)
+            out_schema = Schema(out_cols)
+        else:
+            fn, layout_box, out_schema = cached
+
+        dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray)
+                          else v) for k, v in stacked.items()}
+        build_inputs = [F.build_traced_inputs(bt) for bt in builds]
+        try:
+            with self._span("device-dispatch-batched", k=K, cap=CAP, b=Bb):
+                data_stacks, valid_stack, length = fn(
+                    arrays, valids, lengths, build_inputs, dev_params)
+        except Exception:                # noqa: BLE001 — lane, not law
+            # a shape the vmapped trace can't batch (or a compile-side
+            # failure): fall back to per-member execution rather than
+            # failing B clients on an optimization
+            GLOBAL.inc("batch/trace_errors")
+            return None
+        if cached is None:
+            # cache only after the first successful dispatch, so a
+            # trace-failing shape never parks a dead entry in the budget
+            self._fused_cache[key] = (fn, layout_box, out_schema)
+
+        out_dicts = {n2: d for n2, d in dicts.items() if out_schema.has(n2)}
+        out_dicts.update({n2: d for n2, d in plan.result_dicts.items()
+                          if out_schema.has(n2)})
+        blocks = F.fetch_fused_batch(data_stacks, valid_stack, length,
+                                     layout_box, out_schema, out_dicts,
+                                     member_rows)
+        out = []
+        for (mp, _prms), blk in zip(members, blocks):
+            blk = _apply_offset(blk, mp.offset or 0, mp.limit)
+            out.append(self._project_output(blk, mp.output))
+        return out
 
     def _bounded_groupby_rewrite(self, plan: QueryPlan, builds: list,
                                  join_metas: list):
@@ -1509,6 +1714,16 @@ class Executor:
             cols[lbl] = ColumnData(cd.data, cd.valid, cd.dictionary)
             schema_cols.append(Column(lbl, block.schema.dtype(internal)))
         return HostBlock(Schema(schema_cols), cols, block.length)
+
+
+def _param_values_equal(a, b) -> bool:
+    """Batch-invariance test for one runtime param across two members
+    (arrays compare by dtype/shape/contents; scalars by type + value)."""
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        return (isinstance(a, np.ndarray) and isinstance(b, np.ndarray)
+                and a.dtype == b.dtype and a.shape == b.shape
+                and np.array_equal(a, b))
+    return type(a) is type(b) and bool(a == b)
 
 
 def _apply_offset(block: HostBlock, lo: int, limit) -> HostBlock:

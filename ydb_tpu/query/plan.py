@@ -81,6 +81,16 @@ class QueryPlan:
     # schema-declaration order of every FROM relation's columns
     # ("alias.col" internal names) — SELECT * output order
     star_order: list = field(default_factory=list)
+    # parameter lifting (query/paramlift.py): canonical `__litN` params
+    # whose values were extracted from this plan's literals — programs
+    # fingerprint on SHAPE, one compiled executable serves every literal
+    # variant. `lift_sig`: prune-stripped shape identity the batched
+    # dispatch lane groups same-shape arrivals by (build-affecting param
+    # VALUES are rederived from the programs per member —
+    # `paramlift.build_lift_values`); None = not lifted (lane
+    # ineligible).
+    lift_names: tuple = ()
+    lift_sig: Optional[tuple] = None
 
 
 def explain(plan: QueryPlan, indent: int = 0) -> str:

@@ -183,8 +183,12 @@ class Planner:
     # -- entry -------------------------------------------------------------
 
     def plan_select(self, sel: ast.Select) -> QueryPlan:
+        # literal lifting runs AFTER planning (paramlift.py): pruning,
+        # selectivity, and dictionary folding all saw concrete values;
+        # only the compiled artifact becomes value-free
+        from ydb_tpu.query.paramlift import lift_plan
         with self._mu:
-            return self._plan_select_locked(sel)
+            return lift_plan(self._plan_select_locked(sel))
 
     def plan_dq(self, sel: ast.Select, topology):
         """Lower a SELECT to a DQ stage graph (`ydb_tpu/dq/graph.py`) —

@@ -106,6 +106,11 @@ class QueryStats:
     # `xla_exec.groupby_trace_delta` window for this statement) —
     # non-empty only when it compiled a fresh group-by shape
     groupby: dict = field(default_factory=dict)
+    # batched dispatch lane (`query/batch_lane.py`): how this statement
+    # rode a coalesced batch — {"coalesced": B, "leader": bool,
+    # "batched": bool} (batched=False → the lane fell back to per-member
+    # execution); empty when the lane is off or the shape was ineligible
+    batching: dict = field(default_factory=dict)
 
     def render(self) -> str:
         path = ("mesh-distributed" if self.distributed
@@ -125,6 +130,12 @@ class QueryStats:
                     f"sort rows max {g.get('sort_rows_max', 0)} | "
                     f"value gather rows max "
                     f"{g.get('value_gather_rows_max', 0)}")
+        if self.batching:
+            b = self.batching
+            out += (f"\n-- batching: coalesced {b.get('coalesced', 0)} "
+                    f"queries | leader "
+                    f"{str(b.get('leader', False)).lower()} | "
+                    f"{'stacked dispatch' if b.get('batched') else 'per-member fallback'}")
         return out
 
 

@@ -196,14 +196,35 @@ def child_main(sf: float, progress_path: str, skip: list,
             t0 = time.perf_counter()
             got = eng.query(sql)                 # compile + first run
             times = [time.perf_counter() - t0]
+            # first-run phase breakdown carries the compile cost;
+            # steady-state phases come from the last repeat below
+            ph_first = dict(getattr(eng.last_stats, "phases", {}) or {})
             for _ in range(repeats):
                 t0 = time.perf_counter()
                 got = eng.query(sql)
                 times.append(time.perf_counter() - t0)
             best = min(times)
+            phases = dict(getattr(eng.last_stats, "phases", {}) or {})
+            # repeats=0 (the capped fallback legs): the only run taken
+            # IS the first run, so its phases carry compile time
+            first_only = repeats == 0
             rec = {"kind": "result", "query": name,
                    "ms": round(best * 1000, 1),
                    "path": eng.executor.last_path, **extra}
+            if phases:
+                # per-phase attribution (compile/upload/dispatch/device/
+                # readout) so a regressed round is blamed on a PHASE,
+                # not a bare wall number
+                rec["phases"] = {k: round(v, 1)
+                                 for k, v in phases.items()}
+                if first_only:
+                    # these are FIRST-run (compile-bearing) numbers —
+                    # tag them so the steady-state aggregate excludes
+                    # them instead of misattributing compile to a phase
+                    rec["phases_include_compile"] = True
+            if ph_first.get("compile_ms"):
+                rec["compile_ms_first"] = round(
+                    ph_first["compile_ms"], 1)
             if gated(name):
                 d = oracle_data()    # lazy gen OUTSIDE the timed window
                 t0 = time.perf_counter()
@@ -542,7 +563,33 @@ def run_suite(sf: float, suite_deadline: float,
         "vs_pandas": ratios,
         "vs_pandas_geomean": round(geomean(list(ratios.values())), 1)
         if ratios else None,
+        # device-timeline attribution (the round-10 profiling floor):
+        # steady-state per-phase ms per query + per-phase geomean, so a
+        # regressed round is blamed on compile/upload/dispatch/device/
+        # readout instead of a bare wall number
+        "per_query_phases": {q: r["phases"] for q, r in results.items()
+                             if r.get("phases")},
+        # steady-state aggregate only: rows tagged phases_include_compile
+        # (repeats=0 fallback legs) would fold compile into a phase
+        "phase_geomean_ms": _phase_geomean(
+            [r["phases"] for r in results.values()
+             if r.get("phases") and not r.get("phases_include_compile")]),
+        "compile_ms_first": {q: r["compile_ms_first"]
+                             for q, r in results.items()
+                             if r.get("compile_ms_first")},
     }
+
+
+def _phase_geomean(phase_dicts: list) -> dict:
+    """Per-phase geomean across the suite's queries (zeros skipped: a
+    phase a query never entered must not zero the aggregate)."""
+    out = {}
+    for key in ("compile_ms", "build_ms", "upload_ms", "dispatch_ms",
+                "device_ms", "readout_ms"):
+        vals = [d[key] for d in phase_dicts if d.get(key)]
+        if vals:
+            out[key] = round(geomean(vals), 2)
+    return out
 
 
 _WEDGED = {"v": False}

@@ -69,6 +69,17 @@ if [ "$brc" -ne 0 ]; then
     exit "$brc"
 fi
 
+echo "== cross-worker trace gate (one assembled tree; retries visible) =="
+# deterministic profile-subsystem gate: a 2-worker DQ join yields exactly
+# ONE assembled trace with task spans from both workers and nonzero
+# channel bytes, and a retried stage shows both task attempts in the tree
+JAX_PLATFORMS=cpu python scripts/trace_gate.py
+trc=$?
+if [ "$trc" -ne 0 ]; then
+    echo "trace gate FAILED (rc=$trc)" >&2
+    exit "$trc"
+fi
+
 echo "== DQ two-worker smoke (scan→join→agg over hash-shuffle edges) =="
 # two real OS worker processes; gates on result correctness AND the
 # dq/* counters being non-zero on router + workers (a refactor that

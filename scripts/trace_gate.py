@@ -1,0 +1,134 @@
+"""CI gate for the cross-worker query-profile subsystem.
+
+Two deterministic legs over an in-process 2-worker DQ cluster
+(`dq/runner.LocalWorker` — the same task/channel code path the gRPC
+cluster runs, minus the wire):
+
+  1. a sharded×sharded shuffle join must assemble EXACTLY ONE trace:
+     every span carries one trace_id, worker-recorded task spans
+     (task-exec under dq-task) are present for BOTH workers, and the
+     stage stats carry nonzero channel bytes;
+  2. a stage retried through the runner's kill path (a worker that
+     fails its first attempt, `tests/test_dq.py`'s flaky shape) must
+     show BOTH task attempts in the tree — attempt 1 failed, attempt 2
+     finished — for the same task id.
+
+Prints one JSON line; exit 0 = green.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def mk_cluster(flaky_first: bool = False):
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.dq.runner import LocalWorker
+    from ydb_tpu.query import QueryEngine
+
+    engines = []
+    for wid in range(2):
+        e = QueryEngine(block_rows=1 << 13)
+        e.execute("create table t (id Int64 not null, k Int64 not null, "
+                  "v Double not null, primary key (id))")
+        mine = [i for i in range(200) if i % 2 == wid]
+        e.execute("insert into t (id, k, v) values " + ", ".join(
+            f"({i}, {i % 7}, {i}.5)" for i in mine))
+        e.execute("create table u (uid Int64 not null, w Double not null, "
+                  "primary key (uid))")
+        mine_u = [i for i in range(7) if i % 2 == wid]
+        if mine_u:
+            e.execute("insert into u (uid, w) values " + ", ".join(
+                f"({i}, {i}.0)" for i in mine_u))
+        engines.append(e)
+
+    class _FlakyWorker(LocalWorker):
+        """Fails its first dq_run_task (after which the runner's
+        stage-level retry re-runs every task of the stage)."""
+
+        def __init__(self, engine, name):
+            super().__init__(engine, name=name)
+            self.fail_times = 1
+
+        def dq_run_task(self, **kw):
+            if self.fail_times > 0 and kw.get("outputs"):
+                self.fail_times -= 1
+                raise RuntimeError("injected task failure (trace gate)")
+            return super().dq_run_task(**kw)
+
+    cls0 = _FlakyWorker if flaky_first else LocalWorker
+    workers = [cls0(engines[0], name="w0"),
+               LocalWorker(engines[1], name="w1")]
+    c = ShardedCluster(workers, merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    return c, engines
+
+
+def leg_join() -> dict:
+    c, engines = mk_cluster()
+    got = c.query("select count(*) as n, sum(w) as s from t, u "
+                  "where k = uid")
+    eng = engines[0]
+    spans = eng.last_trace
+    trace_ids = {s.trace_id for s in spans}
+    by_id = {s.span_id: s for s in spans}
+    exec_workers = set()
+    for s in spans:
+        if s.name == "task-exec":
+            parent = by_id.get(s.parent_id)
+            if parent is not None:
+                exec_workers.add(parent.attrs.get("worker"))
+    stats = list(eng.dq_stage_stats)
+    channel_rows = sum(r["rows"] for r in stats
+                      if r["worker"] != "router")
+    prof = eng.profiles[-1] if eng.profiles else {}
+    return {
+        "result_ok": int(got.n[0]) > 0,
+        "one_trace": len(trace_ids) == 1 and 0 not in trace_ids,
+        "both_workers_spanned":
+            exec_workers >= {"local:w0", "local:w1"},
+        "channel_bytes_nonzero":
+            sum(r["bytes"] for r in stats) > 0 and channel_rows > 0,
+        "stage_stats_rows": len(stats) > 0,
+        "profile_recorded": bool(prof.get("stages")),
+    }
+
+
+def leg_retry() -> dict:
+    c, engines = mk_cluster(flaky_first=True)
+    got = c.query("select count(*) as n, sum(w) as s from t, u "
+                  "where k = uid")
+    eng = engines[0]
+    spans = eng.last_trace
+    attempts: dict = {}
+    for s in spans:
+        if s.name == "dq-task":
+            attempts.setdefault(s.attrs.get("task"), []).append(
+                (s.attrs.get("attempt"), s.attrs.get("state")))
+    retried = [(t, a) for t, a in attempts.items() if len(a) > 1]
+    both_visible = any(
+        {st for (_n, st) in a} >= {"failed", "finished"}
+        for (_t, a) in retried)
+    return {
+        "result_ok": int(got.n[0]) > 0,
+        "one_trace": len({s.trace_id for s in spans}) == 1,
+        "retried_task_present": bool(retried),
+        "both_attempts_in_tree": both_visible,
+    }
+
+
+def main() -> int:
+    join = leg_join()
+    retry = leg_retry()
+    ok = all(join.values()) and all(retry.values())
+    print(json.dumps({"metric": "trace_gate", "ok": ok,
+                      "join": join, "retry": retry}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,60 @@ def test_counters_snapshot():
     assert "device_cache/hits" in c and "program_cache/misses" in c
 
 
+def test_tracer_exception_safety_statement():
+    """A statement that RAISES must leave the tracer clean: the next
+    statement's spans must parent under its own fresh root, not under
+    the failed statement's stale stack (the pre-round-10 leak)."""
+    e = mk()
+    try:
+        e.query("select no_such_column from t")
+    except Exception:
+        pass
+    assert e.tracer._stack == []            # nothing left open
+    e.query("select count(*) as n from t")
+    spans = e.last_trace
+    root = spans[0]
+    assert root.name == "statement" and root.parent_id is None
+    ids = {s.span_id for s in spans}
+    assert all(s.trace_id == root.trace_id for s in spans)
+    assert all(s.parent_id in ids for s in spans[1:])
+
+
+def test_tracer_force_closes_leaked_spans():
+    """A code path that enters a span ctx and raises past __exit__ (or
+    never exits) must still be closed by end_trace, with the
+    thread-local stack popped for the next trace."""
+    from ydb_tpu.utils.tracing import Tracer
+    t = Tracer()
+    t.begin_trace()
+    ctx = t.span("leaky")
+    sp = ctx.__enter__()                    # never exited
+    inner = t.span("inner-leak")
+    inner.__enter__()
+    out = t.end_trace()
+    assert t._stack == []
+    assert all(s.dur_ms > 0 for s in out)   # stamped, not 0.0
+    # the next trace starts clean: fresh id, roots parent to None
+    t.begin_trace()
+    with t.span("fresh") as f:
+        pass
+    out2 = t.end_trace()
+    assert out2[0].parent_id is None
+    assert out2[0].trace_id != sp.trace_id
+
+
+def test_tracer_exit_is_order_robust():
+    """__exit__ of an outer span removes itself even when an inner span
+    leaked open above it on the stack."""
+    from ydb_tpu.utils.tracing import Tracer
+    t = Tracer()
+    t.begin_trace()
+    with t.span("outer"):
+        t.span("leaked").__enter__()        # stays open
+    assert [s.name for s in t._stack] == []  # outer popped leaked too
+    t.end_trace()
+
+
 def test_background_compaction_bounds_portions():
     e = QueryEngine(block_rows=1 << 13)
     e.execute("""create table t (id Int64 not null, primary key (id))

@@ -178,13 +178,21 @@ class ExchangeBuffer:
 
     def take(self, channel: str) -> pd.DataFrame:
         """Drain and concatenate every frame of a channel."""
+        df, _nb = self.take2(channel)
+        return df
+
+    def take2(self, channel: str):
+        """`take` plus the drained byte count — the consumer-side channel
+        stat (`dq_input_channel` bytes) the profile subsystem records."""
         with self._mu:
             frames = self._frames.pop(channel, [])
             self._seen.pop(channel, None)
-            self.bytes -= sum(nb for (_f, nb) in frames)
+            nbytes = sum(nb for (_f, nb) in frames)
+            self.bytes -= nbytes
         if not frames:
-            return pd.DataFrame()
-        return pd.concat([f for (f, _nb) in frames], ignore_index=True)
+            return pd.DataFrame(), 0
+        return (pd.concat([f for (f, _nb) in frames], ignore_index=True),
+                nbytes)
 
     def drop(self, channel: str) -> None:
         with self._mu:
@@ -206,7 +214,7 @@ class ChannelWriter:
     def __init__(self, channel: str, src: str, send, n_peers: int,
                  token: str = "", frame_rows: int = None,
                  inflight_bytes: int = None, retries: int = 2,
-                 counters=None):
+                 counters=None, trace=None):
         import itertools
         import os
         import threading
@@ -221,11 +229,19 @@ class ChannelWriter:
             "YDB_TPU_DQ_INFLIGHT_BYTES", 32 << 20))
         self.retries = retries
         self._counters = counters
+        # trace context carried in every frame header ({trace_id,
+        # parent_span_id} — utils/tracing): a consumer-side debugger can
+        # attribute any landed frame back to its query's span tree
+        self._trace = {k: trace[k] for k in ("trace_id", "parent_span_id")
+                       if trace and trace.get(k) is not None} \
+            if trace else {}
         self._seq = itertools.count()
         self._inflight = 0
         self.peak_inflight = 0
         self.bytes_sent = 0
         self.frames_sent = 0
+        self.rows_sent = 0
+        self.wait_ms = 0.0               # backpressure: flow-control stalls
         self._cv = threading.Condition()
         self._pool = ThreadPoolExecutor(
             max_workers=min(8, max(2, n_peers)))
@@ -242,22 +258,35 @@ class ChannelWriter:
             seq = next(self._seq)
             frame = pack_frame({"channel": self.channel, "part": peer,
                                 "src": self.src, "seq": seq,
-                                "token": self.token}, chunk)
+                                "token": self.token, **self._trace}, chunk)
             self._acquire(len(frame))
             self._futures.append(
                 self._pool.submit(self._send_one, peer, frame))
+            self.rows_sent += len(chunk)
             lo += self.frame_rows
             if lo >= nrows:
                 break
 
     def _acquire(self, nbytes: int) -> None:
+        import time
         with self._cv:
             # a frame larger than the whole budget still passes alone
-            while self._inflight and \
+            if self._inflight and \
                     self._inflight + nbytes > self.inflight_budget:
-                self._cv.wait()
+                t0 = time.perf_counter()
+                while self._inflight and \
+                        self._inflight + nbytes > self.inflight_budget:
+                    self._cv.wait()
+                self.wait_ms += (time.perf_counter() - t0) * 1000.0
             self._inflight += nbytes
             self.peak_inflight = max(self.peak_inflight, self._inflight)
+
+    def stats(self) -> dict:
+        """Per-channel producer stats (the dq_output_channel stats view):
+        what run_task ships back for the cross-worker profile."""
+        return {"channel": self.channel, "frames": self.frames_sent,
+                "rows": self.rows_sent, "bytes": self.bytes_sent,
+                "backpressure_wait_ms": round(self.wait_ms, 3)}
 
     def _send_one(self, peer: int, frame: bytes) -> None:
         import time

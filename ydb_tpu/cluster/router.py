@@ -244,15 +244,102 @@ class ShardedCluster:
     def query(self, sql: str) -> pd.DataFrame:
         """Distribute one SELECT: lower to a StageGraph, execute it with
         the task runner (one task per (stage, worker), channels between
-        stages), merge router-side."""
-        from ydb_tpu.dq.runner import DqError, DqTaskRunner
+        stages), merge router-side. The whole graph runs under ONE trace
+        on the merge engine's tracer — worker task spans propagate back
+        over the DqRunTask RPCs and assemble into a single cross-worker
+        span tree (`engine.last_trace`, `.sys/query_profiles`).
+
+        `EXPLAIN ANALYZE <select>` returns the distributed profile: the
+        stage graph, per-(stage, worker) task stats (rows/bytes/frames/
+        waits) and the assembled span tree, as a one-column frame."""
         stmt = parse(sql)
+        if isinstance(stmt, ast.Explain):
+            if not isinstance(stmt.query, ast.Select):
+                raise ClusterError("EXPLAIN distributes SELECT only")
+            return self._explain(stmt)
         if not isinstance(stmt, ast.Select):
             raise ClusterError("the router distributes SELECT; use "
                                "execute() for DDL/DML")
-        graph = self._lower(stmt)
+        df, _runner = self._run_traced(stmt, sql)
+        return df
+
+    def _run_traced(self, stmt: ast.Select, sql: str,
+                    force_trace: bool = False, graph=None):
+        import time as _time
+
+        from ydb_tpu.dq.runner import DqError, DqTaskRunner
+        from ydb_tpu.utils.metrics import GLOBAL_HIST
+        if graph is None:
+            graph = self._lower(stmt)
         runner = DqTaskRunner(self.workers, self.engine)
+        eng = self.engine
+        sampled = force_trace or eng._sample_decision(sql)
+        eng.tracer.begin_trace(sampled=sampled)
+        t0 = _time.perf_counter()
+        rows_out = None
         try:
-            return runner.run(graph)
+            with eng.tracer.span("dq-query", sql=sql[:60],
+                                 workers=len(self.workers),
+                                 stages=len(graph.stages)):
+                df = runner.run(graph)
+            rows_out = len(df)
+            return df, runner
         except DqError as e:
             raise ClusterError(str(e)) from e
+        finally:
+            total_ms = (_time.perf_counter() - t0) * 1000.0
+            if rows_out is not None:
+                # successes only — the local path records latency in
+                # _finish_stats, which a failed statement never reaches;
+                # a timed-out DQ run would otherwise inject a 600 s
+                # timeout artifact into p99/max
+                GLOBAL_HIST.observe("query/latency_ms", total_ms)
+                eng._note_slow(sql, total_ms, "dq-select")
+            spans = eng.tracer.end_trace()
+            if spans:
+                eng.last_trace = spans
+                # the DQ wall/rows pass explicitly: last_stats only
+                # covers the router-merge statement (or a previous one)
+                eng._record_profile(
+                    sql, spans, stage_stats=runner.stage_stats,
+                    total_ms=round(total_ms, 3),
+                    rows_out=rows_out or 0,
+                    # a failed run must not masquerade as a successful
+                    # empty-result query (the local path marks these
+                    # "error" the same way)
+                    kind="dq-select" if rows_out is not None
+                    else "dq-error")
+
+    def _explain(self, stmt: ast.Explain) -> pd.DataFrame:
+        """Distributed EXPLAIN [ANALYZE]: the stage graph, and with
+        ANALYZE the per-stage/per-channel profile of an actual run."""
+        graph = self._lower(stmt.query)
+        lines = [f"DQ stage graph: {len(graph.stages)} stages, "
+                 f"{len(graph.channels)} channels, "
+                 f"{len(self.workers)} workers"]
+        for stage in graph.stages:
+            lines.append(f"  stage {stage.id} on={stage.on} "
+                         f"in={list(stage.inputs)} "
+                         f"out={list(stage.outputs)}")
+        if not stmt.analyze:
+            return pd.DataFrame({"plan": lines})
+        # run the SAME lowered graph the listing above describes —
+        # re-lowering could diverge from the plan this output claims
+        # to profile (and pays the lowering twice)
+        df, runner = self._run_traced(stmt.query,
+                                      render.select(stmt.query),
+                                      force_trace=True, graph=graph)
+        lines.append(f"-- rows out: {len(df)}")
+        lines.append("-- stage stats (per task):")
+        for r in runner.stage_stats:
+            lines.append(
+                f"  {r['stage']}@{r['worker']}: rows {r['rows']} | "
+                f"bytes {r['bytes']} | frames {r['frames']} | "
+                f"exec {r['exec_ms']:.1f}ms | flush {r['flush_ms']:.1f}ms"
+                f" | input-wait {r['input_wait_ms']:.1f}ms | "
+                f"backpressure {r['backpressure_wait_ms']:.1f}ms | "
+                f"attempts {r['attempts']}")
+        tr = self.engine.tracer.render(self.engine.last_trace)
+        if tr:
+            lines += ["-- trace:"] + tr.split("\n")
+        return pd.DataFrame({"plan": lines})

@@ -6,10 +6,11 @@ tracked through a pending → running → finished/failed state machine.
 Channel failures retry at STAGE granularity: the stage's output channels
 are dropped everywhere reachable and every task re-runs with the SAME
 frame src — stage programs are deterministic, so a timed-out first
-attempt that is still running ships byte-identical (src, seq) frames and
-the receiver's dedup absorbs whichever attempt lands second (a worker
-that stays dead turns into a clean error naming it — never a hang,
-never a torn result).
+attempt that is still running ships frames with identical (src, seq)
+identities AND identical payloads (headers may differ: they carry the
+attempt's trace span), and the receiver's (src, seq)-keyed dedup absorbs
+whichever attempt lands second (a worker that stays dead turns into a
+clean error naming it — never a hang, never a torn result).
 
 `LocalWorker` adapts an in-process `QueryEngine` to the same worker
 surface the gRPC `server.Client` exposes, so a 1-worker graph is the
@@ -44,9 +45,37 @@ class DqTaskRunner:
         self.rpc_timeout = rpc_timeout if rpc_timeout is not None else \
             float(os.environ.get("YDB_TPU_DQ_RPC_TIMEOUT", 600.0))
         self.task_log: list = []             # observability + tests
+        # per-(stage, worker) execution stats for THIS graph run — one
+        # row per task attempt set, pushed into the engine's
+        # `dq_stage_stats` ring (`.sys/dq_stage_stats`) after the run
+        self.stage_stats: list = []
+        self._input_waits: dict = {}         # (stage id, widx) -> ms
         for w in self.workers:
             if hasattr(w, "bind_peers"):
                 w.bind_peers(self.workers)
+
+    # -- tracing helpers ----------------------------------------------------
+
+    @property
+    def tracer(self):
+        return getattr(self.engine, "tracer", None)
+
+    def _span(self, name: str, **attrs):
+        from contextlib import nullcontext
+        t = self.tracer
+        return t.span(name, **attrs) if t is not None else nullcontext()
+
+    @staticmethod
+    def _trace_ctx(base_ctx, parent_span) -> dict:
+        """Propagation context for a task RPC: the router trace's id,
+        the task span to parent under, and the sampling bit. `base_ctx`
+        MUST be captured on the trace-owning thread (`tracer.current()`
+        is thread-local) — task RPCs fire from pool threads."""
+        if base_ctx is None:
+            return None
+        if parent_span is not None:
+            return dict(base_ctx, parent_span_id=parent_span.span_id)
+        return dict(base_ctx)
 
     # -- public -------------------------------------------------------------
 
@@ -62,6 +91,9 @@ class DqTaskRunner:
             raise DqError("stage graph ended without a router stage")
         finally:
             self._cleanup(graph)
+            ring = getattr(self.engine, "dq_stage_stats", None)
+            if ring is not None:
+                ring.extend(self.stage_stats)
 
     # -- worker stages ------------------------------------------------------
 
@@ -71,51 +103,115 @@ class DqTaskRunner:
         return list(enumerate(self.workers))
 
     def _run_worker_stage(self, graph, stage) -> None:
-        from concurrent.futures import ThreadPoolExecutor
+        from ydb_tpu.utils.metrics import GLOBAL_HIST
         self.counters.inc("dq/stages")
+        t_stage = time.perf_counter()
         tws = self._task_workers(stage)
-        self._materialize_inputs(graph, stage)
-        specs = []
-        for cid in stage.outputs:
-            ch = graph.channels[cid]
-            specs.append({"channel": ch.id, "kind": ch.kind,
-                          "key": ch.key, "n_peers": len(self.workers),
-                          "peers": [w.endpoint for w in self.workers]})
-        tasks = {i: {"task": f"{graph.tag}.{stage.id}.w{i}",
-                     "stage": stage.id, "worker": w.endpoint,
-                     "state": "pending", "attempts": 0}
-                 for (i, w) in tws}
-        self.task_log.extend(tasks.values())
+        with self._span("dq-stage", stage=stage.id, tasks=len(tws)):
+            self._materialize_inputs(graph, stage)
+            specs = []
+            for cid in stage.outputs:
+                ch = graph.channels[cid]
+                specs.append({"channel": ch.id, "kind": ch.kind,
+                              "key": ch.key, "n_peers": len(self.workers),
+                              "peers": [w.endpoint for w in self.workers]})
+            tasks = {i: {"task": f"{graph.tag}.{stage.id}.w{i}",
+                         "stage": stage.id, "worker": w.endpoint,
+                         "state": "pending", "attempts": 0}
+                     for (i, w) in tws}
+            self.task_log.extend(tasks.values())
+            results = self._run_stage_attempts(graph, stage, tws, tasks,
+                                               specs)
+        # success-only, matching the router stage and query/latency_ms:
+        # a timed-out stage would inject an rpc-timeout artifact
+        GLOBAL_HIST.observe("dq/stage_ms",
+                            (time.perf_counter() - t_stage) * 1000.0)
 
+        for (i, resp, _e) in results:
+            for cid in stage.outputs:
+                ch = graph.channels[cid]
+                self._dtypes.setdefault(cid, {}).update(
+                    resp.get("dtypes") or {})
+                if ch.router_bound:
+                    frame = self._collected_frame(resp)
+                    if frame is not None:
+                        self._collected.setdefault(cid, {})[i] = frame
+            self.counters.inc("dq/channel_bytes",
+                              resp.get("bytes_shipped", 0))
+            self.counters.inc("dq/frames", resp.get("frames_shipped", 0))
+            self._note_task_stats(graph, stage, tasks[i], resp, i)
+
+    def _run_stage_attempts(self, graph, stage, tws, tasks, specs):
+        """The pending → running → finished/failed attempt loop. Every
+        ATTEMPT of every task gets its own span in the router's tree
+        (`attach_span` — the span object lives on the trace-owning
+        thread, pool threads stamp duration/outcome), and a finishing
+        task's worker-recorded spans ingest under its attempt span."""
+        from concurrent.futures import ThreadPoolExecutor
+        tracer = self.tracer
+        # propagation context captured HERE, on the trace-owning thread
+        # (the pool threads below have no thread-local trace open)
+        base_ctx = tracer.current() if tracer is not None else None
         for attempt in range(self.stage_retries + 1):
+            task_spans = {}
+            if tracer is not None:
+                for (i, w) in tws:
+                    task_spans[i] = tracer.attach_span(
+                        "dq-task", task=tasks[i]["task"],
+                        worker=w.endpoint, attempt=attempt + 1)
+
             def one(iw):
                 i, w = iw
                 t = tasks[i]
                 t["state"], t["attempts"] = "running", attempt + 1
                 self.counters.inc("dq/tasks")
+                sp = task_spans.get(i)
+                t0 = time.perf_counter()
                 try:
                     # src is attempt-INDEPENDENT on purpose: the stage
                     # program is deterministic (same inputs, same frame
                     # boundaries, same seq order), so a timed-out first
                     # attempt still running concurrently with the retry
-                    # ships byte-identical (src, seq) frames — the
-                    # receiver dedups them instead of double-landing rows
+                    # ships frames with the same (src, seq) identities
+                    # and payloads (headers differ — per-attempt trace
+                    # span) — the receiver's (src, seq)-keyed dedup
+                    # drops them instead of double-landing rows
                     resp = w.dq_run_task(
                         task_id=t["task"], stage=stage.id, sql=stage.sql,
                         outputs=specs, src=t["task"],
-                        timeout=self.rpc_timeout)
+                        timeout=self.rpc_timeout,
+                        trace=self._trace_ctx(base_ctx, sp))
                     t["state"] = "finished"
+                    if sp is not None:
+                        sp.dur_ms = (time.perf_counter() - t0) * 1000.0
+                        sp.attrs["state"] = "finished"
                     return (i, resp, None)
                 except Exception as e:       # noqa: BLE001 — per-task
                     t["state"] = "failed"
                     t["error"] = f"{type(e).__name__}: {e}"
+                    if sp is not None:
+                        sp.dur_ms = (time.perf_counter() - t0) * 1000.0
+                        sp.attrs["state"] = "failed"
+                        sp.attrs["error"] = f"{type(e).__name__}"
                     return (i, None, e)
 
             with ThreadPoolExecutor(max_workers=len(tws)) as pool:
                 results = list(pool.map(one, tws))
+            if tracer is not None:
+                # worker-recorded spans join the tree under their
+                # attempt's task span (ids collide-free: span ids are
+                # pid-salted) — the assembled cross-worker profile
+                for (i, resp, _e) in results:
+                    spans = ((resp or {}).get("profile") or {}) \
+                        .get("spans")
+                    if spans:
+                        sp = task_spans.get(i)
+                        tracer.ingest(
+                            spans, parent_id=sp.span_id
+                            if sp is not None else None)
             failed = [(i, e) for (i, _r, e) in results if e is not None]
             if not failed:
-                break
+                return results
             # stage-level retry: drop the half-delivered output channels
             # everywhere reachable, then re-run every task of the stage
             # under a new attempt id
@@ -130,25 +226,49 @@ class DqTaskRunner:
             raise DqError(
                 f"stage {stage.id} failed after "
                 f"{self.stage_retries + 1} attempt(s) on: {names}")
+        raise AssertionError("unreachable: the attempt loop returns on "
+                             "success or raises on exhausted retries")
 
-        for (i, resp, _e) in results:
-            for cid in stage.outputs:
-                ch = graph.channels[cid]
-                self._dtypes.setdefault(cid, {}).update(
-                    resp.get("dtypes") or {})
-                if ch.router_bound:
-                    frame = self._collected_frame(resp)
-                    if frame is not None:
-                        self._collected.setdefault(cid, {})[i] = frame
-            self.counters.inc("dq/channel_bytes",
-                              resp.get("bytes_shipped", 0))
-            self.counters.inc("dq/frames", resp.get("frames_shipped", 0))
+    def _stage_row(self, graph, stage, worker: str, state: str,
+                   attempts: int, **stats) -> dict:
+        """The `.sys/dq_stage_stats` row shape — ONE literal for worker
+        tasks and the router stage (sysview.py mirrors these keys)."""
+        ctx = self.tracer.current() if self.tracer is not None else None
+        row = {"trace_id": (ctx or {}).get("trace_id", 0) or 0,
+               "graph": graph.tag, "stage": stage.id, "worker": worker,
+               "state": state, "attempts": int(attempts),
+               "rows": 0, "bytes": 0, "frames": 0,
+               "exec_ms": 0.0, "flush_ms": 0.0,
+               "input_wait_ms": 0.0, "backpressure_wait_ms": 0.0}
+        row.update(stats)
+        return row
+
+    def _note_task_stats(self, graph, stage, task, resp, widx) -> None:
+        """One `.sys/dq_stage_stats` row per finished task."""
+        prof = resp.get("profile") or {}
+        chans = prof.get("channels") or []
+        self.stage_stats.append(self._stage_row(
+            graph, stage, task["worker"], task["state"],
+            task["attempts"],
+            rows=int(resp.get("rows_in", 0)),
+            bytes=int(resp.get("bytes_shipped", 0)),
+            frames=int(resp.get("frames_shipped", 0)),
+            exec_ms=float(prof.get("exec_ms", 0.0)),
+            flush_ms=float(prof.get("flush_ms", 0.0)),
+            input_wait_ms=float(
+                self._input_waits.get((stage.id, widx), 0.0)),
+            backpressure_wait_ms=float(
+                sum(c.get("backpressure_wait_ms", 0.0) for c in chans))))
 
     def _materialize_inputs(self, graph, stage) -> None:
         """Stage barrier, consumer side: every producer task finished (the
         runner only reaches this stage afterwards), so drain each input
-        channel into its typed transient table on every task worker."""
+        channel into its typed transient table on every task worker.
+        Each open's {rows, bytes, wait_ms} reply becomes an `input-wait`
+        span and accrues into the consuming task's stage-stats row."""
         from concurrent.futures import ThreadPoolExecutor
+
+        from ydb_tpu.utils.metrics import GLOBAL_HIST
         for cid in stage.inputs:
             ch = graph.channels[cid]
             dtypes = self._dtypes.get(cid, {})
@@ -156,11 +276,12 @@ class DqTaskRunner:
             tws = self._task_workers(stage)
 
             def open_one(iw, _ch=ch, _cols=cols):
-                _i, w = iw
+                i, w = iw
                 try:
-                    return w.channel_open(_ch.id, _ch.table,
-                                          columns=_cols,
-                                          timeout=self.rpc_timeout)
+                    return (i, w.endpoint,
+                            w.channel_open(_ch.id, _ch.table,
+                                           columns=_cols,
+                                           timeout=self.rpc_timeout))
                 except Exception as e:       # noqa: BLE001 — one surface:
                     # a worker lost at the barrier must raise DqError so
                     # the router maps it to ClusterError like every other
@@ -170,7 +291,25 @@ class DqTaskRunner:
                         f"{w.endpoint}: {type(e).__name__}: "
                         f"{str(e)[:200]}") from e
             with ThreadPoolExecutor(max_workers=len(tws)) as pool:
-                list(pool.map(open_one, tws))
+                opens = list(pool.map(open_one, tws))
+            for (i, endpoint, resp) in opens:
+                wait = float(resp.get("wait_ms", 0.0) or 0.0)
+                key = (stage.id, i)
+                self._input_waits[key] = self._input_waits.get(key, 0.0) \
+                    + wait
+                if wait:
+                    GLOBAL_HIST.observe("dq/channel_wait_ms", wait)
+                sp = self.tracer.attach_span(
+                    "input-wait", channel=ch.id, worker=endpoint,
+                    rows=int(resp.get("rows", 0)),
+                    bytes=int(resp.get("bytes", 0))) \
+                    if self.tracer is not None else None
+                if sp is not None:
+                    # the wait already HAPPENED — rewind start so the
+                    # span occupies its true interval instead of
+                    # overlapping the upcoming task execution
+                    sp.start_ms = round(sp.start_ms - wait, 3)
+                    sp.dur_ms = wait
 
     def _drop_outputs(self, graph, stage) -> None:
         chans = list(stage.outputs)
@@ -194,6 +333,29 @@ class DqTaskRunner:
     # -- router (merge) stage ----------------------------------------------
 
     def _run_router_stage(self, graph, stage) -> pd.DataFrame:
+        from ydb_tpu.utils.metrics import GLOBAL_HIST
+        t_stage = time.perf_counter()
+        ok = False
+        try:
+            with self._span("dq-stage", stage=stage.id, on="router"):
+                out = self._router_stage_body(graph, stage)
+            ok = True
+            return out
+        finally:
+            ms = (time.perf_counter() - t_stage) * 1000.0
+            if ok:
+                # success-only, like the worker stages above
+                GLOBAL_HIST.observe("dq/stage_ms", ms)
+            self.stage_stats.append(self._stage_row(
+                graph, stage, "router",
+                "finished" if ok else "failed", 1,
+                rows=sum(len(f) for got in
+                         (self._collected.get(cid, {})
+                          for cid in stage.inputs)
+                         for f in got.values()),
+                exec_ms=round(ms, 3)))
+
+    def _router_stage_body(self, graph, stage) -> pd.DataFrame:
         from ydb_tpu.query.window import apply_order_limit
         self.counters.inc("dq/stages")
         if getattr(stage, "groupby_merge", False):
@@ -299,7 +461,8 @@ class LocalWorker:
                                               None))
 
     def dq_run_task(self, task_id: str, stage: str, sql: str,
-                    outputs: list, src: str, timeout=None) -> dict:
+                    outputs: list, src: str, timeout=None,
+                    trace=None) -> dict:
         from ydb_tpu.dq import task as dq_task
         rec = self.tasks.setdefault(task_id, {"stage": stage,
                                               "attempts": 0})
@@ -308,7 +471,7 @@ class LocalWorker:
             resp = dq_task.run_task(
                 self.engine, sql, outputs, src,
                 send=lambda _o, p, frame: self._peers[p]._land(frame),
-                counters=self.task_counters)
+                counters=self.task_counters, trace=trace)
             rec["state"] = "finished"
             return resp
         except Exception as e:
@@ -318,9 +481,9 @@ class LocalWorker:
     def channel_open(self, channel: str, table: str, columns=None,
                      timeout=None) -> dict:
         from ydb_tpu.dq.task import materialize_channel
-        rows = materialize_channel(self.engine, self.exchange, channel,
-                                   table, columns)
-        return {"ok": True, "rows": rows}
+        stats = materialize_channel(self.engine, self.exchange, channel,
+                                    table, columns)
+        return {"ok": True, **stats}
 
     def channel_close(self, tables=(), channels=(), timeout=None) -> dict:
         for name in tables:

@@ -7,6 +7,16 @@ the runner for a router-bound channel. Shared verbatim by the gRPC
 servicer (`server/service.py` DqRunTask) and the in-process
 `LocalWorker` (`dq/runner.py`), so the 1-worker degenerate case runs the
 exact code the cluster runs.
+
+Profiling: a task that arrives with a trace context ({trace_id,
+parent_span_id, sampled} — the NWilson::TTraceId analog riding the
+DqRunTask RPC) adopts it on the worker engine's tracer, records its
+exec / output-flush spans (with the engine's own statement + device
+sub-spans nested inside), and ships the finished span list back in the
+response (`resp["profile"]["spans"]`) for the runner to `ingest()` into
+the router's tree. Per-channel producer stats (frames/rows/bytes/
+backpressure wait) ship back unconditionally — they cost nothing and
+feed `.sys/dq_stage_stats` even for unsampled queries.
 """
 
 from __future__ import annotations
@@ -15,60 +25,119 @@ from ydb_tpu.utils.metrics import GLOBAL
 
 
 def run_task(engine, sql: str, outputs: list, src: str, send,
-             token: str = "", counters=None) -> dict:
+             token: str = "", counters=None, trace=None) -> dict:
     """Execute one task. `outputs`: [{"channel", "kind", "key", "n_peers"}]
     specs; `send(out, peer_idx, frame_bytes)` is the transport for
-    worker-bound channels. Returns {"ok", "rows_in", "dtypes",
-    "bytes_shipped", "frames_shipped"[, "collected_df"]} — the caller
-    serializes `collected_df` for the wire."""
-    from ydb_tpu.cluster.exchange import ChannelWriter, hash_partition
+    worker-bound channels; `trace`: the propagated context (or None).
+    Returns {"ok", "rows_in", "dtypes", "bytes_shipped", "frames_shipped",
+    "profile"[, "collected_df"]} — the caller serializes `collected_df`
+    for the wire."""
     counters = counters or GLOBAL
     executor = engine.executor
-    executor.dq_stage_depth += 1
+    tracer = getattr(engine, "tracer", None)
+    adopt = trace is not None and tracer is not None
+    sampled = bool(adopt and trace.get("sampled"))
+    if adopt:
+        # adopt the ROUTER's decision either way: an UNSAMPLED context
+        # still opens an (unsampled) trace so the stage statement runs
+        # nested — otherwise the worker engine would treat internal
+        # stage SQL as an outermost user statement, re-sample it, drain
+        # the deterministic sampling accumulator, and push uuid-named
+        # stage programs into the worker's query-profiles ring
+        tracer.begin_trace(sampled=sampled,
+                           trace_id=trace.get("trace_id"),
+                           parent_id=trace.get("parent_span_id"))
+    spans = []
     try:
-        block = engine.execute(sql)
+        resp = _run_task_body(engine, executor, sql, outputs, src, send,
+                              token, counters, tracer, sampled, trace)
     finally:
-        executor.dq_stage_depth -= 1
+        if adopt:
+            # end_trace force-closes anything a raising path left open,
+            # so the worker tracer never leaks state into its next task
+            spans = tracer.end_trace()
+    if sampled:
+        resp["profile"]["spans"] = [s.to_dict() for s in spans]
+    return resp
+
+
+def _run_task_body(engine, executor, sql, outputs, src, send, token,
+                   counters, tracer, sampled, trace):
+    import time
+    from contextlib import nullcontext
+
+    from ydb_tpu.cluster.exchange import ChannelWriter, hash_partition
+
+    def span(name, **attrs):
+        return tracer.span(name, **attrs) if sampled else nullcontext()
+
+    channel_stats: list = []
+    t0 = time.perf_counter()
+    with span("task-exec", src=src):
+        executor.dq_stage_depth += 1
+        try:
+            block = engine.execute(sql)
+        finally:
+            executor.dq_stage_depth -= 1
+    exec_ms = (time.perf_counter() - t0) * 1000.0
     df = block.to_pandas()
     resp = {"ok": True, "rows_in": len(df),
             "dtypes": {c: str(df[c].dtype) for c in df.columns}}
     total_bytes = total_frames = 0
-    for out in outputs:
-        kind = out["kind"]
-        if kind in ("union_all", "merge"):
-            resp["collected_df"] = df
-            continue
-        n_peers = int(out["n_peers"])
-        if kind == "hash_shuffle":
-            key = out["key"]
-            # the key's hash route comes from the SCHEMA, not the pandas
-            # dtype: nullable int keys widen to object dtype in pandas
-            # and would otherwise string-hash on this producer while a
-            # NOT NULL producer int-hashes — the same key landing on two
-            # consumers silently drops sharded-join matches
-            kkind = None
-            if block.schema.has(key):
-                dt = block.schema.dtype(key)
-                kkind = ("string" if dt.is_string
-                         else "float" if dt.is_float else "int")
-            parts = hash_partition(df, key, n_peers, kind=kkind)
-        elif kind == "broadcast":
-            parts = [df] * n_peers
-        else:
-            raise ValueError(f"bad output channel kind {kind!r}")
-        writer = ChannelWriter(
-            out["channel"], src,
-            lambda p, frame, _o=out: send(_o, p, frame),
-            n_peers, token=token, counters=counters)
-        try:
-            for p in range(n_peers):
-                writer.ship(p, parts[p])
-        finally:
-            writer.close()
-        total_bytes += writer.bytes_sent
-        total_frames += writer.frames_sent
+    t0 = time.perf_counter()
+    with span("output-flush", channels=len(outputs)):
+        for out in outputs:
+            kind = out["kind"]
+            if kind in ("union_all", "merge"):
+                resp["collected_df"] = df
+                channel_stats.append({
+                    "channel": out["channel"], "frames": 0,
+                    "rows": len(df), "bytes": 0,
+                    "backpressure_wait_ms": 0.0})
+                continue
+            n_peers = int(out["n_peers"])
+            if kind == "hash_shuffle":
+                key = out["key"]
+                # the key's hash route comes from the SCHEMA, not the
+                # pandas dtype: nullable int keys widen to object
+                # dtype in pandas and would otherwise string-hash on
+                # this producer while a NOT NULL producer int-hashes
+                # — the same key landing on two consumers silently
+                # drops sharded-join matches
+                kkind = None
+                if block.schema.has(key):
+                    dt = block.schema.dtype(key)
+                    kkind = ("string" if dt.is_string
+                             else "float" if dt.is_float else "int")
+                parts = hash_partition(df, key, n_peers, kind=kkind)
+            elif kind == "broadcast":
+                parts = [df] * n_peers
+            else:
+                raise ValueError(f"bad output channel kind {kind!r}")
+            writer = ChannelWriter(
+                out["channel"], src,
+                lambda p, frame, _o=out: send(_o, p, frame),
+                n_peers, token=token, counters=counters, trace=trace)
+            try:
+                for p in range(n_peers):
+                    writer.ship(p, parts[p])
+            finally:
+                writer.close()
+            total_bytes += writer.bytes_sent
+            total_frames += writer.frames_sent
+            channel_stats.append(writer.stats())
+    flush_ms = (time.perf_counter() - t0) * 1000.0
     resp["bytes_shipped"] = total_bytes
     resp["frames_shipped"] = total_frames
+    resp["profile"] = {
+        "exec_ms": round(exec_ms, 3),
+        "flush_ms": round(flush_ms, 3),
+        "channels": channel_stats,
+    }
+    wait = sum(c["backpressure_wait_ms"] for c in channel_stats)
+    if wait:
+        from ydb_tpu.utils.metrics import GLOBAL_HIST
+        GLOBAL_HIST.observe("dq/channel_wait_ms", wait)
     counters.inc("dq/tasks")
     if total_frames:
         counters.inc("dq/frames", total_frames)
@@ -77,14 +146,20 @@ def run_task(engine, sql: str, outputs: list, src: str, send,
 
 
 def materialize_channel(engine, exchange, channel: str, table: str,
-                        columns=None) -> int:
+                        columns=None) -> dict:
     """Drain a channel's frames into a transient local table — the stage
     barrier's consumer side (ChannelOpen). `columns`: [(name, dtype)] so
     a worker that received no partitions still registers a typed temp.
-    Namespace/auth policy stays with the caller (the servicer)."""
+    Namespace/auth policy stays with the caller (the servicer).
+    Returns {"rows", "bytes", "wait_ms"} — the consumer-side channel
+    stat (input drain + table build time) the runner attributes as the
+    stage's input-wait."""
+    import time
+
     from ydb_tpu.core.block import HostBlock
     from ydb_tpu.storage.mvcc import WriteVersion
-    df = exchange.take(channel)
+    t0 = time.perf_counter()
+    df, nbytes = exchange.take2(channel)
     if df.empty and columns:
         df = empty_typed_frame(columns)
     block = HostBlock.from_pandas(df)
@@ -108,7 +183,8 @@ def materialize_channel(engine, exchange, channel: str, table: str,
                       if cd.dictionary is not None}
     t.commit(t.write(block), WriteVersion(1, 1))
     t.indexate()
-    return block.length
+    return {"rows": block.length, "bytes": int(nbytes),
+            "wait_ms": round((time.perf_counter() - t0) * 1000.0, 3)}
 
 
 def empty_typed_frame(columns):

@@ -944,10 +944,44 @@ class ProgramCache:
         fn = self._cache.get(key)
         if fn is None:
             self.misses += 1
-            fn = self._build(program, sig, cap)
-            self._cache[key] = fn
+            fn = self._timed_fill(key, self._build(program, sig, cap))
         else:
             self.hits += 1
+        return fn
+
+    def _timed_fill(self, key, built):
+        """Cache-fill wrapper: jax.jit compiles lazily on the FIRST
+        invocation, so the fill stores a thin shim that times that call
+        (trace + XLA compile + first run) and records it as this
+        program's compile_ms; later calls pay one flag check. The shim
+        delegates `clear_cache` to the jitted fn so ExecCache eviction
+        releases the real executable (a bare closure would silently
+        defeat the release-on-evict lifecycle), and it never overwrites
+        the cache entry — an overwrite would spuriously release."""
+        import threading as _threading
+        import time as _time
+        timed = [False]
+        mu = _threading.Lock()
+
+        def fn(*a, **kw):
+            if timed[0]:
+                return built(*a, **kw)
+            with mu:
+                first = not timed[0]
+                timed[0] = True
+            if not first:
+                # lost the first-call race: don't double-count compiles
+                return built(*a, **kw)
+            from ydb_tpu.utils.metrics import GLOBAL
+            t0 = _time.perf_counter()
+            out = built(*a, **kw)
+            ms = (_time.perf_counter() - t0) * 1000.0
+            GLOBAL.inc("program_cache/compiles")
+            GLOBAL.inc("program_cache/compile_ms", ms)
+            return out
+
+        fn.clear_cache = built.clear_cache
+        self._cache[key] = fn
         return fn
 
     @staticmethod

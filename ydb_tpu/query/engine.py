@@ -129,6 +129,31 @@ class QueryEngine:
         from ydb_tpu.utils.tracing import Tracer
         self.tracer = Tracer()
         self.executor.tracer = self.tracer
+        # admission-time trace sampling (jaeger_tracing sampler analog):
+        # YDB_TPU_TRACE_SAMPLE in [0, 1] — 1 (default) traces every
+        # statement, 0 records zero spans (results byte-identical),
+        # fractions sample deterministically 1-in-1/rate. Statements
+        # whose text previously blew the slow-query threshold are
+        # FORCED-sampled regardless of rate, so the profile of a known
+        # offender is always captured on its next run.
+        self.trace_sample = min(1.0, max(0.0, float(
+            os.environ.get("YDB_TPU_TRACE_SAMPLE", "1") or 0)))
+        self.slow_query_ms = float(
+            os.environ.get("YDB_TPU_SLOW_QUERY_MS", "1000"))
+        self._slow_sqls: dict = {}       # sql -> worst ms (bounded)
+        self._trace_mu = threading.Lock()
+        self._trace_acc = 0.0            # deterministic rate accumulator
+        # assembled query profiles, last-N ring (`.sys/query_profiles`):
+        # one record per SAMPLED outermost statement — sql, wall,
+        # phase breakdown, and the full cross-worker span tree
+        from collections import deque as _deque
+        self.profiles = _deque(maxlen=int(
+            os.environ.get("YDB_TPU_PROFILE_RING", "64")))
+        # per-(stage, worker) DQ execution stats ring
+        # (`.sys/dq_stage_stats`) — the TDqTaskRunnerStatsView seat;
+        # filled by DqTaskRunner when this engine drives a stage graph
+        self.dq_stage_stats = _deque(maxlen=int(
+            os.environ.get("YDB_TPU_DQ_STATS_RING", "256")))
         # per-statement result metadata is THREAD-LOCAL: concurrent
         # sessions must each see their own stats/trace/rows-affected
         self._tls = threading.local()
@@ -384,7 +409,12 @@ class QueryEngine:
         # concurrency comes from many sessions, not one)
         ctx = session._mu if session is not self._default_session \
             else nullcontext()
-        self.tracer.begin_trace()
+        outermost = self.tracer._state().depth == 0
+        # the sampling decision (and its accumulator/forced-slow side
+        # effects) applies to OUTERMOST statements only — a nested
+        # begin_trace inherits the open trace's decision anyway
+        self.tracer.begin_trace(
+            sampled=self._sample_decision(sql) if outermost else True)
         kind_box: list = []
         ok = False
         try:
@@ -394,8 +424,73 @@ class QueryEngine:
             return block
         finally:
             self.last_trace = self.tracer.end_trace()
+            # profiles record USER statements: a DQ stage program run
+            # through a legacy (context-free) caller is still internal
+            if outermost and self.last_trace \
+                    and not self.executor.dq_stage_depth:
+                self._record_profile(sql, self.last_trace)
             if not _internal:
                 self._audit(sql, ok, kind_box[0] if kind_box else "")
+
+    def _sample_decision(self, sql: str) -> bool:
+        """Admission-time trace sampling: rate-based, with forced-on for
+        EXPLAIN (the user asked for the profile) and for statements whose
+        text previously exceeded the slow-query threshold. Nested
+        (internal) statements inherit the enclosing decision — this is
+        only consulted for the thread's OUTERMOST begin_trace."""
+        if self.trace_sample >= 1.0:
+            return True
+        if sql.lstrip()[:7].lower() == "explain":
+            return True
+        if sql in self._slow_sqls:
+            from ydb_tpu.utils.metrics import GLOBAL
+            GLOBAL.inc("trace/forced_slow")
+            return True
+        if self.trace_sample <= 0.0:
+            return False
+        with self._trace_mu:
+            self._trace_acc += self.trace_sample
+            if self._trace_acc >= 1.0:
+                self._trace_acc -= 1.0
+                return True
+        return False
+
+    def _record_profile(self, sql: str, spans: list,
+                        stage_stats: list = None, total_ms: float = None,
+                        rows_out: int = None, kind: str = None) -> None:
+        """Append one assembled profile to the last-N ring
+        (`.sys/query_profiles`): the span tree plus its device-timeline
+        rollup. `stage_stats`: the DQ runner's per-(stage, worker) rows
+        for distributed queries. total_ms/rows_out/kind overrides: the
+        router passes the DQ wall explicitly — for a distributed query
+        `last_stats` holds only the router-MERGE statement's numbers
+        (or a previous statement's, when the final stage had no merge
+        SQL), not the graph's."""
+        from ydb_tpu.utils.tracing import phase_breakdown
+        st = self.last_stats
+        # last_stats is only trustworthy when it belongs to THIS
+        # statement and finished: a statement that raised before (or
+        # inside) stats assembly leaves the PREVIOUS statement's record
+        # in the thread-local — attributing its wall/kind/rows to this
+        # profile row would fabricate exactly the numbers this view
+        # exists to make reliable
+        mine = st is not None and getattr(st, "sql", None) == sql
+        finished = mine and getattr(st, "total_ms", 0.0) > 0.0
+        self.profiles.append({
+            "trace_id": spans[0].trace_id,
+            "sql": sql,
+            "kind": kind if kind is not None
+            else (st.kind if mine else "error"),
+            "total_ms": total_ms if total_ms is not None
+            else (st.total_ms if finished
+                  else round(spans[0].dur_ms, 3)),
+            "rows_out": rows_out if rows_out is not None
+            else (int(st.rows_out) if mine else 0),
+            "phases": phase_breakdown(spans),
+            "n_spans": len(spans),
+            "spans": [s.to_dict() for s in spans],
+            "stages": list(stage_stats or []),
+        })
 
     def _audit(self, sql: str, ok: bool, kind: str) -> None:
         """Audit trail for mutating statements (the ydb/core/audit sink):
@@ -436,6 +531,11 @@ class QueryEngine:
         # merge stage) must not wipe the outer statement's window
         from ydb_tpu.ops.xla_exec import groupby_trace_mark
         stats._gb_mark = groupby_trace_mark()
+        # span-window mark: THIS statement's phase breakdown must only
+        # cover spans recorded from here on — a nested statement (the DQ
+        # router merge) shares the trace with already-ingested worker
+        # spans whose device time is NOT this statement's
+        stats._span_mark = len(self.tracer.spans)
         with self.tracer.span("parse"):
             stmt = parse(sql)
         stats.parse_ms = t.lap()
@@ -749,22 +849,68 @@ class QueryEngine:
 
     def _finish_stats(self, stats, t, block) -> None:
         from ydb_tpu.ops.xla_exec import groupby_trace_delta
-        from ydb_tpu.utils.metrics import GLOBAL
+        from ydb_tpu.utils.metrics import GLOBAL, GLOBAL_HIST
+        from ydb_tpu.utils.tracing import phase_breakdown
         stats.execute_ms = t.lap()
         stats.total_ms = stats.parse_ms + stats.plan_ms + stats.execute_ms
         stats.rows_out = block.length
         stats.fused = self.executor.last_path == "fused"
         stats.distributed = self.executor.last_path == "distributed"
         stats.groupby = groupby_trace_delta(getattr(stats, "_gb_mark", {}))
+        if self.tracer.sampled:
+            stats.phases = phase_breakdown(
+                self.tracer.spans[getattr(stats, "_span_mark", 0):])
+        # latency histograms count USER statements once: a nested
+        # internal statement (EXPLAIN ANALYZE's re-entrant execute, the
+        # DQ router-merge SELECT — its trace depth is >1) must not add a
+        # second, cheaper sample that drags p50 down and doubles count.
+        # Worker-side DQ stage programs are excluded via dq_stage_depth,
+        # NOT trace depth — an unsampled task opens no trace, and the
+        # histogram contents must not depend on the sampling rate
+        if self.tracer._state().depth <= 1 \
+                and not self.executor.dq_stage_depth:
+            GLOBAL_HIST.observe("query/latency_ms", stats.total_ms)
+            GLOBAL_HIST.observe("query/parse_ms", stats.parse_ms)
+            GLOBAL_HIST.observe("query/plan_ms", stats.plan_ms)
+            GLOBAL_HIST.observe("query/execute_ms", stats.execute_ms)
+            # slow-query bookkeeping is USER-statement-scoped too: DQ
+            # stage/merge SQL embeds per-query uuid temp names that can
+            # never match a future run — remembering them would churn
+            # the bounded forced-trace set and inflate slow_query/*
+            self._note_slow(stats.sql, stats.total_ms, stats.kind)
         GLOBAL.inc("engine/rows_out", block.length)
         GLOBAL.inc("engine/queries")
         self.query_history.append(stats)
 
+    def _note_slow(self, sql: str, total_ms: float, kind: str) -> None:
+        """Slow-query log counter family + the forced-sampling set: a
+        statement over the threshold is counted, and its TEXT is
+        remembered so its next run is traced even at sample rate 0."""
+        if total_ms < self.slow_query_ms or not sql:
+            return
+        from ydb_tpu.utils.metrics import GLOBAL
+        GLOBAL.inc("slow_query/count")
+        GLOBAL.inc(f"slow_query/{kind or 'other'}")
+        GLOBAL.set_max("slow_query/worst_ms", total_ms)
+        with self._trace_mu:
+            if len(self._slow_sqls) >= 256 and sql not in self._slow_sqls:
+                # bounded: drop the least-slow remembered offender
+                victim = min(self._slow_sqls, key=self._slow_sqls.get)
+                del self._slow_sqls[victim]
+            self._slow_sqls[sql] = max(self._slow_sqls.get(sql, 0.0),
+                                       total_ms)
+
     def counters(self) -> dict:
         """Live counter snapshot (the /counters endpoint payload)."""
         from ydb_tpu.ops.xla_exec import _GLOBAL_CACHE
-        from ydb_tpu.utils.metrics import GLOBAL
+        from ydb_tpu.utils.metrics import GLOBAL, GLOBAL_HIST, HIST_FAMILIES
         c = GLOBAL.snapshot()
+        c.update(GLOBAL_HIST.snapshot())
+        # the fixed histogram families are always visible (zeros before
+        # the first observation), like the counter families below
+        for fam in HIST_FAMILIES:
+            for q in ("count", "p50", "p95", "p99", "max"):
+                c.setdefault(f"hist/{fam}/{q}", 0)
         c.update({
             "engine/plan_cache_size": len(self._plan_cache),
             "executor/fused_plans": len(self.executor._fused_cache),
@@ -792,8 +938,12 @@ class QueryEngine:
                   "groupby/scatter_ops", "groupby/sort_rows_max",
                   "groupby/value_gather_rows_max",
                   "groupby/join_bounded_plans", "dq/merge_groupby_stages",
-                  "sort/rows_max", "sort/operands_max"):
+                  "sort/rows_max", "sort/operands_max",
+                  "slow_query/count", "trace/forced_slow",
+                  "program_cache/compiles", "program_cache/compile_ms"):
             c.setdefault(k, 0)
+        c.setdefault("trace/sample_rate", self.trace_sample)
+        c.setdefault("trace/profiles_held", len(self.profiles))
         return c
 
     def prewarm(self, tables=None) -> int:

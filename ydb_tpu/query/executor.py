@@ -127,9 +127,10 @@ class Executor:
         self._tls.last_path = v
 
     def _span(self, name: str, **attrs):
-        from contextlib import nullcontext
-        return self.tracer.span(name, **attrs) if self.tracer is not None \
-            else nullcontext()
+        if self.tracer is not None:
+            return self.tracer.span(name, **attrs)
+        from ydb_tpu.utils.tracing import _NullSpanCtx
+        return _NullSpanCtx()   # yields a throwaway span (attrs writable)
 
     # -- cache warmup ------------------------------------------------------
 
@@ -322,6 +323,7 @@ class Executor:
                                 builds_sig, sort_spec, rank_assigns,
                                 tuple(sorted(all_params)), lim_key=lim_key)
         entry = self._fused_cache.get(key)
+        fresh_compile = entry is None
         if entry is None:
             fn, layout_box = F.build_fused_fn(
                 pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
@@ -338,9 +340,17 @@ class Executor:
         dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
                       for k, v in all_params.items()}
         build_inputs = [F.build_traced_inputs(bt) for bt in builds]
-        with self._span("device-dispatch", k=K, cap=CAP):
+        with self._span("device-dispatch", k=K, cap=CAP) as dsp:
+            import time as _time
+            t_disp = _time.perf_counter()
             data_stacks, valid_stack, length = fn(arrays, valids, lengths,
                                                   build_inputs, dev_params)
+            if fresh_compile:
+                # jit compiles synchronously inside the first call of a
+                # fresh shape; steady-state dispatch is ~async enqueue —
+                # the delta IS this program's trace+compile cost
+                dsp.attrs["compile_ms"] = round(
+                    (_time.perf_counter() - t_disp) * 1000.0, 3)
 
         # readout deferred into the result future: the dispatch above is
         # async, and `fetch_fused_result` performs the ONE device→host
@@ -353,8 +363,16 @@ class Executor:
         limit = plan.limit
 
         def fetch() -> HostBlock:
-            block = F.fetch_fused_result(data_stacks, valid_stack, length,
-                                         layout_box, out_schema, out_dicts)
+            # split the readout into on-device execute (block_until_ready
+            # delta — the program is still running when the future is
+            # consumed promptly) and the D2H transfer + host unpack, so
+            # the trace attributes device time separately from link time
+            with self._span("device-execute"):
+                jax.block_until_ready((data_stacks, valid_stack, length))
+            with self._span("readout-transfer"):
+                block = F.fetch_fused_result(data_stacks, valid_stack,
+                                             length, layout_box,
+                                             out_schema, out_dicts)
             return _apply_offset(block, lo, limit)
 
         fut = DeviceResultFuture(fetch)
@@ -610,9 +628,15 @@ class Executor:
                           else v) for k, v in stacked.items()}
         build_inputs = [F.build_traced_inputs(bt) for bt in builds]
         try:
-            with self._span("device-dispatch-batched", k=K, cap=CAP, b=Bb):
+            with self._span("device-dispatch-batched", k=K, cap=CAP,
+                            b=Bb) as dsp:
+                import time as _time
+                t_disp = _time.perf_counter()
                 data_stacks, valid_stack, length = fn(
                     arrays, valids, lengths, build_inputs, dev_params)
+                if cached is None:
+                    dsp.attrs["compile_ms"] = round(
+                        (_time.perf_counter() - t_disp) * 1000.0, 3)
         except Exception:                # noqa: BLE001 — lane, not law
             # a shape the vmapped trace can't batch (or a compile-side
             # failure): fall back to per-member execution rather than
@@ -627,9 +651,12 @@ class Executor:
         out_dicts = {n2: d for n2, d in dicts.items() if out_schema.has(n2)}
         out_dicts.update({n2: d for n2, d in plan.result_dicts.items()
                           if out_schema.has(n2)})
-        blocks = F.fetch_fused_batch(data_stacks, valid_stack, length,
-                                     layout_box, out_schema, out_dicts,
-                                     member_rows)
+        with self._span("device-execute"):
+            jax.block_until_ready((data_stacks, valid_stack, length))
+        with self._span("readout-transfer", b=len(members)):
+            blocks = F.fetch_fused_batch(data_stacks, valid_stack, length,
+                                         layout_box, out_schema, out_dicts,
+                                         member_rows)
         out = []
         for (mp, _prms), blk in zip(members, blocks):
             blk = _apply_offset(blk, mp.offset or 0, mp.limit)

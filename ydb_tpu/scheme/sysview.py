@@ -19,7 +19,7 @@ from ydb_tpu.core.block import HostBlock
 PREFIX = ".sys/"
 
 VIEWS = ("tables", "partition_stats", "counters", "query_metrics",
-         "top_queries_by_duration")
+         "top_queries_by_duration", "dq_stage_stats", "query_profiles")
 
 
 def is_sysview(name: str) -> bool:
@@ -81,6 +81,61 @@ def sysview_block(engine, name: str) -> HostBlock:
                              ("execute_ms", "float64"),
                              ("rows_out", "int64"), ("path", str),
                              ("cache_hit", "bool")])
+    if view == "dq_stage_stats":
+        # per-(stage, worker) task stats of recent DQ graph runs — the
+        # TDqTaskRunnerStatsView seat (filled by dq/runner.py)
+        rows = [{
+            "trace_id": int(r.get("trace_id", 0)),
+            "graph": r.get("graph", ""), "stage": r.get("stage", ""),
+            "worker": r.get("worker", ""), "state": r.get("state", ""),
+            "attempts": int(r.get("attempts", 0)),
+            "rows": int(r.get("rows", 0)),
+            "bytes": int(r.get("bytes", 0)),
+            "frames": int(r.get("frames", 0)),
+            "exec_ms": float(r.get("exec_ms", 0.0)),
+            "flush_ms": float(r.get("flush_ms", 0.0)),
+            "input_wait_ms": float(r.get("input_wait_ms", 0.0)),
+            "backpressure_wait_ms": float(
+                r.get("backpressure_wait_ms", 0.0)),
+        } for r in list(getattr(engine, "dq_stage_stats", []))]
+        return _block(rows, [("trace_id", "int64"), ("graph", str),
+                             ("stage", str), ("worker", str),
+                             ("state", str), ("attempts", "int64"),
+                             ("rows", "int64"), ("bytes", "int64"),
+                             ("frames", "int64"), ("exec_ms", "float64"),
+                             ("flush_ms", "float64"),
+                             ("input_wait_ms", "float64"),
+                             ("backpressure_wait_ms", "float64")])
+    if view == "query_profiles":
+        # the last-N assembled profiles (sampled statements + DQ runs):
+        # wall, span count, and the device-timeline phase rollup
+        rows = []
+        for p in list(getattr(engine, "profiles", [])):
+            ph = p.get("phases") or {}
+            rows.append({
+                "trace_id": int(p.get("trace_id", 0)),
+                "sql": p.get("sql", ""), "kind": p.get("kind", ""),
+                "total_ms": float(p.get("total_ms", 0.0)),
+                "rows_out": int(p.get("rows_out", 0)),
+                "n_spans": int(p.get("n_spans", 0)),
+                "n_stages": len(p.get("stages") or []),
+                "compile_ms": float(ph.get("compile_ms", 0.0)),
+                "build_ms": float(ph.get("build_ms", 0.0)),
+                "upload_ms": float(ph.get("upload_ms", 0.0)),
+                "dispatch_ms": float(ph.get("dispatch_ms", 0.0)),
+                "device_ms": float(ph.get("device_ms", 0.0)),
+                "readout_ms": float(ph.get("readout_ms", 0.0)),
+            })
+        return _block(rows, [("trace_id", "int64"), ("sql", str),
+                             ("kind", str), ("total_ms", "float64"),
+                             ("rows_out", "int64"), ("n_spans", "int64"),
+                             ("n_stages", "int64"),
+                             ("compile_ms", "float64"),
+                             ("build_ms", "float64"),
+                             ("upload_ms", "float64"),
+                             ("dispatch_ms", "float64"),
+                             ("device_ms", "float64"),
+                             ("readout_ms", "float64")])
     raise KeyError(f"unknown system view {name!r} "
                    f"(have: {', '.join(PREFIX + v for v in VIEWS)})")
 

@@ -240,7 +240,8 @@ class QueryServicer:
 
             resp = dq_task.run_task(
                 self.engine, request["sql"], request.get("outputs") or [],
-                str(request.get("src", "")), send, token=self._token)
+                str(request.get("src", "")), send, token=self._token,
+                trace=request.get("trace"))
             if "collected_df" in resp:
                 df = resp.pop("collected_df")
                 resp["collected"] = {"columns": list(df.columns),
@@ -283,10 +284,10 @@ class QueryServicer:
                 return {"error": f"ChannelOpen: table {name!r} is outside "
                                  f"the {SHUFFLE_TMP_PREFIX}* shuffle-temp "
                                  "namespace"}
-            rows = materialize_channel(self.engine, self.exchange,
-                                       request["channel"], name,
-                                       request.get("columns"))
-            return {"ok": True, "rows": rows}
+            stats = materialize_channel(self.engine, self.exchange,
+                                        request["channel"], name,
+                                        request.get("columns"))
+            return {"ok": True, **stats}
         except Exception as e:               # noqa: BLE001 — wire boundary
             return {"error": f"{type(e).__name__}: {e}"}
 
@@ -642,12 +643,16 @@ class Client:
 
     def dq_run_task(self, task_id: str, stage: str, sql: str,
                     outputs: list, src: str = "",
-                    timeout: float = None) -> dict:
+                    timeout: float = None, trace: dict = None) -> dict:
         """Run one DQ task (stage program + channel routing) on the
-        worker; blocks until the task's frames are delivered."""
+        worker; blocks until the task's frames are delivered. `trace`:
+        the propagated {trace_id, parent_span_id, sampled} context —
+        the worker records its spans against it and ships them back in
+        `resp["profile"]`."""
         resp = self._dq_run({"task_id": task_id, "stage": stage,
                              "sql": sql, "outputs": list(outputs),
-                             "src": src, "token": self.token},
+                             "src": src, "token": self.token,
+                             "trace": trace},
                             timeout=timeout)
         if "error" in resp:
             raise RuntimeError(resp["error"])

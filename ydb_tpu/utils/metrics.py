@@ -15,6 +15,7 @@ endpoint.
 
 from __future__ import annotations
 
+import math
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -53,6 +54,118 @@ class Counters:
 
 
 GLOBAL = Counters()
+
+
+class Histogram:
+    """Log-bucketed latency histogram (the monlib NHistogram exponential
+    bucket family): bucket i covers [BASE·G^(i-1), BASE·G^i), G=2,
+    BASE=0.05 ms — 32 buckets span 50 µs … ~30 h (0.05·2^31 ms),
+    everything above lands in one overflow bucket. Quantiles
+    interpolate geometrically inside the winning bucket and clamp to
+    the exact observed min/max, so a single sample reports itself at
+    every quantile."""
+
+    BASE = 0.05
+    GROWTH = 2.0
+    N_BUCKETS = 32                    # + 1 overflow
+
+    __slots__ = ("counts", "count", "sum", "min", "max")
+
+    def __init__(self):
+        self.counts = [0] * (self.N_BUCKETS + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def _bucket(self, v: float) -> int:
+        if v < self.BASE:
+            return 0
+        i = int(math.log(v / self.BASE, self.GROWTH)) + 1
+        return min(i, self.N_BUCKETS)      # N_BUCKETS = overflow
+
+    def record(self, v: float) -> None:
+        v = max(0.0, float(v))
+        self.counts[self._bucket(v)] += 1
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+    def quantile(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        rank = q * self.count
+        acc = 0
+        for i, c in enumerate(self.counts):
+            if c == 0:
+                continue
+            if acc + c >= rank:
+                if i >= self.N_BUCKETS:
+                    # overflow bucket is unbounded above — the exact
+                    # observed max is the only honest answer
+                    return self.max
+                lo = self.BASE * self.GROWTH ** (i - 1) if i > 0 else 0.0
+                hi = self.BASE * self.GROWTH ** i
+                frac = (rank - acc) / c
+                est = lo + (hi - lo) * frac
+                return min(max(est, self.min), self.max)
+            acc += c
+        return self.max
+
+    def snapshot(self) -> dict:
+        if self.count == 0:
+            return {"count": 0, "p50": 0.0, "p95": 0.0, "p99": 0.0,
+                    "max": 0.0}
+        return {"count": self.count,
+                "p50": round(self.quantile(0.50), 3),
+                "p95": round(self.quantile(0.95), 3),
+                "p99": round(self.quantile(0.99), 3),
+                "max": round(self.max, 3)}
+
+
+class HistogramRegistry:
+    """Named histograms with the Counters locking discipline; surfaced
+    on /counters as `hist/<name>/{count,p50,p95,p99,max}`."""
+
+    def __init__(self):
+        import threading
+        self._h: dict[str, Histogram] = {}
+        self._mu = threading.Lock()
+
+    def observe(self, name: str, value_ms: float) -> None:
+        with self._mu:
+            h = self._h.get(name)
+            if h is None:
+                h = self._h[name] = Histogram()
+            h.record(value_ms)
+
+    def get(self, name: str) -> Optional[Histogram]:
+        with self._mu:
+            return self._h.get(name)
+
+    def snapshot(self) -> dict:
+        """Flat /counters payload: hist/<name>/p50 etc. Per-histogram
+        snapshots are taken UNDER the lock — quantile() walks counts[]
+        against self.count, and a concurrent record() between the two
+        would hand back a torn view."""
+        out = {}
+        with self._mu:
+            for name, h in self._h.items():
+                for k, v in h.snapshot().items():
+                    out[f"hist/{name}/{k}"] = v
+        return out
+
+
+GLOBAL_HIST = HistogramRegistry()
+
+# the fixed histogram families (always-visible keys on /counters — see
+# QueryEngine.counters): end-to-end + per-phase statement latency,
+# per-DQ-stage wall, channel wait (input drain + writer backpressure),
+# and memory-admission queueing
+HIST_FAMILIES = ("query/latency_ms", "query/parse_ms", "query/plan_ms",
+                 "query/execute_ms", "dq/stage_ms", "dq/channel_wait_ms",
+                 "admission/wait_ms")
 
 # DQ task-graph runtime counters (`ydb_tpu/dq/`), one namespace on the
 # existing /counters surface — router side counts stages/tasks/retries,
@@ -111,6 +224,11 @@ class QueryStats:
     # "batched": bool} (batched=False → the lane fell back to per-member
     # execution); empty when the lane is off or the shape was ineligible
     batching: dict = field(default_factory=dict)
+    # device-timeline attribution (`utils/tracing.phase_breakdown` over
+    # this statement's spans): {build_ms, upload_ms, dispatch_ms,
+    # device_ms, readout_ms, compile_ms} — empty when the statement was
+    # unsampled or never touched the device
+    phases: dict = field(default_factory=dict)
 
     def render(self) -> str:
         path = ("mesh-distributed" if self.distributed
@@ -136,6 +254,13 @@ class QueryStats:
                     f"queries | leader "
                     f"{str(b.get('leader', False)).lower()} | "
                     f"{'stacked dispatch' if b.get('batched') else 'per-member fallback'}")
+        if self.phases:
+            p = self.phases
+            out += ("\n-- phases: " + " | ".join(
+                f"{k.removesuffix('_ms')} {p[k]:.1f}ms"
+                for k in ("compile_ms", "build_ms", "upload_ms",
+                          "dispatch_ms", "device_ms", "readout_ms")
+                if k in p))
         return out
 
 

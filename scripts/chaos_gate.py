@@ -1,0 +1,75 @@
+"""Hive chaos gate: kill -9 a worker mid-query, the cluster answers on.
+
+CI leg (`scripts/ci.sh`): runs the shared chaos choreography
+(`tests/cluster_util.chaos_drill` — three real worker processes on
+durable stores with synchronous standby mirrors and push heartbeat
+agents against a router-hosted Hive, kill -9 one mid-query-stream) and
+GATES on:
+
+  * every query in the stream COMPLETES with results identical to the
+    pre-kill 3-worker answer — the router's failover expires the dead
+    lease, the Hive re-places the lost shard (a survivor replays its
+    standby image via HiveAdoptShard), and the statement re-lowers
+    onto the survivors;
+  * `hive/worker_dead` and `dq/retry_rerouted` moved (deltas >= 1);
+  * `.sys/cluster_nodes` shows exactly 2 alive / 1 dead;
+  * no operator action anywhere in the loop.
+
+Also records the re-placement latency (kill → first post-kill query
+COMPLETION) for PERF.md round-11. Prints one JSON line; exit 0 = green.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+SF = float(os.environ.get("CHAOS_SF", "0.002"))
+
+
+def main() -> int:
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from tests.cluster_util import chaos_drill
+
+    root = tempfile.mkdtemp(prefix="chaos_gate_")
+    try:
+        d = chaos_drill(root, sf=SF)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    want = d["want"]
+    ok_stream = not d["errors"] and not d["hung"] \
+        and len(d["results"]) == 4
+    ok_results = ok_stream and all(
+        list(got.o_orderpriority) == list(want.o_orderpriority)
+        and list(got.n) == list(want.n)
+        and np.allclose(got.s, want.s, rtol=1e-9)
+        for (_t, got) in d["results"])
+    deltas = d["counter_deltas"]
+    gate = {
+        "stream_completed": ok_stream,
+        "results_correct": ok_results,
+        "worker_dead_counter": deltas["hive/worker_dead"] >= 1,
+        "retry_rerouted_counter": deltas["dq/retry_rerouted"] >= 1,
+        "shards_replaced_counter": deltas["hive/shards_replaced"] >= 1,
+        "two_alive_one_dead": d["states"] == {"alive": 2, "dead": 1},
+    }
+    ok = all(gate.values())
+    print(json.dumps({
+        "metric": "chaos_gate", "ok": ok, "gate": gate,
+        "errors": d["errors"][:3], "cluster_nodes": d["states"],
+        "replacement_latency_ms": d["replacement_latency_ms"],
+        "hive_counters": {k: v for k, v in d["counters"].items()
+                          if k.startswith(("hive/", "dq/retry"))},
+    }), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

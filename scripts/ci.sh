@@ -91,4 +91,30 @@ if [ "$drc" -ne 0 ]; then
     exit "$drc"
 fi
 
+echo "== Hive chaos gate (3 workers, kill -9 mid-query, re-placement) =="
+# the elastic-cluster floor: kill -9 one of three durable+mirrored
+# workers while a query stream runs — every query must COMPLETE after
+# Hive lease-expiry + shard re-placement (standby image replayed onto a
+# survivor), hive/worker_dead and dq/retry_rerouted must be nonzero,
+# and .sys/cluster_nodes must converge to 2 alive / 1 dead
+JAX_PLATFORMS=cpu python scripts/chaos_gate.py
+crc=$?
+if [ "$crc" -ne 0 ]; then
+    echo "Hive chaos gate FAILED (rc=$crc)" >&2
+    exit "$crc"
+fi
+
+if [ "${CI_FULLSUITE:-0}" = "1" ]; then
+    echo "== full-suite single-process gate (segfault pin, nightly) =="
+    # VERDICT Weak #3 regression pin: the WHOLE suite (slow soaks
+    # included) in ONE pytest process, green and segfault-free. Minutes
+    # long — nightly only (CI_FULLSUITE=1).
+    JAX_PLATFORMS=cpu python scripts/fullsuite_gate.py
+    frc=$?
+    if [ "$frc" -ne 0 ]; then
+        echo "full-suite gate FAILED (rc=$frc)" >&2
+        exit "$frc"
+    fi
+fi
+
 echo "== CI green =="

@@ -20,22 +20,39 @@ def _read(pf: str) -> str:
         return f.read().strip()
 
 
+def mirror_dir(root, wid: int) -> str:
+    """The standby-image directory `spawn_workers(durable=True)` gives
+    worker `wid` — the Hive adopt hook replays it on a survivor."""
+    return os.path.join(str(root), f"mirror{wid}")
+
+
 def spawn_workers(root, n_workers: int, sf: float,
-                  startup_timeout: float = 180.0):
+                  startup_timeout: float = 180.0,
+                  durable: bool = False, hive_endpoint: str = None):
     """Start `n_workers` cluster_worker processes sharding TPC-H at
     `sf`. Returns (procs, ports) with procs = [(Popen, port_file)];
-    the caller owns teardown via `stop_workers(procs)`."""
+    the caller owns teardown via `stop_workers(procs)`.
+
+    `durable=True`: each worker runs on a durable store under `root`
+    with a synchronous standby mirror at `mirror_dir(root, wid)` —
+    the precondition for Hive shard re-placement. `hive_endpoint`:
+    workers push register/heartbeats there (`hive/agent.py`)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     env.pop("XLA_FLAGS", None)
     procs, ports = [], []
     try:
         for wid in range(n_workers):
             pf = os.path.join(str(root), f"port{wid}")
-            p = subprocess.Popen(
-                [sys.executable,
-                 os.path.join(REPO, "tests", "cluster_worker.py"),
-                 str(wid), str(n_workers), str(sf), pf],
-                env=env, cwd=REPO)
+            argv = [sys.executable,
+                    os.path.join(REPO, "tests", "cluster_worker.py"),
+                    str(wid), str(n_workers), str(sf), pf]
+            if durable:
+                argv += ["--data-dir",
+                         os.path.join(str(root), f"data{wid}"),
+                         "--mirror", mirror_dir(root, wid)]
+            if hive_endpoint:
+                argv += ["--hive", hive_endpoint]
+            p = subprocess.Popen(argv, env=env, cwd=REPO)
             procs.append((p, pf))
         deadline = time.time() + startup_timeout
         for (p, pf) in procs:
@@ -51,6 +68,115 @@ def spawn_workers(root, n_workers: int, sf: float,
         stop_workers(procs)
         raise
     return procs, ports
+
+
+def kill_worker(procs, idx: int) -> int:
+    """Chaos helper: SIGKILL worker `idx` (no shutdown, no flush — the
+    failure mode Hive failover exists for) and reap it. Returns the pid
+    so logs can name the victim."""
+    p, _pf = procs[idx]
+    pid = p.pid
+    if p.poll() is None:
+        p.kill()
+    p.wait(timeout=30)
+    return pid
+
+
+DRILL_SQL = ("select o_orderpriority, count(*) as n, "
+             "sum(l_extendedprice) as s from lineitem, orders "
+             "where l_orderkey = o_orderkey "
+             "group by o_orderpriority order by o_orderpriority")
+
+
+def chaos_drill(root, sf: float = 0.002, nw: int = 3, victim: int = 1,
+                queries: int = 4, lease_s: float = 5.0,
+                sql: str = DRILL_SQL, kill_delay_s: float = 0.3) -> dict:
+    """ONE copy of the kill -9 failover choreography, shared by
+    `tests/test_hive.py` and `scripts/chaos_gate.py`: boot `nw` durable
+    + mirrored workers with push heartbeat agents against a
+    router-hosted Hive (served over real gRPC), warm a shuffle-join
+    aggregate, then kill -9 `victim` while a `queries`-deep stream
+    runs. Returns a summary dict (results carry COMPLETION timestamps,
+    so `replacement_latency_ms` honestly spans the failover inside the
+    first post-kill query); every cluster resource is torn down before
+    returning."""
+    import threading
+    import time as _time
+
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.hive import Hive
+    from ydb_tpu.query import QueryEngine
+    from ydb_tpu.server import Client, serve
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    merge = QueryEngine(block_rows=1 << 16)
+
+    def adopt(shard, node, old_node):
+        # replay the image of the owner AT DEATH — after a chained
+        # failover the shard's rows live in its last owner's mirror,
+        # not its original home's
+        wid = int(old_node.node_id.lstrip("w"))
+        Client(node.endpoint).hive_adopt_shard(
+            mirror_dir(root, wid), tables=["lineitem", "orders"])
+
+    hive = Hive(lease_s=lease_s, adopt=adopt)
+    merge.hive = hive
+    hive_server, hive_port = serve(merge, port=0)
+    procs = []
+    before = GLOBAL.snapshot()
+    try:
+        procs, ports = spawn_workers(
+            root, nw, sf, durable=True,
+            hive_endpoint=f"127.0.0.1:{hive_port}")
+        deadline = _time.time() + 120
+        while len(hive.membership.nodes()) < nw:
+            if _time.time() > deadline:
+                raise RuntimeError("workers never registered with Hive")
+            _time.sleep(0.2)
+        c = ShardedCluster([f"127.0.0.1:{p}" for p in ports],
+                           merge_engine=merge, hive=hive)
+        c.key_columns["lineitem"] = ["l_orderkey", "l_linenumber"]
+        c.key_columns["orders"] = ["o_orderkey"]
+        c.replicated = {"customer", "nation", "region", "part",
+                        "partsupp", "supplier"}
+        want = c.query(sql)              # warm: nw alive, full coverage
+        results, errors = [], []
+
+        def stream():
+            for _ in range(queries):
+                try:
+                    df = c.query(sql)
+                    # timestamp AFTER completion: the first post-kill
+                    # entry then includes the failover it sat through
+                    results.append((_time.monotonic(), df))
+                except Exception as e:   # noqa: BLE001 — caller gates
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        th = threading.Thread(target=stream)
+        th.start()
+        _time.sleep(kill_delay_s)        # land the kill mid-stream
+        t_kill = _time.monotonic()
+        kill_worker(procs, victim)
+        th.join(timeout=300)
+        hung = th.is_alive()
+        nodes = merge.query("select state, count(*) as n from "
+                            "`.sys/cluster_nodes` group by state")
+        states = dict(zip(nodes.state, (int(v) for v in nodes.n)))
+        snap = GLOBAL.snapshot()
+        deltas = {k: snap.get(k, 0) - before.get(k, 0)
+                  for k in ("hive/worker_dead", "dq/retry_rerouted",
+                            "hive/shards_replaced")}
+        post = [t for (t, _g) in results if t > t_kill]
+        return {"want": want, "results": results, "errors": errors,
+                "hung": hung, "states": states,
+                "counter_deltas": deltas, "counters": snap,
+                "replacement_latency_ms":
+                    round((min(post) - t_kill) * 1000.0, 1)
+                    if post else None}
+    finally:
+        hive.stop_pulse()
+        hive_server.stop(grace=None)
+        stop_workers(procs)
 
 
 def stop_workers(procs) -> None:

@@ -1,11 +1,20 @@
 """Worker process for the cluster-router test: one engine, one shard.
 
 Usage: python tests/cluster_worker.py WID NWORKERS SF PORT_FILE
+           [--data-dir DIR] [--mirror DIR] [--hive HOST:PORT]
 
 Loads the deterministic TPC-H dataset (same seed as the test's oracle),
-keeps every `lineitem` row with index % NWORKERS == WID (the sharded
-fact), replicates the other tables (co-located joins), serves the
-ordinary gRPC front and writes the bound port to PORT_FILE.
+keeps every `lineitem`/`orders` row with index % NWORKERS == WID (the
+sharded facts), replicates the other tables (co-located joins), serves
+the ordinary gRPC front and writes the bound port to PORT_FILE.
+
+Hive-mode extras (`tests/test_hive.py`, `scripts/chaos_gate.py`):
+`--data-dir` makes the engine durable and `--mirror` ships every
+mutation synchronously to a standby image (`cluster/replica.py`), so a
+kill -9'd worker's shard can be ADOPTED by a survivor replaying that
+image; `--hive` starts a HeartbeatAgent pushing HiveRegister/
+HiveHeartbeat to the control-plane host (node id `w{WID}`, shard
+`shard-{WID}`).
 """
 
 import os
@@ -23,16 +32,26 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 
+def _opt(argv, flag):
+    if flag in argv:
+        return argv[argv.index(flag) + 1]
+    return None
+
+
 def main() -> None:
     wid, nw, sf, port_file = (int(sys.argv[1]), int(sys.argv[2]),
                               float(sys.argv[3]), sys.argv[4])
+    data_dir = _opt(sys.argv, "--data-dir")
+    mirror = _opt(sys.argv, "--mirror")
+    hive_ep = _opt(sys.argv, "--hive")
     from ydb_tpu.bench.tpch_gen import TPCH_SCHEMAS, TpchData
     from ydb_tpu.core.block import HostBlock
     from ydb_tpu.query import QueryEngine
     from ydb_tpu.server import serve
     from ydb_tpu.storage.mvcc import WriteVersion
 
-    eng = QueryEngine(block_rows=1 << 12)
+    eng = QueryEngine(block_rows=1 << 12, data_dir=data_dir,
+                      replica=mirror if mirror else None)
     data = TpchData(sf)
     # lineitem AND orders are sharded — by their OWN row index, so a
     # lineitem row's order usually lives on the OTHER worker: joining
@@ -60,6 +79,11 @@ def main() -> None:
         table.indexate()
 
     server, port = serve(eng, port=0)
+    if hive_ep:
+        from ydb_tpu.hive.agent import HeartbeatAgent
+        HeartbeatAgent(hive_ep, node_id=f"w{wid}",
+                       endpoint=f"127.0.0.1:{port}",
+                       shards=[f"shard-{wid}"], engine=eng).start()
     with open(port_file, "w") as f:
         f.write(str(port))
     while True:

@@ -46,14 +46,47 @@ class ShardedCluster:
     """Router over worker gRPC endpoints (one engine process per shard)."""
 
     def __init__(self, endpoints: list, merge_engine=None,
-                 dtx_log: Optional[str] = None, dtx_replica=None):
+                 dtx_log: Optional[str] = None, dtx_replica=None,
+                 hive=None, failover_rounds: int = 1):
+        """`hive`: a `ydb_tpu.hive.Hive` control plane. When attached,
+        the worker list is no longer static: each query consults the
+        Hive's placement (alive, non-stale workers), a transport-dead
+        worker triggers lease expiry + shard re-placement (the Hive's
+        adopt hook replays the shard's standby image onto a survivor),
+        and the statement re-lowers onto the surviving placement — up to
+        `failover_rounds` times — instead of erroring out."""
+        import threading
         from ydb_tpu.query import QueryEngine
         from ydb_tpu.server import Client
         self.workers = [ep if hasattr(ep, "execute") else Client(ep)
                         for ep in endpoints]
+        self.hive = hive
+        self.failover_rounds = failover_rounds
+        # endpoint -> worker cache: failover swaps the live list, but a
+        # surviving worker keeps its Client (gRPC channel reuse) or its
+        # in-process LocalWorker object
+        self._worker_pool = {w.endpoint: w for w in self.workers}
+        # the endpoint layout pk-hash insert routing was loaded against
+        # (the post-failover upsert refusal compares against it)
+        self._initial_endpoints = [w.endpoint for w in self.workers]
+        # placement barrier: queries arriving while a re-placement is in
+        # flight wait for it instead of racing a half-adopted shard;
+        # failovers themselves serialize on _fo_mu (two queries blaming
+        # the same dead worker run ONE re-placement, the second finds
+        # the lease already expired and just re-resolves placement)
+        self._placement_settled = threading.Event()
+        self._placement_settled.set()
+        # RLock: _failover holds it across _refresh_placement, and
+        # refresh itself takes it so sweep-driven (lease-expiry)
+        # adoption serializes with query traffic exactly like the
+        # observed-transport-error path
+        self._fo_mu = threading.RLock()
         # local engine used for the merge stage (schema-free: merge runs
         # over the gathered partial frame registered as a temp table)
         self.engine = merge_engine or QueryEngine(block_rows=1 << 16)
+        if hive is not None:
+            # the merge engine serves `.sys/cluster_nodes` off this hive
+            self.engine.hive = hive
         self.replicated: set = set()        # table names on every worker
         self.key_columns: dict = {}         # table -> [pk col]
         # durable coordinator decision log for cross-worker 2PC
@@ -105,6 +138,19 @@ class ShardedCluster:
         pk = self.key_columns.get(stmt.table)
         if not pk:
             raise ClusterError(f"unknown sharded table {stmt.table!r}")
+        if self.hive is not None and [w.endpoint for w in self.workers] \
+                != self._initial_endpoints:
+            # pk-hash routing is modulo the worker LIST — after a
+            # failover shrank/changed it, ANY routed write of an
+            # existing key can land beside a different worker's copy
+            # (duplicate, divergent pk rows; a worker-local dup-pk
+            # check cannot see the adopted copy). Refuse every mode
+            # loudly until placement-aware write routing exists
+            # (ROADMAP item 5c).
+            raise ClusterError(
+                f"{stmt.mode} into a sharded table after a topology "
+                "change is not supported yet (pk-hash routing would "
+                "diverge from the surviving placement)")
         if not stmt.columns:
             raise ClusterError("routed inserts need an explicit column "
                                "list (INSERT INTO t (cols...) VALUES ...)")
@@ -225,13 +271,98 @@ class ShardedCluster:
 
     def _lower(self, stmt: ast.Select):
         from ydb_tpu.dq.lower import DqLowerError, DqTopology, lower_select
-        topo = DqTopology(n_workers=len(self.workers),
-                          replicated=set(self.replicated),
-                          key_columns=dict(self.key_columns))
+        if self.hive is not None:
+            topo = DqTopology.from_hive(
+                self.hive, replicated=set(self.replicated),
+                key_columns=dict(self.key_columns))
+        else:
+            topo = DqTopology(n_workers=len(self.workers),
+                              replicated=set(self.replicated),
+                              key_columns=dict(self.key_columns))
         try:
             return lower_select(stmt, topo, self._table_columns)
         except DqLowerError as e:
             raise ClusterError(str(e)) from e
+
+    # -- Hive placement / failover -----------------------------------------
+
+    def _client_for(self, endpoint: str):
+        from ydb_tpu.server import Client
+        w = self._worker_pool.get(endpoint)
+        if w is None:
+            w = self._worker_pool[endpoint] = Client(endpoint)
+        return w
+
+    def _refresh_placement(self) -> None:
+        """Rebuild the live worker list from the Hive's placement (alive,
+        non-stale shard owners). Endpoints the router already knows keep
+        their RELATIVE order (push agents race to register, and the
+        operator's endpoint order is what pk-hash insert routing was
+        loaded against — a silent reorder would re-route writes); only
+        genuinely new endpoints append. No-op without a hive — the
+        static endpoint list stays authoritative."""
+        if self.hive is None:
+            return
+        with self._fo_mu:
+            # under the failover lock: a lease-expiry sweep can run the
+            # seconds-long image replay inline, and concurrent queries
+            # must serialize behind it here (the same hold the
+            # _failover path gives observed transport deaths) instead
+            # of racing a half-adopted shard into a spurious error
+            self.hive.sweep()
+            alive = set(self.hive.query_endpoints())
+            if not alive:
+                return
+            cur = [w.endpoint for w in self.workers]
+            eps = [ep for ep in cur if ep in alive] \
+                + [ep for ep in self.hive.query_endpoints()
+                   if ep not in cur]
+            if eps != cur:
+                self.workers = [self._client_for(ep) for ep in eps]
+
+    def _probe_lost(self, hint=(), kinds=None) -> list:
+        """Which workers are transport-dead RIGHT NOW? The runner's view
+        (`hint`) can blame a live sender whose peer died mid-frame, so
+        every worker is ping-probed and the probe decides — EXCEPT for
+        hang-shaped failures (`kinds[ep] == "timeout"`): a wedged worker
+        still answers ping, so its RPC deadline is the only honest
+        signal. A transient connection blip on a now-healthy worker
+        must NOT evict it (eviction marks a rejoiner stale — an
+        operator-level cost)."""
+        from concurrent.futures import ThreadPoolExecutor
+        kinds = kinds or {}
+
+        def probe(w):
+            try:
+                return None if w.ping(timeout=5) else w.endpoint
+            except Exception:                # noqa: BLE001 — dead is dead
+                return w.endpoint
+        with ThreadPoolExecutor(max_workers=len(self.workers)) as pool:
+            lost = [ep for ep in pool.map(probe, self.workers)
+                    if ep is not None]
+        here = {w.endpoint for w in self.workers}
+        # conscious trade-off: the "timeout" hint evicts a worker whose
+        # RPC blew its deadline even though ping succeeds — that is the
+        # wedged-engine shape. It assumes rpc_timeout (default 600 s)
+        # is far above honest query time; operators who tighten it opt
+        # into aggressive failover of merely-slow workers.
+        return lost or [ep for ep in hint
+                        if ep in here and kinds.get(ep) == "timeout"]
+
+    def _failover(self, lost: list) -> None:
+        """Expire the dead workers' leases, re-place their shards (the
+        Hive's adopt hook replays each shard's standby image onto a
+        survivor), and swap the worker list. Concurrent queries hold at
+        the placement barrier while this runs."""
+        from ydb_tpu.utils.metrics import GLOBAL
+        with self._fo_mu:
+            self._placement_settled.clear()
+            try:
+                self.hive.fail_workers(lost)
+                GLOBAL.inc("dq/retry_rerouted")
+                self._refresh_placement()
+            finally:
+                self._placement_settled.set()
 
     def plan(self, sql: str):
         """Lower a SELECT to its DQ stage graph without running it
@@ -252,6 +383,7 @@ class ShardedCluster:
         `EXPLAIN ANALYZE <select>` returns the distributed profile: the
         stage graph, per-(stage, worker) task stats (rows/bytes/frames/
         waits) and the assembled span tree, as a one-column frame."""
+        from ydb_tpu.utils.metrics import GLOBAL
         stmt = parse(sql)
         if isinstance(stmt, ast.Explain):
             if not isinstance(stmt.query, ast.Select):
@@ -260,8 +392,45 @@ class ShardedCluster:
         if not isinstance(stmt, ast.Select):
             raise ClusterError("the router distributes SELECT; use "
                                "execute() for DDL/DML")
-        df, _runner = self._run_traced(stmt, sql)
-        return df
+        from ydb_tpu.dq.lower import table_names
+        refs = table_names(stmt.relation) if stmt.relation is not None \
+            else []
+        if refs and all(t.startswith(".sys/") for t in refs):
+            # sysviews are router-local runtime state (`.sys/
+            # cluster_nodes` reads THIS router's hive) — scattering them
+            # over workers would be wrong twice over
+            return self.engine.query(sql)
+        if not self._placement_settled.is_set():
+            # a re-placement is in flight: hold admission until the
+            # adopted shard is queryable rather than racing it
+            GLOBAL.inc("hive/failover_holds")
+            self._placement_settled.wait(timeout=120)
+        rounds = self.failover_rounds if self.hive is not None else 0
+        for round_ in range(rounds + 1):
+            self._refresh_placement()
+            try:
+                df, _runner = self._run_traced(stmt, sql)
+                return df
+            except ClusterError as e:
+                if self.hive is None or round_ >= rounds:
+                    raise
+                lost = self._probe_lost(getattr(e, "lost_workers", ()),
+                                        getattr(e, "lost_kinds", None))
+                if not lost:
+                    if self.hive.orphaned_shards():
+                        # a concurrent failover is mid-re-placement (or
+                        # a failed replay awaits its sweep retry): wait
+                        # it out and re-resolve rather than failing a
+                        # query a second earlier would have answered
+                        GLOBAL.inc("hive/failover_holds")
+                        self._placement_settled.wait(timeout=120)
+                        with self._fo_mu:
+                            pass       # drain any active failover
+                        continue
+                    raise              # a query error, not a dead worker
+                self._failover(lost)
+        raise AssertionError("unreachable: the failover loop returns a "
+                             "frame or raises")
 
     def _run_traced(self, stmt: ast.Select, sql: str,
                     force_trace: bool = False, graph=None):
@@ -270,6 +439,13 @@ class ShardedCluster:
         from ydb_tpu.dq.runner import DqError, DqTaskRunner
         from ydb_tpu.utils.metrics import GLOBAL_HIST
         if graph is None:
+            graph = self._lower(stmt)
+        elif self.hive is not None \
+                and graph.placement_epoch != self.hive.epoch:
+            # a pre-lowered graph (EXPLAIN ANALYZE reuses the one it
+            # printed) whose placement went stale would task dead
+            # workers / the wrong peer count — re-lower on the current
+            # epoch instead
             graph = self._lower(stmt)
         runner = DqTaskRunner(self.workers, self.engine)
         eng = self.engine
@@ -285,7 +461,14 @@ class ShardedCluster:
             rows_out = len(df)
             return df, runner
         except DqError as e:
-            raise ClusterError(str(e)) from e
+            ce = ClusterError(str(e))
+            # the failover loop reads which endpoints died at the
+            # transport level (DqWorkerLost and accumulated task errors)
+            ce.lost_workers = sorted(
+                set(getattr(e, "endpoints", ()))
+                | runner.transport_failed)
+            ce.lost_kinds = dict(runner.transport_kinds)
+            raise ce from e
         finally:
             total_ms = (_time.perf_counter() - t0) * 1000.0
             if rows_out is not None:

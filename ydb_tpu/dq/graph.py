@@ -87,6 +87,10 @@ class StageGraph:
     stages: list = field(default_factory=list)
     channels: dict = field(default_factory=dict)
     tag: str = ""
+    # Hive placement epoch this graph was lowered against (0 = static
+    # topology) — a failover re-lowers, so a rerun graph carries the
+    # epoch whose worker set it actually tasks
+    placement_epoch: int = 0
 
     def stage(self, sid: str) -> Stage:
         for s in self.stages:

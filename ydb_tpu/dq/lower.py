@@ -41,10 +41,35 @@ class DqLowerError(Exception):
 
 @dataclass
 class DqTopology:
-    """What the lowering needs to know about the cluster."""
+    """What the lowering needs to know about the cluster. With a Hive
+    attached (`from_hive`), the worker count comes from the CURRENT
+    placement — alive, non-stale shard owners — instead of a static
+    endpoint list, and the graph is stamped with the placement epoch it
+    was lowered against (a failed run re-lowers against the next one)."""
     n_workers: int
     replicated: set = field(default_factory=set)
     key_columns: dict = field(default_factory=dict)  # sharded: table -> pk
+    placement_epoch: int = 0
+
+    @classmethod
+    def from_hive(cls, hive, replicated=(), key_columns=None
+                  ) -> "DqTopology":
+        orphans = hive.orphaned_shards()
+        if orphans:
+            # refusing beats silently returning a partial scan: these
+            # shards' rows are unreachable until a re-placement (sweep
+            # retries the image replay) or an operator intervenes
+            raise DqLowerError(
+                f"shard(s) {orphans} have no live owner — re-placement "
+                "pending or failed; refusing a silently-partial scan")
+        eps = hive.query_endpoints()
+        if not eps:
+            raise DqLowerError(
+                "no alive shard-owning workers in the Hive placement — "
+                "the cluster has no queryable topology")
+        return cls(n_workers=len(eps), replicated=set(replicated),
+                   key_columns=dict(key_columns or {}),
+                   placement_epoch=hive.epoch)
 
 
 # -- AST helpers (moved from cluster/router.py — shared by lowerings) ------
@@ -349,7 +374,9 @@ def lower_select(sel: ast.Select, topo: DqTopology,
         b.stages.append(Stage(id="merge", inputs=[ch.id], on="router"))
     else:
         _lower_two_phase(b, sel, inputs=[])
-    return b.graph()
+    g = b.graph()
+    g.placement_epoch = topo.placement_epoch
+    return g
 
 
 def _lower_two_phase(b: _Builder, sel: ast.Select, inputs: list) -> None:

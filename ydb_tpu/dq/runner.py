@@ -35,6 +35,51 @@ class DqError(Exception):
     pass
 
 
+class DqWorkerLost(DqError):
+    """A task's worker is gone at the TRANSPORT level (connection
+    refused/reset, RPC deadline): its shard cannot re-run anywhere
+    without re-placement, so the runner surfaces the loss immediately
+    instead of burning `stage_retries` into timeouts against a corpse.
+    The router's Hive failover (`cluster/router.py`) catches this,
+    re-places the dead worker's shards, and re-lowers the statement
+    onto the surviving placement."""
+
+    def __init__(self, msg: str, endpoints=()):
+        super().__init__(msg)
+        self.endpoints = sorted(endpoints)
+
+
+def _is_transport_error(e) -> bool:
+    """Transport-level failure (the worker process/link, not the query):
+    gRPC channel errors and socket-level exceptions. In-band worker
+    errors arrive as RuntimeError from the Client wrapper and are NOT
+    transport — they retry on the same worker like before."""
+    try:
+        import grpc
+        if isinstance(e, grpc.RpcError):
+            return True
+    except ImportError:
+        pass
+    return isinstance(e, (ConnectionError, TimeoutError, OSError))
+
+
+def _transport_kind(e) -> str:
+    """'timeout' (hang-shaped: the worker may still answer ping, which
+    is exactly why the router's probe must trust this hint) vs
+    'unavailable' (connection-level: the probe can verify it)."""
+    if isinstance(e, TimeoutError):
+        return "timeout"
+    try:
+        import grpc
+        if isinstance(e, grpc.RpcError) and \
+                getattr(e, "code", lambda: None)() == \
+                grpc.StatusCode.DEADLINE_EXCEEDED:
+            return "timeout"
+    except ImportError:
+        pass
+    return "unavailable"
+
+
 class DqTaskRunner:
     def __init__(self, workers: list, engine, counters=None,
                  stage_retries: int = 1, rpc_timeout: float = None):
@@ -50,9 +95,24 @@ class DqTaskRunner:
         # `dq_stage_stats` ring (`.sys/dq_stage_stats`) after the run
         self.stage_stats: list = []
         self._input_waits: dict = {}         # (stage id, widx) -> ms
+        # endpoints whose last RPC died at the transport level: later
+        # attempts/stages skip them (reroute single-task stages, raise
+        # DqWorkerLost for per-shard ones) instead of re-timing-out —
+        # the router reads this (with per-endpoint failure kinds) to
+        # drive Hive failover
+        self.transport_failed: set = set()
+        self.transport_kinds: dict = {}      # endpoint -> timeout|unavailable
         for w in self.workers:
             if hasattr(w, "bind_peers"):
-                w.bind_peers(self.workers)
+                try:
+                    w.bind_peers(self.workers)
+                except Exception as e:       # noqa: BLE001 — a worker
+                    # already dead at bind time is an early transport
+                    # failure, surfaced when its first task runs
+                    if _is_transport_error(e):
+                        self.transport_failed.add(w.endpoint)
+                    else:
+                        raise
 
     # -- tracing helpers ----------------------------------------------------
 
@@ -98,16 +158,28 @@ class DqTaskRunner:
     # -- worker stages ------------------------------------------------------
 
     def _task_workers(self, stage) -> list:
+        """Workers to task for a stage, honoring transport-dead skips.
+        A single-task stage (`worker0`: replicated-only data, every
+        worker holds a full copy) REROUTES onto the first live worker —
+        the one correctness-preserving reroute without re-placement. A
+        per-shard stage must task every worker; a dead one among them is
+        a worker-lost condition, not a reroute."""
         if stage.on == "worker0":
-            return [(0, self.workers[0])]
+            for (i, w) in enumerate(self.workers):
+                if w.endpoint not in self.transport_failed:
+                    return [(i, w)]
+            raise DqWorkerLost(
+                f"stage {stage.id}: no live worker for single-task "
+                f"stage (all {len(self.workers)} transport-failed)",
+                endpoints=self.transport_failed)
         return list(enumerate(self.workers))
 
     def _run_worker_stage(self, graph, stage) -> None:
         from ydb_tpu.utils.metrics import GLOBAL_HIST
         self.counters.inc("dq/stages")
         t_stage = time.perf_counter()
-        tws = self._task_workers(stage)
-        with self._span("dq-stage", stage=stage.id, tasks=len(tws)):
+        with self._span("dq-stage", stage=stage.id,
+                        tasks=len(self._task_workers(stage))):
             self._materialize_inputs(graph, stage)
             specs = []
             for cid in stage.outputs:
@@ -115,13 +187,7 @@ class DqTaskRunner:
                 specs.append({"channel": ch.id, "kind": ch.kind,
                               "key": ch.key, "n_peers": len(self.workers),
                               "peers": [w.endpoint for w in self.workers]})
-            tasks = {i: {"task": f"{graph.tag}.{stage.id}.w{i}",
-                         "stage": stage.id, "worker": w.endpoint,
-                         "state": "pending", "attempts": 0}
-                     for (i, w) in tws}
-            self.task_log.extend(tasks.values())
-            results = self._run_stage_attempts(graph, stage, tws, tasks,
-                                               specs)
+            results, tasks = self._run_stage_attempts(graph, stage, specs)
         # success-only, matching the router stage and query/latency_ms:
         # a timed-out stage would inject an rpc-timeout artifact
         GLOBAL_HIST.observe("dq/stage_ms",
@@ -141,18 +207,44 @@ class DqTaskRunner:
             self.counters.inc("dq/frames", resp.get("frames_shipped", 0))
             self._note_task_stats(graph, stage, tasks[i], resp, i)
 
-    def _run_stage_attempts(self, graph, stage, tws, tasks, specs):
+    def _run_stage_attempts(self, graph, stage, specs):
         """The pending → running → finished/failed attempt loop. Every
         ATTEMPT of every task gets its own span in the router's tree
         (`attach_span` — the span object lives on the trace-owning
         thread, pool threads stamp duration/outcome), and a finishing
-        task's worker-recorded spans ingest under its attempt span."""
+        task's worker-recorded spans ingest under its attempt span.
+        Returns (results, tasks). The worker set is re-resolved per
+        attempt: a transport-dead worker is skipped (a single-task stage
+        reroutes onto a live one, counted `dq/retry_rerouted`)."""
         from concurrent.futures import ThreadPoolExecutor
         tracer = self.tracer
         # propagation context captured HERE, on the trace-owning thread
         # (the pool threads below have no thread-local trace open)
         base_ctx = tracer.current() if tracer is not None else None
+        tasks: dict = {}
+        prev_eps = None
         for attempt in range(self.stage_retries + 1):
+            tws = self._task_workers(stage)
+            eps = {w.endpoint for (_i, w) in tws}
+            if (prev_eps is not None and eps - prev_eps) or \
+                    (attempt == 0 and stage.on == "worker0"
+                     and tws[0][0] != 0):
+                # this attempt runs on workers the last one would not
+                # have — the single-task stage rerouted off a dead
+                # worker (mid-stage, or pre-marked at bind time)
+                self.counters.inc("dq/retry_rerouted",
+                                  max(1, len(eps - (prev_eps or set()))))
+            prev_eps = eps
+            for (i, w) in tws:
+                if i not in tasks:
+                    # attempts counts THIS task's own runs (a task
+                    # created by a mid-stage reroute starts at 0, not
+                    # at the stage's attempt index — its stats must not
+                    # blame retries on the healthy worker)
+                    tasks[i] = {"task": f"{graph.tag}.{stage.id}.w{i}",
+                                "stage": stage.id, "worker": w.endpoint,
+                                "state": "pending", "attempts": 0}
+                    self.task_log.append(tasks[i])
             task_spans = {}
             if tracer is not None:
                 for (i, w) in tws:
@@ -163,7 +255,8 @@ class DqTaskRunner:
             def one(iw):
                 i, w = iw
                 t = tasks[i]
-                t["state"], t["attempts"] = "running", attempt + 1
+                t["state"] = "running"
+                t["attempts"] = t.get("attempts", 0) + 1
                 self.counters.inc("dq/tasks")
                 sp = task_spans.get(i)
                 t0 = time.perf_counter()
@@ -211,7 +304,26 @@ class DqTaskRunner:
                             if sp is not None else None)
             failed = [(i, e) for (i, _r, e) in results if e is not None]
             if not failed:
-                return results
+                return results, tasks
+            transport = [(i, e) for (i, e) in failed
+                         if _is_transport_error(e)]
+            for (i, e) in transport:
+                self.transport_failed.add(tasks[i]["worker"])
+                self.transport_kinds[tasks[i]["worker"]] = \
+                    _transport_kind(e)
+            if transport and stage.on != "worker0":
+                # a per-shard stage lost a worker: its shard cannot
+                # re-run elsewhere without re-placement — surface the
+                # loss NOW (Hive failover re-lowers onto survivors)
+                # instead of resending into the corpse every attempt
+                names = ", ".join(f"{tasks[i]['worker']} "
+                                  f"({tasks[i].get('error', '?')[:120]})"
+                                  for (i, _e) in transport)
+                raise DqWorkerLost(
+                    f"stage {stage.id} failed after {attempt + 1} "
+                    f"attempt(s) on: {names} — worker lost (transport); "
+                    f"needs re-placement",
+                    endpoints=self.transport_failed)
             # stage-level retry: drop the half-delivered output channels
             # everywhere reachable, then re-run every task of the stage
             # under a new attempt id
@@ -285,11 +397,18 @@ class DqTaskRunner:
                 except Exception as e:       # noqa: BLE001 — one surface:
                     # a worker lost at the barrier must raise DqError so
                     # the router maps it to ClusterError like every other
-                    # failure mode
-                    raise DqError(
-                        f"channel {_ch.id} barrier failed on "
-                        f"{w.endpoint}: {type(e).__name__}: "
-                        f"{str(e)[:200]}") from e
+                    # failure mode; transport-level loss marks the worker
+                    # for Hive failover like a task failure would
+                    msg = (f"channel {_ch.id} barrier failed on "
+                           f"{w.endpoint}: {type(e).__name__}: "
+                           f"{str(e)[:200]}")
+                    if _is_transport_error(e):
+                        self.transport_failed.add(w.endpoint)
+                        self.transport_kinds[w.endpoint] = \
+                            _transport_kind(e)
+                        raise DqWorkerLost(
+                            msg, endpoints=self.transport_failed) from e
+                    raise DqError(msg) from e
             with ThreadPoolExecutor(max_workers=len(tws)) as pool:
                 opens = list(pool.map(open_one, tws))
             for (i, endpoint, resp) in opens:
@@ -498,5 +617,13 @@ class LocalWorker:
     def counters(self) -> dict:
         return self.engine.counters()
 
-    def ping(self) -> bool:
+    def hive_adopt_shard(self, root: str, tables=None,
+                         timeout=None) -> dict:
+        """Replay a dead peer's shard image into this worker's tables
+        (the HiveAdoptShard RPC surface, in-process)."""
+        from ydb_tpu.hive.adopt import adopt_shard
+        return {"ok": True,
+                "copied": adopt_shard(self.engine, root, tables)}
+
+    def ping(self, timeout=None) -> bool:
         return True

@@ -1,0 +1,32 @@
+"""Hive — the cluster control plane (membership, placement, failover).
+
+The reference's Hive tablet + StateStorage seats (`hive_impl.h`,
+`statestorage.cpp`), radically simplified into three cooperating parts:
+
+  * membership   (`hive/membership.py`) — workers hold leases renewed by
+                 heartbeats (push agents or router pull); expiry = dead;
+  * placement    (`hive/placement.py`) — a deterministic capacity- and
+                 load-aware shard→worker map, stable while owners live;
+  * failover     (`hive/core.py` re-placement over `hive/adopt.py` image
+                 replay; `hive/election.py` lease-elected router/standby
+                 leadership).
+
+Observability: `hive/*` counters on /counters and the
+`.sys/cluster_nodes` sysview (`scheme/sysview.py`).
+"""
+
+from ydb_tpu.hive.adopt import adopt_shard
+from ydb_tpu.hive.agent import HeartbeatAgent
+from ydb_tpu.hive.core import Hive, HiveError
+from ydb_tpu.hive.election import (LeaseElection, LeaseFile,
+                                   promote_when_elected)
+from ydb_tpu.hive.membership import ALIVE, DEAD, HiveMembership, NodeInfo
+from ydb_tpu.hive.placement import (PlacementMap, rebalance,
+                                    stage_load_signal)
+
+__all__ = [
+    "ALIVE", "DEAD", "HeartbeatAgent", "Hive", "HiveError",
+    "HiveMembership", "LeaseElection", "LeaseFile", "NodeInfo",
+    "PlacementMap", "adopt_shard", "promote_when_elected", "rebalance",
+    "stage_load_signal",
+]

@@ -1,0 +1,90 @@
+"""Shard adoption — boot a dead worker's shard image on a survivor.
+
+Every worker mirrors its durable store synchronously to a standby image
+(`cluster/replica.py`: an ack'd commit is on both sides), so a dead
+worker's rows are fully present in its mirror directory. Re-placement
+replays that image into the adopting worker's OWN tables: boot a
+QueryEngine from the image root (ordinary crash recovery — the standby
+IS a crash image), read each sharded table, and commit the rows into
+the survivor's catalog under a fresh plan step. After the replay the
+survivor's local scan covers both its original shard and the adopted
+one, so re-lowered DQ stage programs need no shard awareness at all.
+
+The copy reserves the engine's memory admission for each table's
+working set — an adoption racing live traffic queues like any big
+query instead of blowing the device budget (kqp_rm_service stance).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def adopt_shard(engine, image_root: str, tables=None) -> dict:
+    """Replay the shard image at `image_root` into `engine`'s tables.
+    `tables`: the sharded table names to absorb (replicated tables are
+    already everywhere — copying them would double-count). Returns
+    {table: rows_copied}."""
+    from ydb_tpu.core.block import HostBlock
+    from ydb_tpu.query import QueryEngine
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    img = QueryEngine(block_rows=1 << 12, data_dir=image_root)
+    copied: dict = {}
+    # idempotency guard: tables commit one-by-one, so a partial failure
+    # (or an RPC retry after a lost reply) re-enters here — tables that
+    # already landed must NOT replay again (silent row duplication).
+    # Per-process scope matches the retry path (the Hive re-asks the
+    # same worker process); a survivor that crashes MID-adoption keeps
+    # its partial rows durably and must be re-imaged, not re-adopted.
+    done = engine.__dict__.setdefault("_hive_adopted", set())
+    root_key = os.path.realpath(image_root)
+    for name in tables or sorted(img.catalog.tables):
+        if not img.catalog.has(name) or not engine.catalog.has(name):
+            continue
+        if (root_key, name) in done:
+            copied[name] = 0
+            continue
+        df = img.query(f"select * from {name}")
+        if not len(df):
+            copied[name] = 0
+            continue
+        t = engine.catalog.table(name)
+        enc = {}
+        valids = {}
+        est = 0
+        for c in t.schema:
+            a = df[c.name].to_numpy()
+            if c.dtype.is_string:
+                # encode under the DEST table's dictionaries — the image
+                # engine's codes mean nothing here
+                enc[c.name] = t.dictionaries[c.name].encode_bulk(
+                    np.asarray(a, dtype=object))
+            else:
+                if a.dtype == object:
+                    # nullable column decoded to objects: None → NaN/0
+                    # with an explicit validity mask
+                    valid = np.array([v is not None for v in a])
+                    fill = np.where(valid, a, 0)
+                    enc[c.name] = np.asarray(fill.tolist(),
+                                             dtype=c.dtype.np)
+                    valids[c.name] = valid
+                else:
+                    enc[c.name] = np.asarray(a, dtype=c.dtype.np)
+            est += int(getattr(enc[c.name], "nbytes", 0))
+        block = HostBlock.from_arrays(t.schema, enc,
+                                      valids=valids or None,
+                                      dictionaries=dict(t.dictionaries))
+        # admission: the replay's upload/scan growth competes with live
+        # queries — reserve like any statement would
+        with engine.admission.admit(est):
+            writes = t.write(block)
+            t.commit(writes, engine._next_version())
+            t.indexate()
+        done.add((root_key, name))
+        copied[name] = len(df)
+        GLOBAL.inc("hive/adopted_rows", len(df))
+    GLOBAL.inc("hive/shards_adopted")
+    return copied

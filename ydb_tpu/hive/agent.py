@@ -1,0 +1,96 @@
+"""Worker-side heartbeat agent — the push half of the lease protocol.
+
+Runs on every worker process: registers with the Hive host's
+HiveRegister RPC (retrying until the Hive is up — boot order must not
+matter), then renews at lease/3 via HiveHeartbeat, carrying the
+worker's load signal (mean DQ task wall from its own stage stats). A
+`{register: true}` reply means the Hive restarted and lost volatile
+membership — the agent re-registers and carries on. Loss of the Hive
+endpoint is survivable noise: the agent keeps retrying, and the worker
+keeps serving whatever traffic still reaches it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class HeartbeatAgent:
+    def __init__(self, hive_endpoint: str, node_id: str, endpoint: str,
+                 shards=(), capacity: float = 1.0, engine=None,
+                 token: str = "", interval_s: float = None):
+        self.hive_endpoint = hive_endpoint
+        self.node_id = node_id
+        self.endpoint = endpoint
+        self.shards = list(shards)
+        self.capacity = float(capacity)
+        self.engine = engine             # load signal source (optional)
+        self.token = token
+        self.interval_s = interval_s     # None: lease/3 from register
+        self._stop = threading.Event()
+        self._thread = None
+        self.registered = False
+
+    def _client(self):
+        from ydb_tpu.server import Client
+        return Client(self.hive_endpoint, token=self.token)
+
+    def _load(self):
+        if self.engine is None:
+            return None
+        from ydb_tpu.hive.placement import stage_load_signal
+        sig = stage_load_signal(self.engine)
+        if sig:
+            # a worker only knows its own wall; any recorded key is it
+            return next(iter(sig.values()))
+        # workers don't run the router-side DqTaskRunner, so their
+        # dq_stage_stats ring stays empty — but every DQ stage program
+        # executes through engine.execute, which feeds the process-wide
+        # statement-latency histogram: its mean IS this worker's wall
+        from ydb_tpu.utils.metrics import GLOBAL_HIST
+        h = GLOBAL_HIST.get("query/latency_ms")
+        if h is not None and h.count:
+            return h.sum / h.count
+        return None
+
+    def _loop(self) -> None:
+        client = None
+        interval = self.interval_s or 1.0
+        while not self._stop.is_set():
+            try:
+                if client is None:
+                    client = self._client()
+                if not self.registered:
+                    resp = client.hive_register(
+                        endpoint=self.endpoint, node_id=self.node_id,
+                        capacity=self.capacity, shards=self.shards)
+                    self.registered = True
+                    if self.interval_s is None:
+                        interval = max(0.2,
+                                       float(resp.get("lease_s", 3.0))
+                                       / 3.0)
+                else:
+                    resp = client.hive_heartbeat(self.node_id,
+                                                 load=self._load())
+                    if resp.get("register"):
+                        self.registered = False
+                        continue            # re-register immediately
+            except Exception:                # noqa: BLE001 — hive may be
+                client = None                # down/restarting; keep going
+                self.registered = False
+            self._stop.wait(interval)
+
+    def start(self) -> "HeartbeatAgent":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"hive-agent-{self.node_id}")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -1,0 +1,248 @@
+"""The Hive — cluster control plane: membership × placement × failover.
+
+The analog of the reference's Hive tablet (`hive_impl.h`): it owns which
+worker serves which shard, notices workers dying (lease expiry or an
+observed transport error), and re-places the dead worker's shards onto
+survivors. Re-placement is DATA movement here: every worker mirrors its
+durable store synchronously to a standby image (`cluster/replica.py`),
+so "move shard S to node V" = "replay S's image into V's tables" — the
+`adopt` hook, typically `hive/adopt.py:adopt_shard` over the mirror
+root (in-process) or the worker's HiveAdoptShard RPC (OS cluster).
+
+The router consults `query_endpoints()` instead of a static endpoint
+list (`cluster/router.py`), and the DQ lowering reads the same placement
+through `DqTopology.from_hive` (`dq/lower.py`) — a graph is lowered
+against an epoch, and a failed run re-lowers against the next one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ydb_tpu.hive.membership import ALIVE, HiveMembership
+from ydb_tpu.hive.placement import PlacementMap, rebalance
+
+
+class HiveError(Exception):
+    pass
+
+
+class Hive:
+    def __init__(self, lease_s: float = 3.0, clock=time.monotonic,
+                 adopt=None, counters=None, move_on_join: bool = False):
+        """`adopt(shard_id, node: NodeInfo, old_node: NodeInfo|None) ->
+        None`: make `node` serve `shard_id`'s rows by replaying the
+        image of `old_node` — the owner AT DEATH, whose standby mirror
+        is where the shard's rows (original or previously adopted)
+        actually live. A raising hook REVERTS the move — a shard the
+        survivor did not actually absorb must stay visibly orphaned
+        (queries fail loudly) rather than silently losing its rows from
+        every result."""
+        from ydb_tpu.utils.metrics import GLOBAL
+        self.membership = HiveMembership(lease_s=lease_s, clock=clock,
+                                         counters=counters)
+        self.placement = PlacementMap()
+        self.adopt = adopt
+        self.counters = counters or GLOBAL
+        self.move_on_join = move_on_join
+        self._mu = threading.Lock()          # placement transitions
+        self._adopting: set = set()          # shards mid-replay
+        # failed replays back off before the sweep retries them — a
+        # persistently failing adopt hook must not re-run its
+        # seconds-long image replay inline in EVERY query's sweep
+        self.adopt_retry_s = max(2.0, float(lease_s))
+        self._adopt_backoff: dict = {}       # shard -> earliest retry
+        self._pulse_thread = None
+        self._pulse_stop = threading.Event()
+
+    # -- worker lifecycle ---------------------------------------------------
+
+    def register_worker(self, endpoint: str, node_id: str = "",
+                        capacity: float = 1.0, shards=()) -> dict:
+        """Register a worker and claim its declared shards (first claim
+        wins; a re-placed shard is NOT handed back to a rejoiner — its
+        rows now live on the adopter)."""
+        resp = self.membership.register(endpoint, node_id=node_id,
+                                        capacity=capacity, shards=shards)
+        nid = resp["node_id"]
+        with self._mu:
+            changed = False
+            for s in shards:
+                if s not in self.placement.assign:
+                    self.placement.assign[s] = nid
+                    changed = True
+            if changed:
+                self.placement.epoch += 1
+            self._sync_node_shards_locked()
+        self.counters.set("hive/placement_epoch", self.placement.epoch)
+        resp["shards"] = self.placement.shards_of(nid)
+        return resp
+
+    def heartbeat(self, node_id: str, load: float = None) -> dict:
+        return self.membership.heartbeat(node_id, load=load)
+
+    # -- liveness / failover ------------------------------------------------
+
+    def sweep(self) -> list:
+        """Lease-expiry pass: newly dead workers lose their shards to
+        survivors (the failover path nothing has to trigger — a worker
+        that silently wedges is re-placed within one lease)."""
+        newly = self.membership.sweep()
+        if newly or self._has_orphans():
+            # orphans: shards whose adopt hook failed on a previous pass
+            # stay pointed at their dead owner — every sweep retries them
+            self._replace(newly)
+        return newly
+
+    def _has_orphans(self) -> bool:
+        alive = {n.node_id for n in self.membership.alive()
+                 if not n.stale}
+        return any(nid not in alive
+                   for nid in self.placement.assign.values())
+
+    def fail_workers(self, endpoints) -> list:
+        """Observed-dead fast path (the query saw a transport error):
+        expire the lease NOW and re-place."""
+        newly = self.membership.expire(endpoints)
+        if newly:
+            self._replace(newly)
+        return newly
+
+    def _replace(self, dead_nodes: list) -> list:
+        """Move every dead node's shards onto surviving placement. The
+        adopt hook runs OUTSIDE the lock (image replay takes seconds;
+        heartbeats must keep landing), guarded by an in-flight set so
+        concurrent sweeps never double-replay a shard."""
+        candidates = [n for n in self.membership.alive() if not n.stale]
+        now = self.membership.clock()
+        with self._mu:
+            shards = set(self.placement.assign)
+            new = rebalance(self.placement.assign, shards, candidates,
+                            move_on_join=self.move_on_join)
+            live = {n.node_id for n in candidates}
+            moves = [(s, self.placement.assign.get(s), nid)
+                     for s, nid in new.items()
+                     if self.placement.assign.get(s) != nid
+                     and s not in self._adopting
+                     and self._adopt_backoff.get(s, 0.0) <= now
+                     # NEVER move a shard off a LIVE owner through the
+                     # adoption path: mirrors are worker-granular and
+                     # adoption never deletes from the source, so a
+                     # leveling move would leave the rows counted on
+                     # BOTH nodes (move_on_join leveling is advisory
+                     # until shard-granular movement exists)
+                     and self.placement.assign.get(s) not in live]
+            # CO-LOCATE a dead owner's shards on one target: the shard
+            # image is the OWNER's mirror (it holds every shard that
+            # worker served, adopted ones included), so splitting its
+            # shards across targets would replay overlapping images —
+            # the same rows landing on two survivors
+            target_of: dict = {}
+            for (s, old, nid) in sorted(moves, key=lambda m: str(m[0])):
+                target_of.setdefault(old, nid)
+            planned = [(s, old, target_of[old]) for (s, old, _n) in moves]
+            self._adopting.update(s for (s, _o, _n) in planned)
+        done = []
+        for (s, old, nid) in planned:
+            node = self.membership.get(nid)
+            try:
+                if self.adopt is not None:
+                    self.adopt(s, node,
+                               self.membership.get(old)
+                               if old is not None else None)
+                done.append((s, old, nid))
+                self._adopt_backoff.pop(s, None)
+                self.counters.inc("hive/shards_replaced")
+            except Exception:                # noqa: BLE001 — keep orphan
+                self._adopt_backoff[s] = \
+                    self.membership.clock() + self.adopt_retry_s
+                self.counters.inc("hive/adopt_failed")
+        with self._mu:
+            for (s, _old, nid) in done:
+                self.placement.assign[s] = nid
+            if done:
+                self.placement.epoch += 1
+            self._adopting.difference_update(
+                s for (s, _o, _n) in planned)
+            self._sync_node_shards_locked()
+        self.counters.set("hive/placement_epoch", self.placement.epoch)
+        return done
+
+    def _sync_node_shards_locked(self) -> None:
+        """Mirror the placement back onto NodeInfo.shards (the sysview
+        and rejoin-staleness both read it)."""
+        owned: dict = {}
+        for s, nid in self.placement.assign.items():
+            owned.setdefault(nid, []).append(s)
+        for n in self.membership.nodes():
+            n.shards = sorted(owned.get(n.node_id, ()), key=str)
+            if n.shards:
+                n.had_shards = True
+
+    # -- router-facing views ------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self.placement.epoch
+
+    def orphaned_shards(self) -> list:
+        """Shards whose owner is not an alive, non-stale worker — their
+        rows are unreachable until a re-placement succeeds. The lowering
+        REFUSES to build a graph while any exist (a scan that silently
+        drops a shard's rows is worse than an error)."""
+        alive = {n.node_id for n in self.membership.alive()
+                 if not n.stale}
+        return sorted((s for s, nid in self.placement.assign.items()
+                       if nid not in alive), key=str)
+
+    def query_endpoints(self) -> list:
+        """Endpoints a distributed query should task, in registration
+        order: alive, non-stale workers owning at least one shard (a
+        shard-less rejoiner still holds its OLD rows — tasking it would
+        double-count them)."""
+        return [n.endpoint for n in self.membership.alive()
+                if not n.stale and n.shards]
+
+    def rows(self) -> list:
+        return self.membership.rows()
+
+    # -- pull liveness (plain gRPC workers, no agent) -----------------------
+
+    def pulse(self, ping) -> None:
+        """One pull round: `ping(endpoint) -> bool`; responders get their
+        lease renewed, non-responders expire naturally."""
+        for n in self.membership.alive():
+            ok = False
+            try:
+                ok = bool(ping(n.endpoint))
+            except Exception:                # noqa: BLE001 — dead is dead
+                ok = False
+            if ok:
+                self.membership.heartbeat(n.node_id)
+        self.sweep()
+
+    def start_pulse(self, ping, interval_s: float = None) -> None:
+        """Background pull loop at lease/3 (stop with stop_pulse)."""
+        if self._pulse_thread is not None:
+            return
+        interval = interval_s or max(0.2, self.membership.lease_s / 3.0)
+        self._pulse_stop.clear()
+
+        def loop():
+            while not self._pulse_stop.wait(interval):
+                try:
+                    self.pulse(ping)
+                except Exception:            # noqa: BLE001 — keep pulsing
+                    pass
+
+        self._pulse_thread = threading.Thread(target=loop, daemon=True,
+                                              name="hive-pulse")
+        self._pulse_thread.start()
+
+    def stop_pulse(self) -> None:
+        if self._pulse_thread is None:
+            return
+        self._pulse_stop.set()
+        self._pulse_thread.join(timeout=10)
+        self._pulse_thread = None

@@ -1,0 +1,233 @@
+"""Lease-based leader election — the StateStorage seat.
+
+The reference elects tablet leaders through StateStorage replicas
+(`statestorage.cpp` generation+guard rounds); the analog here is a
+LEASE RECORD on shared storage (the standby mirror's disk — the same
+medium the data already rides): candidates race to acquire it, the
+winner renews at lease/3, and a leader that stops renewing (crash,
+partition) loses the lease to the next candidate after expiry. Exactly
+one leader per lease interval, no operator in the loop.
+
+This turns standby promotion (`cluster/replica.py` StandbyServer)
+from operator-driven ("boot from the standby root by hand") into
+election-driven: every router candidate runs `promote_when_elected` —
+whoever wins the lease boots the engine from the standby root; the
+losers keep waiting as warm spares and take over on lease expiry.
+
+The acquire critical section is an atomic `os.mkdir` lock (POSIX mkdir
+is atomic across processes on one filesystem — the shared-disk analog
+of a StateStorage quorum round), with stale-lock breaking so a candidate
+killed INSIDE the critical section cannot wedge the election forever.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class LeaseFile:
+    """The durable lease record: {owner, deadline}."""
+
+    LOCK_STALE_S = 5.0          # break a lock dir older than this
+
+    def __init__(self, path: str, clock=time.time):
+        self.path = path
+        self.clock = clock
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def _locked(self, fn):
+        lockdir = self.path + ".lock"
+        tokenf = os.path.join(lockdir, "owner")
+        token = f"{os.getpid()}.{time.time_ns()}"
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                os.mkdir(lockdir)
+                with open(tokenf, "w") as f:
+                    f.write(token)
+                break
+            except FileExistsError:
+                try:
+                    # wall clock on BOTH sides: getmtime is epoch
+                    # seconds, so the staleness compare must be too
+                    # (monotonic here would never fire and a candidate
+                    # killed inside the critical section would wedge
+                    # the election forever)
+                    if time.time() - os.path.getmtime(lockdir) \
+                            > self.LOCK_STALE_S:
+                        try:
+                            os.unlink(tokenf)
+                        except OSError:
+                            pass
+                        os.rmdir(lockdir)
+                        continue
+                except OSError:
+                    pass
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"lease lock wedged: {lockdir}")
+                time.sleep(0.01)
+        try:
+            return fn()
+        finally:
+            # release ONLY a lock we still own: if a peer stale-broke
+            # ours while we stalled, blindly rmdir'ing here would free
+            # the peer's LIVE lock and let a third candidate into the
+            # critical section alongside it
+            try:
+                with open(tokenf) as f:
+                    mine = f.read() == token
+            except OSError:
+                mine = False
+            if mine:
+                try:
+                    os.unlink(tokenf)
+                    os.rmdir(lockdir)
+                except OSError:
+                    pass
+
+    def read(self):
+        try:
+            with open(self.path) as f:
+                return json.loads(f.read())
+        except (OSError, ValueError):
+            return None
+
+    def _write(self, rec: dict) -> None:
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(rec))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def try_acquire(self, owner: str, lease_s: float) -> bool:
+        """Acquire or renew: succeeds when the record is absent, expired,
+        or already ours. One winner per lease interval — the mkdir lock
+        serializes the read-check-write."""
+        def body():
+            rec = self.read()
+            now = self.clock()
+            if rec is not None and rec.get("owner") != owner \
+                    and float(rec.get("deadline", 0)) > now:
+                return False
+            self._write({"owner": owner, "deadline": now + lease_s})
+            # confirm after write: if a peer stale-broke OUR lock while
+            # we stalled and wrote between our read and write, the race
+            # loser must see itself overwritten. Plain files have no
+            # CAS, so a peer writing AFTER this re-read still wins a
+            # window bounded by one renewal interval (step() then flips
+            # the loser to not-leader); LOCK_STALE_S must exceed any
+            # honest pause inside this critical section.
+            rec = self.read()
+            return rec is not None and rec.get("owner") == owner
+        return self._locked(body)
+
+    def release(self, owner: str) -> None:
+        def body():
+            rec = self.read()
+            if rec is not None and rec.get("owner") == owner:
+                try:
+                    os.unlink(self.path)
+                except FileNotFoundError:
+                    pass
+        self._locked(body)
+
+    def holder(self):
+        """Current live holder (None when absent or expired)."""
+        rec = self.read()
+        if rec is None or float(rec.get("deadline", 0)) <= self.clock():
+            return None
+        return rec.get("owner")
+
+
+class LeaseElection:
+    """One candidate's view of the race: step() tries to acquire/renew;
+    start() runs the loop at lease/3 with an `on_win` callback fired on
+    the pending→leader transition (at-most-once per tenure)."""
+
+    def __init__(self, lease: LeaseFile, candidate_id: str,
+                 lease_s: float = 2.0, on_win=None):
+        from ydb_tpu.utils.metrics import GLOBAL
+        self.lease = lease if isinstance(lease, LeaseFile) \
+            else LeaseFile(lease)
+        self.candidate_id = candidate_id
+        self.lease_s = float(lease_s)
+        self.on_win = on_win
+        self.is_leader = False
+        self.counters = GLOBAL
+        self._stop = threading.Event()
+        self._thread = None
+
+    def step(self) -> bool:
+        won = self.lease.try_acquire(self.candidate_id, self.lease_s)
+        if won and not self.is_leader:
+            self.counters.inc("hive/elections_won")
+            if self.on_win is not None:
+                self.on_win()
+        elif not won and self.is_leader:
+            # lost the lease (a renewal missed a whole interval): a
+            # fenced ex-leader must stop acting, loudly
+            self.counters.inc("hive/leadership_lost")
+        self.is_leader = won
+        return won
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.step()
+                except Exception:            # noqa: BLE001 — keep racing
+                    pass
+                self._stop.wait(max(0.05, self.lease_s / 3.0))
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"elect-{self.candidate_id}")
+        self._thread.start()
+
+    def stop(self, release: bool = False) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if release and self.is_leader:
+            self.lease.release(self.candidate_id)
+            self.is_leader = False
+
+
+def promote_when_elected(standby_root: str, lease_path: str,
+                         candidate_id: str, lease_s: float = 2.0,
+                         timeout_s: float = 30.0, clock=time.time,
+                         **engine_kwargs):
+    """Election-driven standby promote: block until this candidate wins
+    the lease (or `timeout_s` passes — another candidate is the live
+    leader), then boot the engine from the standby root through ordinary
+    crash recovery. Returns (engine, election) — the election keeps
+    renewing in the background as the leader's fence; losers get
+    (None, election)."""
+    from ydb_tpu.query import QueryEngine
+    election = LeaseElection(LeaseFile(lease_path, clock=clock),
+                             candidate_id, lease_s=lease_s)
+    deadline = time.monotonic() + timeout_s
+    while not election.step():
+        if time.monotonic() > deadline:
+            return None, election
+        time.sleep(max(0.05, lease_s / 3.0))
+    # start renewing BEFORE the boot: crash recovery of a large image
+    # can outlast lease_s, and a lapsed lease mid-boot would let a
+    # second candidate win and boot the same root (split-brain)
+    election.start()                # keep renewing: leadership fence
+    try:
+        engine = QueryEngine(data_dir=standby_root, **engine_kwargs)
+    except BaseException:
+        election.stop(release=True)  # failed boot must not hold the
+        raise                        # lease against other candidates
+    from ydb_tpu.utils.metrics import GLOBAL
+    GLOBAL.inc("hive/standby_promotions")
+    return engine, election

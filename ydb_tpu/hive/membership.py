@@ -1,0 +1,192 @@
+"""Hive membership — lease-based worker liveness.
+
+The reference's Hive tablet tracks node liveness through the node
+broker / local services (`hive_impl.h:158` TNodeInfo, lease-style
+`TEvLocal::TEvPing` round-trips); here a worker REGISTERS once and then
+renews a lease with heartbeats. A lease that expires without renewal
+marks the worker dead — the control plane never needs a worker's
+cooperation to declare it gone (kill -9 is indistinguishable from a
+network partition, and both must converge to `dead` within one lease).
+
+Two renewal transports feed the same table:
+
+  * push — workers run a `hive/agent.py` HeartbeatAgent against the
+    HiveRegister/HiveHeartbeat RPCs of whichever server hosts the Hive
+    (`server/service.py`, engine.hive attached);
+  * pull — a router-side pulse loop pings plain gRPC workers and renews
+    the lease of every responder (`hive/core.py` Hive.pulse), for
+    deployments where workers predate the agent.
+
+The clock is injectable so lease expiry is unit-testable without
+sleeping; counters land in the `hive/*` namespace on /counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+ALIVE = "alive"
+DEAD = "dead"
+
+
+@dataclass
+class NodeInfo:
+    """One registered worker (the TNodeInfo seat)."""
+    node_id: str
+    endpoint: str
+    capacity: float = 1.0
+    shards: list = field(default_factory=list)   # shard ids it serves
+    registered_at: float = 0.0
+    lease_deadline: float = 0.0
+    heartbeats: int = 0
+    state: str = ALIVE
+    load: float = 0.0               # worker-reported (stage wall ms)
+    # set when the node re-registered AFTER its shards were re-placed:
+    # its local store still holds the old shard's rows, so sharded scans
+    # must skip it until an operator re-images it (double-count guard)
+    stale: bool = False
+    # ever owned a shard (placement sync sets it; never cleared) — a
+    # dead rejoiner is stale only if it HAD shards that were re-placed
+    had_shards: bool = False
+
+
+class HiveMembership:
+    """Worker registry with lease liveness. Thread-safe: heartbeats
+    arrive from gRPC pool threads while the router sweeps."""
+
+    def __init__(self, lease_s: float = 3.0, clock=time.monotonic,
+                 counters=None):
+        from ydb_tpu.utils.metrics import GLOBAL
+        self.lease_s = float(lease_s)
+        self.clock = clock
+        self.counters = counters or GLOBAL
+        self._mu = threading.Lock()
+        # registration order is placement order (dict preserves it) —
+        # the router's worker list must keep the operator's endpoint
+        # order so pk-hash insert routing stays stable across restarts
+        self._nodes: dict[str, NodeInfo] = {}
+
+    # -- registration / renewal --------------------------------------------
+
+    def register(self, endpoint: str, node_id: str = "",
+                 capacity: float = 1.0, shards=()) -> dict:
+        """Register (or revive) a worker; grants a fresh lease. Returns
+        the accepted identity and lease so the agent can schedule
+        renewals at lease/3."""
+        nid = node_id or endpoint
+        now = self.clock()
+        with self._mu:
+            n = self._nodes.get(nid)
+            if n is None:
+                n = self._nodes[nid] = NodeInfo(
+                    node_id=nid, endpoint=endpoint,
+                    capacity=float(capacity), shards=list(shards),
+                    registered_at=now)
+                self.counters.inc("hive/registered")
+            else:
+                # rejoin: a node whose shards were re-placed while it was
+                # dead holds stale copies of them — it may serve again
+                # only after re-imaging (its `shards` were zeroed by the
+                # re-placement; an operator resets `stale` after wiping)
+                if n.state == DEAD and n.had_shards and not n.shards:
+                    n.stale = True
+                    self.counters.inc("hive/rejoin_stale")
+                n.endpoint = endpoint
+                n.capacity = float(capacity)
+                n.state = ALIVE
+            n.lease_deadline = now + self.lease_s
+            self._gauge_locked()
+            return {"node_id": nid, "lease_s": self.lease_s,
+                    "shards": list(n.shards), "stale": n.stale}
+
+    def heartbeat(self, node_id: str, load: float = None) -> dict:
+        """Renew a lease. Unknown node → the agent must re-register
+        (the Hive restarted and lost volatile membership)."""
+        with self._mu:
+            n = self._nodes.get(node_id)
+            if n is None or n.state == DEAD:
+                return {"ok": False, "register": True}
+            n.lease_deadline = self.clock() + self.lease_s
+            n.heartbeats += 1
+            if load is not None:
+                n.load = float(load)
+            self.counters.inc("hive/heartbeats")
+            return {"ok": True, "lease_s": self.lease_s}
+
+    # -- liveness -----------------------------------------------------------
+
+    def sweep(self) -> list:
+        """Expire overdue leases; returns the NEWLY dead nodes (the
+        caller — `hive/core.py` — re-places their shards)."""
+        now = self.clock()
+        newly = []
+        with self._mu:
+            for n in self._nodes.values():
+                if n.state == ALIVE and n.lease_deadline <= now:
+                    n.state = DEAD
+                    newly.append(n)
+                    self.counters.inc("hive/lease_expired")
+                    self.counters.inc("hive/worker_dead")
+            if newly:
+                self._gauge_locked()
+        return newly
+
+    def expire(self, endpoints) -> list:
+        """Force-expire leases for observed-dead endpoints (the query
+        path saw a transport error — no reason to wait out the lease).
+        Returns the newly dead nodes, like sweep()."""
+        eps = set(endpoints)
+        newly = []
+        with self._mu:
+            for n in self._nodes.values():
+                if n.state == ALIVE and n.endpoint in eps:
+                    n.state = DEAD
+                    newly.append(n)
+                    self.counters.inc("hive/worker_dead")
+            if newly:
+                self._gauge_locked()
+        return newly
+
+    def _gauge_locked(self) -> None:
+        self.counters.set("hive/workers_alive",
+                          sum(1 for n in self._nodes.values()
+                              if n.state == ALIVE))
+
+    # -- views --------------------------------------------------------------
+
+    def get(self, node_id: str):
+        with self._mu:
+            return self._nodes.get(node_id)
+
+    def by_endpoint(self, endpoint: str):
+        with self._mu:
+            for n in self._nodes.values():
+                if n.endpoint == endpoint:
+                    return n
+        return None
+
+    def alive(self) -> list:
+        """Alive nodes in REGISTRATION order (placement order)."""
+        with self._mu:
+            return [n for n in self._nodes.values() if n.state == ALIVE]
+
+    def nodes(self) -> list:
+        with self._mu:
+            return list(self._nodes.values())
+
+    def rows(self) -> list:
+        """`.sys/cluster_nodes` row payloads."""
+        now = self.clock()
+        with self._mu:
+            return [{
+                "node_id": n.node_id, "endpoint": n.endpoint,
+                "state": n.state,
+                "lease_ms_left": max(0.0, (n.lease_deadline - now)
+                                     * 1000.0) if n.state == ALIVE else 0.0,
+                "heartbeats": n.heartbeats,
+                "capacity": n.capacity, "load": n.load,
+                "shards": ",".join(str(s) for s in n.shards),
+                "stale": n.stale,
+            } for n in self._nodes.values()]

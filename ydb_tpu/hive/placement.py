@@ -1,0 +1,116 @@
+"""Shard placement — the deterministic, capacity- and load-aware balancer.
+
+The reference Hive owns the tablet→node map and rebalances it with a
+scored boot-queue (`hive_impl.h` TBootQueue; `tablet_move_info.h`
+usage-weighted moves). Here the map is shard→node, and the balancer is a
+pure DETERMINISTIC function of (current map, shard set, alive nodes,
+load signal): every router candidate computes the identical map from the
+same inputs, so placement needs no consensus round — the election
+(`hive/election.py`) picks who gets to ACT on it.
+
+Load signal: PR 7's per-stage wall stats (`engine.dq_stage_stats`, the
+`.sys/dq_stage_stats` ring filled by the DQ runner) aggregated per
+worker — a worker whose tasks run long is loaded, whatever the cause
+(bigger shard, slower host, noisy neighbor).
+
+Stability discipline: shards stay where they are while their node is
+alive (moving a shard means replaying its image — never free); leave
+moves ONLY the dead node's shards; join moves nothing by default
+(`move_on_join` opts in, for deployments whose adopt hook can re-image).
+"""
+
+from __future__ import annotations
+
+
+def stage_load_signal(engine) -> dict:
+    """Per-worker load from the DQ stage-stats ring: mean task exec_ms
+    (the per-stage wall attribution PR 7 records). Empty dict until a
+    distributed query has run."""
+    totals: dict = {}
+    counts: dict = {}
+    for r in list(getattr(engine, "dq_stage_stats", []) or []):
+        w = r.get("worker", "")
+        if not w or w == "router":
+            continue
+        totals[w] = totals.get(w, 0.0) + float(r.get("exec_ms", 0.0))
+        counts[w] = counts.get(w, 0) + 1
+    return {w: totals[w] / counts[w] for w in totals}
+
+
+def _score(node, assigned_load: dict) -> tuple:
+    """Lower is better; deterministic tie-break on node_id."""
+    cap = max(node.capacity, 1e-9)
+    return (assigned_load.get(node.node_id, 0.0) / cap,
+            (node.load or 0.0) / cap, node.node_id)
+
+
+def rebalance(current: dict, shards, nodes: list,
+              shard_load: dict = None, move_on_join: bool = False) -> dict:
+    """Compute the new shard→node_id map.
+
+    `current`: the existing map (may reference dead nodes); `shards`:
+    every shard that must be placed; `nodes`: ALIVE candidate NodeInfos
+    (stale rejoiners excluded by the caller); `shard_load`: optional
+    per-shard weight (defaults 1.0). Deterministic: iteration orders are
+    sorted, scores tie-break on node_id."""
+    if not nodes:
+        return {}
+    by_id = {n.node_id: n for n in nodes}
+    shard_load = shard_load or {}
+    out: dict = {}
+    assigned: dict = {}          # node_id -> placed load
+    # 1. keep every shard whose owner is still alive (no free moves)
+    for s in sorted(shards, key=str):
+        owner = current.get(s)
+        if owner in by_id:
+            out[s] = owner
+            assigned[owner] = assigned.get(owner, 0.0) \
+                + shard_load.get(s, 1.0)
+    # 2. orphans (dead/unknown owner) go to the best-scoring node —
+    #    heaviest first so the greedy packing stays balanced
+    orphans = sorted((s for s in shards if s not in out),
+                     key=lambda s: (-shard_load.get(s, 1.0), str(s)))
+    for s in orphans:
+        best = min(nodes, key=lambda n: _score(n, assigned))
+        out[s] = best.node_id
+        assigned[best.node_id] = assigned.get(best.node_id, 0.0) \
+            + shard_load.get(s, 1.0)
+    # 3. optional join leveling: drain the most-loaded node toward empty
+    #    joiners until shard counts are within one of each other
+    if move_on_join:
+        while True:
+            counts = {n.node_id: 0 for n in nodes}
+            for nid in out.values():
+                counts[nid] += 1
+            hi = max(counts, key=lambda k: (counts[k], k))
+            lo = min(counts, key=lambda k: (counts[k], k))
+            if counts[hi] - counts[lo] <= 1:
+                break
+            moved = min((s for s, nid in out.items() if nid == hi),
+                        key=str)
+            out[moved] = lo
+    return out
+
+
+class PlacementMap:
+    """The versioned shard→node map (epoch bumps on every change, so
+    lowered graphs and routers can detect a stale topology)."""
+
+    def __init__(self):
+        self.assign: dict = {}      # shard id -> node_id
+        self.epoch = 0
+
+    def apply(self, new: dict) -> list:
+        """Install a computed map; returns the moves [(shard, old_node,
+        new_node)] (old_node None for first placement)."""
+        moves = [(s, self.assign.get(s), nid) for s, nid in new.items()
+                 if self.assign.get(s) != nid]
+        dropped = [s for s in self.assign if s not in new]
+        if moves or dropped:
+            self.assign = dict(new)
+            self.epoch += 1
+        return moves
+
+    def shards_of(self, node_id: str) -> list:
+        return sorted((s for s, nid in self.assign.items()
+                       if nid == node_id), key=str)

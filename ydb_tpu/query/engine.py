@@ -129,6 +129,11 @@ class QueryEngine:
         from ydb_tpu.utils.tracing import Tracer
         self.tracer = Tracer()
         self.executor.tracer = self.tracer
+        # cluster control plane (ydb_tpu/hive/): a router candidate that
+        # hosts the Hive attaches it here — the server's HiveRegister/
+        # HiveHeartbeat RPCs and the `.sys/cluster_nodes` sysview both
+        # read it; None on ordinary workers
+        self.hive = None
         # admission-time trace sampling (jaeger_tracing sampler analog):
         # YDB_TPU_TRACE_SAMPLE in [0, 1] — 1 (default) traces every
         # statement, 0 records zero spans (results byte-identical),
@@ -940,7 +945,12 @@ class QueryEngine:
                   "groupby/join_bounded_plans", "dq/merge_groupby_stages",
                   "sort/rows_max", "sort/operands_max",
                   "slow_query/count", "trace/forced_slow",
-                  "program_cache/compiles", "program_cache/compile_ms"):
+                  "program_cache/compiles", "program_cache/compile_ms",
+                  "hive/registered", "hive/heartbeats",
+                  "hive/worker_dead", "hive/workers_alive",
+                  "hive/lease_expired", "hive/shards_replaced",
+                  "hive/adopt_failed", "hive/failover_holds",
+                  "hive/placement_epoch", "dq/retry_rerouted"):
             c.setdefault(k, 0)
         c.setdefault("trace/sample_rate", self.trace_sample)
         c.setdefault("trace/profiles_held", len(self.profiles))

@@ -19,7 +19,8 @@ from ydb_tpu.core.block import HostBlock
 PREFIX = ".sys/"
 
 VIEWS = ("tables", "partition_stats", "counters", "query_metrics",
-         "top_queries_by_duration", "dq_stage_stats", "query_profiles")
+         "top_queries_by_duration", "dq_stage_stats", "query_profiles",
+         "cluster_nodes")
 
 
 def is_sysview(name: str) -> bool:
@@ -136,6 +137,34 @@ def sysview_block(engine, name: str) -> HostBlock:
                              ("dispatch_ms", "float64"),
                              ("device_ms", "float64"),
                              ("readout_ms", "float64")])
+    if view == "cluster_nodes":
+        # Hive membership/placement (the `ds_clusters`/nodes sysview
+        # seat): one row per registered worker, lease liveness included.
+        # Empty when no Hive is attached to this engine — the view
+        # exists on every node, the CONTROL PLANE lives on one.
+        hive = getattr(engine, "hive", None)
+        if hive is not None:
+            # membership-level sweep only: the view must not show
+            # expired leases as alive, but a monitoring SELECT must
+            # never trigger re-placement DATA MOVEMENT (hive.sweep()
+            # replays shard images; the query path owns that)
+            hive.membership.sweep()
+        rows = [{
+            "node_id": r["node_id"], "endpoint": r["endpoint"],
+            "state": r["state"],
+            "lease_ms_left": float(r["lease_ms_left"]),
+            "heartbeats": int(r["heartbeats"]),
+            "capacity": float(r["capacity"]),
+            "load": float(r["load"]), "shards": r["shards"],
+            "stale": bool(r["stale"]),
+        } for r in (hive.rows() if hive is not None else [])]
+        return _block(rows, [("node_id", str), ("endpoint", str),
+                             ("state", str),
+                             ("lease_ms_left", "float64"),
+                             ("heartbeats", "int64"),
+                             ("capacity", "float64"),
+                             ("load", "float64"), ("shards", str),
+                             ("stale", "bool")])
     raise KeyError(f"unknown system view {name!r} "
                    f"(have: {', '.join(PREFIX + v for v in VIEWS)})")
 

@@ -454,6 +454,74 @@ class QueryServicer:
         except Exception as e:               # noqa: BLE001 — wire boundary
             return {"error": f"{type(e).__name__}: {e}"}
 
+    # -- Hive control plane (ydb_tpu/hive/) --------------------------------
+    #
+    # The server hosting the Hive (engine.hive attached — typically a
+    # router candidate) serves membership: workers push HiveRegister
+    # once and HiveHeartbeat at lease/3 (`hive/agent.py`); HiveNodes is
+    # the ops-facing snapshot (`.sys/cluster_nodes` serves the same rows
+    # through SQL). HiveAdoptShard runs on WORKERS: the Hive's failover
+    # tells a survivor to replay a dead peer's shard image into its own
+    # tables (`hive/adopt.py`).
+
+    def _hive(self):
+        return getattr(self.engine, "hive", None)
+
+    def hive_register(self, request, context):
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        hive = self._hive()
+        if hive is None:
+            return {"error": "no Hive hosted on this node"}
+        try:
+            return hive.register_worker(
+                endpoint=str(request.get("endpoint", "")),
+                node_id=str(request.get("node_id", "")),
+                capacity=float(request.get("capacity", 1.0)),
+                shards=list(request.get("shards") or []))
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def hive_heartbeat(self, request, context):
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        hive = self._hive()
+        if hive is None:
+            return {"error": "no Hive hosted on this node"}
+        try:
+            load = request.get("load")
+            return hive.heartbeat(str(request.get("node_id", "")),
+                                  load=None if load is None
+                                  else float(load))
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def hive_nodes(self, request, context):
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        hive = self._hive()
+        if hive is None:
+            return {"error": "no Hive hosted on this node"}
+        # membership-level sweep only, like `.sys/cluster_nodes`: a
+        # monitoring poll must show expired leases as dead but must
+        # never trigger re-placement data movement inline
+        hive.membership.sweep()
+        return {"nodes": hive.rows(), "epoch": hive.epoch}
+
+    def hive_adopt_shard(self, request, context):
+        """Replay a shard image (a dead peer's standby mirror root) into
+        this worker's tables — the re-placement data plane."""
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        from ydb_tpu.hive.adopt import adopt_shard
+        try:
+            root = str(request["root"])
+            copied = adopt_shard(self.engine, root,
+                                 request.get("tables"))
+            return {"ok": True, "copied": copied}
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
     def ping(self, request, context):
         return {"ok": True}
 
@@ -537,6 +605,20 @@ def serve(engine, port: int = 2136, max_workers: int = 8,
             response_serializer=_ser),
         "TxInDoubt": grpc.unary_unary_rpc_method_handler(
             servicer.tx_in_doubt, request_deserializer=_deser,
+            response_serializer=_ser),
+        # Hive control plane: membership (on the Hive host) + shard
+        # adoption (on workers)
+        "HiveRegister": grpc.unary_unary_rpc_method_handler(
+            servicer.hive_register, request_deserializer=_deser,
+            response_serializer=_ser),
+        "HiveHeartbeat": grpc.unary_unary_rpc_method_handler(
+            servicer.hive_heartbeat, request_deserializer=_deser,
+            response_serializer=_ser),
+        "HiveNodes": grpc.unary_unary_rpc_method_handler(
+            servicer.hive_nodes, request_deserializer=_deser,
+            response_serializer=_ser),
+        "HiveAdoptShard": grpc.unary_unary_rpc_method_handler(
+            servicer.hive_adopt_shard, request_deserializer=_deser,
             response_serializer=_ser),
     }
     server = grpc.server(
@@ -706,8 +788,46 @@ class Client:
     def tx_in_doubt(self) -> list:
         return self._dtx_call("TxInDoubt", {})["gtx"]
 
-    def ping(self) -> bool:
-        return bool(self._ping({}).get("ok"))
+    # -- Hive control plane -------------------------------------------------
+
+    def _hive_call(self, method: str, body: dict,
+                   timeout: float = None) -> dict:
+        stubs = self.__dict__.setdefault("_hive_stubs", {})
+        call = stubs.get(method)
+        if call is None:
+            call = stubs[method] = self._channel.unary_unary(
+                f"/{SERVICE}/{method}", request_serializer=_ser,
+                response_deserializer=_deser)
+        resp = call({**body, "token": self.token}, timeout=timeout)
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp
+
+    def hive_register(self, endpoint: str, node_id: str = "",
+                      capacity: float = 1.0, shards=(),
+                      timeout: float = None) -> dict:
+        return self._hive_call("HiveRegister",
+                               {"endpoint": endpoint, "node_id": node_id,
+                                "capacity": capacity,
+                                "shards": list(shards)}, timeout=timeout)
+
+    def hive_heartbeat(self, node_id: str, load: float = None,
+                       timeout: float = None) -> dict:
+        return self._hive_call("HiveHeartbeat",
+                               {"node_id": node_id, "load": load},
+                               timeout=timeout)
+
+    def hive_nodes(self, timeout: float = None) -> dict:
+        return self._hive_call("HiveNodes", {}, timeout=timeout)
+
+    def hive_adopt_shard(self, root: str, tables=None,
+                         timeout: float = None) -> dict:
+        return self._hive_call("HiveAdoptShard",
+                               {"root": root, "tables": tables},
+                               timeout=timeout)
+
+    def ping(self, timeout: float = None) -> bool:
+        return bool(self._ping({}, timeout=timeout).get("ok"))
 
     def health(self) -> dict:
         return self._health({})

@@ -179,6 +179,38 @@ HIST_FAMILIES = ("query/latency_ms", "query/parse_ms", "query/plan_ms",
 #   dq/channel_inflight_peak_bytes  flow-control high watermark
 #   dq/merge_groupby_stages       router merge stages that are partial-agg
 #                                 merges (ride the tiled sorted group-by)
+#   dq/retry_rerouted             tasks/statements re-routed off a
+#                                 transport-dead worker (single-task
+#                                 stage reroute, or a router failover
+#                                 round that re-lowered onto the
+#                                 surviving Hive placement)
+#
+# Hive control-plane counters (`ydb_tpu/hive/`, the cluster membership/
+# placement/failover subsystem):
+#   hive/registered               workers registered (first time)
+#   hive/heartbeats               lease renewals (push agents or pull
+#                                 pulse)
+#   hive/worker_dead              alive→dead transitions (lease expiry
+#                                 or observed transport failure)
+#   hive/lease_expired            the expiry subset of worker_dead
+#   hive/workers_alive            gauge: currently alive workers
+#   hive/shards_replaced          shards moved off dead workers (adopt
+#                                 hook succeeded)
+#   hive/shards_adopted           shard images replayed INTO this node
+#   hive/adopted_rows             rows absorbed by those replays
+#   hive/adopt_failed             re-placements whose image replay
+#                                 raised (shard stays orphaned, retried
+#                                 each sweep)
+#   hive/rejoin_stale             dead workers that re-registered after
+#                                 their shards were re-placed (excluded
+#                                 from sharded scans until re-imaged)
+#   hive/failover_holds           queries held at the placement barrier
+#                                 while a re-placement was in flight
+#   hive/placement_epoch          gauge: placement map version
+#   hive/elections_won            lease-election wins (pending→leader)
+#   hive/leadership_lost          leaders fenced by a lost lease
+#   hive/standby_promotions       engines booted from a standby root by
+#                                 a won election
 #
 # Sorted group-by trace counters (`ops/xla_exec.py`, accrued at TRACE
 # time — compile-cache hits re-trace nothing, so deltas show up only for

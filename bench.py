@@ -848,6 +848,148 @@ def storm_main(n: int, rows: int = 8192) -> int:
     return 0 if ok else 1
 
 
+def multichip_main(n: int, rows: int) -> int:
+    """Multi-chip shuffle leg (`bench.py --multichip [N]`): an N-worker,
+    N-device sharded×sharded join driven through BOTH channel planes —
+    host gRPC frames (`YDB_TPU_DQ_PLANE=host`) and the device-resident
+    ICI collective — with per-edge plane, `dq/ici_bytes` vs
+    `dq/channel_bytes`, wall clocks and the quantization saving stamped
+    into MULTICHIP_r06.json, so the host-vs-ICI claim is driver-visible
+    per run, not anecdotal. Self-provisions a virtual N-device CPU mesh
+    in a subprocess when the ambient platform is smaller (the
+    `__graft_entry__.dryrun_multichip` stance); on a real multi-chip
+    host the same leg measures genuine ICI. rc 0 = planes selected,
+    byte-equal, bytes moved; the ≥3× wall target is asserted only where
+    the interconnect is real (BENCH_MULTICHIP_MIN_SPEEDUP)."""
+    if os.environ.get("BENCH_MULTICHIP_CHILD") != "1":
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from ydb_tpu.utils.vmesh import virtual_mesh_env
+        env = virtual_mesh_env(n)
+        env["BENCH_MULTICHIP_CHILD"] = "1"
+        r = subprocess.run([sys.executable, os.path.abspath(__file__),
+                            "--multichip", str(n)], env=env,
+                           timeout=1800)
+        return r.returncode
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import pandas as pd
+
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.dq.runner import LocalWorker
+    from ydb_tpu.query import QueryEngine
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    nkeys = 997
+    engines = []
+    for wid in range(n):
+        e = QueryEngine(block_rows=1 << 16)
+        e.execute("create table t (id Int64 not null, k Int64 not null, "
+                  "v Double not null, primary key (id)) "
+                  "with (store = column)")
+        ids = np.arange(wid, rows, n, dtype=np.int64)
+        df = pd.DataFrame({"id": ids, "k": ids % nkeys, "v": ids * 0.5})
+        t = e.catalog.table("t")
+        t.bulk_upsert(df, e._next_version())
+        t.indexate()
+        e.execute("create table u (uid Int64 not null, x Double not null, "
+                  "primary key (uid))")
+        uids = np.arange(wid, nkeys, n, dtype=np.int64)
+        du = pd.DataFrame({"uid": uids, "x": 10.0 + uids * 0.25})
+        u = e.catalog.table("u")
+        u.bulk_upsert(du, e._next_version())
+        u.indexate()
+        engines.append(e)
+    c = ShardedCluster([LocalWorker(e, name=f"mc{i}")
+                        for i, e in enumerate(engines)],
+                       merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    sql = ("select k, count(*) as cnt, sum(v) as s, sum(x) as sx "
+           "from t, u where k = uid group by k order by k")
+
+    def run_plane(plane: str, quant: str = "0"):
+        os.environ["YDB_TPU_DQ_PLANE"] = plane
+        os.environ["YDB_TPU_DQ_QUANT"] = quant
+        c.query(sql)                       # warm: compile + dictionaries
+        counters0 = {k: GLOBAL.get(k) for k in
+                     ("dq/channel_bytes", "dq/ici_bytes", "dq/frames",
+                      "dq/ici_frames", "dq/quant_bytes_saved")}
+        best, res = float("inf"), None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = c.query(sql)
+            dt = time.perf_counter() - t0
+            if dt < best:
+                best, res = dt, out
+        delta = {k: GLOBAL.get(k) - v for k, v in counters0.items()}
+        return best, res, delta
+
+    host_s, host_res, host_d = run_plane("host")
+    ici_s, ici_res, ici_d = run_plane("auto")
+    quant_s, _quant_res, quant_d = run_plane("auto", quant="1")
+    os.environ["YDB_TPU_DQ_QUANT"] = "0"
+
+    edges = [{"channel": ch.id, "kind": ch.kind, "plane": ch.plane,
+              "key": ch.key, "quant_cols": list(ch.quant_cols)}
+             for ch in c.plan(sql).channels.values()]
+    byte_equal = list(host_res.columns) == list(ici_res.columns) \
+        and len(host_res) == len(ici_res) \
+        and all(np.array_equal(host_res[col].to_numpy(),
+                               ici_res[col].to_numpy())
+                for col in host_res.columns)
+    shuffle_ici = [e for e in edges if e["kind"] == "hash_shuffle"
+                   and e["plane"] == "ici"]
+    speedup = host_s / ici_s if ici_s else 0.0
+    out = {
+        "metric": "multichip_ici_shuffle",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "n_devices": n,
+        "rows": rows,
+        "platform": jax.default_backend(),
+        "virtual_mesh": jax.default_backend() == "cpu",
+        "edges": edges,
+        "host_plane": {"wall_s": round(host_s, 4),
+                       "channel_bytes": int(host_d["dq/channel_bytes"]),
+                       "ici_bytes": int(host_d["dq/ici_bytes"])},
+        "ici_plane": {"wall_s": round(ici_s, 4),
+                      "channel_bytes": int(ici_d["dq/channel_bytes"]),
+                      "ici_bytes": int(ici_d["dq/ici_bytes"]),
+                      "ici_frames": int(ici_d["dq/ici_frames"])},
+        "quant": {"wall_s": round(quant_s, 4),
+                  "quant_bytes_saved":
+                      int(quant_d["dq/quant_bytes_saved"])},
+        "speedup_vs_host": round(speedup, 2),
+        "byte_equal": byte_equal,
+        "ici_fallbacks": GLOBAL.get("dq/ici_fallbacks"),
+    }
+    # the ≥3× wall claim belongs to real interconnect; a virtual CPU
+    # mesh emulates collectives through one memcpy domain, so there the
+    # gate is plane selection + byte-equality + bytes moved (set
+    # BENCH_MULTICHIP_MIN_SPEEDUP on multi-chip hardware)
+    min_speedup = float(os.environ.get("BENCH_MULTICHIP_MIN_SPEEDUP",
+                                       "0"))
+    ok = (byte_equal and len(shuffle_ici) == 2
+          and ici_d["dq/ici_bytes"] > 0
+          and ici_d["dq/channel_bytes"] == 0
+          and host_d["dq/channel_bytes"] > 0
+          and quant_d["dq/quant_bytes_saved"] > 0
+          and speedup >= min_speedup)
+    out["ok"] = ok
+    print(json.dumps(out), flush=True)
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "MULTICHIP_r06.json")
+    with open(artifact, "w") as f:
+        json.dump(out, f, indent=2)
+    log(f"multichip: {speedup:.2f}x vs host plane, "
+        f"ici_bytes {out['ici_plane']['ici_bytes']}, "
+        f"quant saved {out['quant']['quant_bytes_saved']} "
+        f"-> {artifact}")
+    return 0 if ok else 1
+
+
 def main() -> None:
     import threading
     suites: dict = {}
@@ -935,6 +1077,10 @@ if __name__ == "__main__":
         sys.exit(storm_main(
             int(sys.argv[2]) if len(sys.argv) > 2 else 64,
             rows=int(os.environ.get("BENCH_STORM_ROWS", "8192"))))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--multichip":
+        sys.exit(multichip_main(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 4,
+            rows=int(os.environ.get("BENCH_MULTICHIP_ROWS", "40000"))))
     elif len(sys.argv) > 1 and sys.argv[1] == "--suite-child":
         sf = float(sys.argv[2])
         skip = [s for s in sys.argv[4].split(",") if s] \

@@ -80,6 +80,19 @@ if [ "$trc" -ne 0 ]; then
     exit "$trc"
 fi
 
+echo "== DQ ICI-plane gate (4-device mesh: plane selection, byte-equal, bytes moved) =="
+# the pluggable channel-plane floor: on a virtual 4-device mesh a
+# sharded×sharded join must lower its shuffle edges to plane=ici,
+# stay byte-equal to the forced host plane (YDB_TPU_DQ_PLANE=host),
+# move its bytes from dq/channel_bytes to dq/ici_bytes, and the
+# quantization lever must save bytes within the declared tolerance
+JAX_PLATFORMS=cpu python scripts/ici_gate.py
+irc=$?
+if [ "$irc" -ne 0 ]; then
+    echo "ICI-plane gate FAILED (rc=$irc)" >&2
+    exit "$irc"
+fi
+
 echo "== DQ two-worker smoke (scan→join→agg over hash-shuffle edges) =="
 # two real OS worker processes; gates on result correctness AND the
 # dq/* counters being non-zero on router + workers (a refactor that

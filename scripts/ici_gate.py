@@ -1,0 +1,162 @@
+"""CI gate for the DQ channel ICI plane (`ydb_tpu/dq/ici.py`).
+
+Deterministic CPU proxy for the multi-chip acceptance shape: under a
+virtual 4-device mesh (`--xla_force_host_platform_device_count=4`,
+self-provisioned in a subprocess — the `__graft_entry__.dryrun_multichip`
+stance) a sharded×sharded join must
+
+  1. lower its shuffle edges to ``plane="ici"`` (plane selection);
+  2. produce BYTE-EQUAL results vs the forced host plane
+     (`YDB_TPU_DQ_PLANE=host` — the escape-hatch lever);
+  3. move its shuffle bytes from `dq/channel_bytes` to `dq/ici_bytes`
+     (the device collective carried the edge; zero npz frames);
+  4. with `YDB_TPU_DQ_QUANT=1`, measure nonzero `dq/quant_bytes_saved`
+     with keys/COUNT bit-exact and SUM within the declared tolerance —
+     and `YDB_TPU_DQ_QUANT=0` stays byte-equal (the quant escape hatch).
+
+Prints one JSON line; exit 0 = green.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NDEV = 4
+ROWS = 400
+JOIN_SQL = ("select k, count(*) as n, sum(v) as s, sum(x) as sx "
+            "from t, u where k = uid group by k order by k")
+QUANT_RTOL = 2e-2
+
+
+def mk_cluster():
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.dq.runner import LocalWorker
+    from ydb_tpu.query import QueryEngine
+
+    engines = []
+    for wid in range(NDEV):
+        e = QueryEngine(block_rows=1 << 13)
+        e.execute("create table t (id Int64 not null, k Int64 not null, "
+                  "v Double not null, primary key (id))")
+        mine = [i for i in range(ROWS) if i % NDEV == wid]
+        # dyadic v: float sums are order-independent, so byte-equality
+        # across planes is a fair demand
+        e.execute("insert into t (id, k, v) values " + ", ".join(
+            f"({i}, {i % 11}, {i * 0.5})" for i in mine))
+        e.execute("create table u (uid Int64 not null, x Double not null, "
+                  "primary key (uid))")
+        mine_u = [i for i in range(11) if i % NDEV == wid]
+        if mine_u:
+            e.execute("insert into u (uid, x) values " + ", ".join(
+                f"({i}, {10.0 + i * 0.25})" for i in mine_u))
+        engines.append(e)
+    c = ShardedCluster([LocalWorker(e, name=f"ici{i}")
+                        for i, e in enumerate(engines)],
+                       merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    return c
+
+
+def _eq(a, b, loose=(), rtol=0.0):
+    import numpy as np
+    if list(a.columns) != list(b.columns) or len(a) != len(b):
+        return False
+    for col in a.columns:
+        x, y = a[col].to_numpy(), b[col].to_numpy()
+        if col in loose:
+            if not np.allclose(x.astype(float), y.astype(float),
+                               rtol=rtol):
+                return False
+        elif not np.array_equal(x, y):
+            return False
+    return True
+
+
+def gate() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= NDEV, jax.devices()
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    os.environ.pop("YDB_TPU_DQ_PLANE", None)
+    os.environ["YDB_TPU_DQ_QUANT"] = "0"
+    c = mk_cluster()
+
+    # 1. plane selection at lowering
+    g = c.plan(JOIN_SQL)
+    planes = {ch.kind: ch.plane for ch in g.channels.values()}
+    plane_ok = planes.get("hash_shuffle") == "ici" \
+        and planes.get("union_all") == "host"
+
+    # 2+3. host plane vs ICI plane: byte-equal, bytes moved counters
+    os.environ["YDB_TPU_DQ_PLANE"] = "host"
+    hb0 = GLOBAL.get("dq/channel_bytes")
+    want = c.query(JOIN_SQL)
+    host_bytes = GLOBAL.get("dq/channel_bytes") - hb0
+
+    os.environ["YDB_TPU_DQ_PLANE"] = "auto"
+    ib0 = GLOBAL.get("dq/ici_bytes")
+    cb0 = GLOBAL.get("dq/channel_bytes")
+    if0 = GLOBAL.get("dq/ici_frames")
+    got = c.query(JOIN_SQL)
+    ici_bytes = GLOBAL.get("dq/ici_bytes") - ib0
+    leaked_host_bytes = GLOBAL.get("dq/channel_bytes") - cb0
+    ici_frames = GLOBAL.get("dq/ici_frames") - if0
+
+    byte_equal = _eq(got, want)
+    bytes_moved = host_bytes > 0 and ici_bytes > 0 \
+        and leaked_host_bytes == 0 and ici_frames > 0
+    no_fallback = GLOBAL.get("dq/ici_fallbacks") == 0
+
+    # 4. quantization lever: saved bytes, bounded error, exact keys;
+    # QUANT=0 (the default above) already proved the byte-equal hatch
+    os.environ["YDB_TPU_DQ_QUANT"] = "1"
+    q0 = GLOBAL.get("dq/quant_bytes_saved")
+    gotq = c.query(JOIN_SQL)
+    quant_saved = GLOBAL.get("dq/quant_bytes_saved") - q0
+    os.environ["YDB_TPU_DQ_QUANT"] = "0"
+    # v and x BOTH feed only SUMs → both legitimately quantize; keys
+    # and COUNT must stay bit-exact
+    quant_ok = quant_saved > 0 \
+        and _eq(gotq, want, loose=("s", "sx"), rtol=QUANT_RTOL)
+
+    out = {
+        "metric": "ici_gate", "n_devices": NDEV,
+        "plane_selection_ok": plane_ok,
+        "byte_equal_vs_host_plane": byte_equal,
+        "host_plane_bytes": int(host_bytes),
+        "ici_bytes": int(ici_bytes),
+        "ici_frames": int(ici_frames),
+        "host_bytes_during_ici_run": int(leaked_host_bytes),
+        "bytes_moved_planes": bytes_moved,
+        "no_fallback": no_fallback,
+        "quant_bytes_saved": int(quant_saved),
+        "quant_ok": quant_ok,
+    }
+    ok = plane_ok and byte_equal and bytes_moved and no_fallback \
+        and quant_ok
+    out["ok"] = ok
+    print(json.dumps(out), flush=True)
+    return 0 if ok else 1
+
+
+def main() -> int:
+    if os.environ.get("YDB_TPU_ICI_GATE_CHILD") == "1":
+        return gate()
+    # self-provision the virtual mesh BEFORE jax initializes (the
+    # parent's platform may be a single real chip or a 1-device CPU)
+    from ydb_tpu.utils.vmesh import virtual_mesh_env
+    env = virtual_mesh_env(NDEV)
+    env["YDB_TPU_ICI_GATE_CHILD"] = "1"
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, timeout=900)
+    return r.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,351 @@
+"""DQ channel ICI plane (`ydb_tpu/dq/ici.py`) — differential suite.
+
+Every test drives the REAL pluggable-plane path: LocalWorkers on the
+virtual 8-device CPU mesh (conftest), `dq/lower.py` choosing
+`plane="ici"` for worker-bound edges, the runner executing the
+redistribution as a device collective, and the `YDB_TPU_DQ_PLANE=host`
+lever as the byte-equal oracle. Quantization (`YDB_TPU_DQ_QUANT=1`)
+differentials: SUM/AVG within declared tolerance, keys and
+COUNT/MIN/MAX bit-exact, non-quantizable declared columns refused
+loudly and shipped exact. Failure injection: a worker dying
+mid-collective falls back to the host plane with the query still
+completing.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ydb_tpu.cluster import ShardedCluster
+from ydb_tpu.dq.lower import DqLowerError, DqTopology, lower_select
+from ydb_tpu.dq.runner import DqTaskRunner, LocalWorker
+from ydb_tpu.query import QueryEngine
+from ydb_tpu.sql import parse
+from ydb_tpu.utils.metrics import GLOBAL
+
+NW = 2
+ROWS = 140
+
+# declared quantization tolerance: int8 per-block symmetric codes bound
+# each value's error by maxabs/254 of its block; SUM/AVG over same-sign
+# same-magnitude columns stay within ~1% — 2% is the declared contract
+QUANT_RTOL = 2e-2
+
+
+def _mk_engine(wid: int, nw: int = NW) -> QueryEngine:
+    eng = QueryEngine(block_rows=1 << 12)
+    eng.execute("create table t (id Int64 not null, k Int64 not null, "
+                "v Double not null, tag Utf8 not null, nv Double, "
+                "primary key (id))")
+    eng.execute("create table u (uid Int64 not null, w Double not null, "
+                "x Double not null, primary key (uid))")
+    mine = [i for i in range(ROWS) if i % nw == wid]
+    # v is DYADIC (i * 0.5): float sums are exact in any order, so the
+    # host-vs-ICI comparisons below can demand byte-equality; nv carries
+    # NULLs (object dtype through to_pandas — the mask codec lane)
+    eng.execute(
+        "insert into t (id, k, v, tag, nv) values "
+        + ", ".join(f"({i}, {i % 7}, {i * 0.5}, 'tag{i % 3}', "
+                    + ("null" if i % 5 == 0 else f"{i * 0.25}") + ")"
+                    for i in mine))
+    umine = [i for i in range(7) if i % nw == wid]
+    if umine:
+        # x magnitudes are HOMOGENEOUS (~10..12): per-block int8
+        # quantization bounds error by block-maxabs/254 per value, so
+        # the declared RELATIVE tolerance only holds when a block's
+        # values share a magnitude — the aggregation-tolerant shape
+        # the planner proof targets (prices, measures), not mixtures
+        # spanning orders of magnitude
+        eng.execute("insert into u (uid, w, x) values "
+                    + ", ".join(f"({i}, {i}.0, {10.0 + i * 0.3})"
+                                for i in umine))
+    return eng
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    engines = [_mk_engine(i) for i in range(NW)]
+    c = ShardedCluster([LocalWorker(e, name=f"icw{i}")
+                        for i, e in enumerate(engines)],
+                       merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    return c
+
+
+def _frames_equal(a: pd.DataFrame, b: pd.DataFrame, rtol=None,
+                  loose_cols=()):
+    assert list(a.columns) == list(b.columns)
+    assert len(a) == len(b)
+    for col in a.columns:
+        x, y = a[col].to_numpy(), b[col].to_numpy()
+        if col in loose_cols:
+            np.testing.assert_allclose(x.astype(np.float64),
+                                       y.astype(np.float64), rtol=rtol)
+        elif x.dtype.kind == "f" or y.dtype.kind == "f":
+            assert np.array_equal(x.astype(np.float64),
+                                  y.astype(np.float64),
+                                  equal_nan=True), col
+        else:
+            assert np.array_equal(x, y), col
+
+
+JOIN_SQL = ("select k, count(*) as n, sum(w) as s, min(x) as mn, "
+            "max(x) as mx from t, u where k = uid group by k order by k")
+
+
+# -- lowering: plane selection ---------------------------------------------
+
+
+def _cols(table):
+    return {"t": ["id", "k", "v", "tag", "nv"],
+            "u": ["uid", "w", "x"]}[table]
+
+
+def _topo(ici_devices):
+    return DqTopology(n_workers=2, key_columns={"t": ["id"],
+                                                "u": ["uid"]},
+                      ici_devices=ici_devices)
+
+
+def test_lowering_picks_ici_for_mesh_colocated_edges():
+    g = lower_select(parse(JOIN_SQL), _topo(ici_devices=8), _cols)
+    planes = {ch.kind: ch.plane for ch in g.channels.values()}
+    assert planes["hash_shuffle"] == "ici"     # worker-bound: device edge
+    assert planes["union_all"] == "host"       # router-bound: collected
+    assert "plane=ici" in g.explain()
+
+
+def test_lowering_keeps_host_without_shared_mesh(monkeypatch):
+    g = lower_select(parse(JOIN_SQL), _topo(ici_devices=0), _cols)
+    assert all(ch.plane == "host" for ch in g.channels.values())
+    # the force-host lever beats a capable mesh
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "host")
+    g = lower_select(parse(JOIN_SQL), _topo(ici_devices=8), _cols)
+    assert all(ch.plane == "host" for ch in g.channels.values())
+    # force-ici on an incapable topology refuses instead of lying
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "ici")
+    with pytest.raises(DqLowerError, match="device-colocated"):
+        lower_select(parse(JOIN_SQL), _topo(ici_devices=0), _cols)
+
+
+def test_lowering_proves_quant_tolerance():
+    g = lower_select(parse(
+        "select k, sum(w) as s, avg(x) as a, min(w) as mn from t, u "
+        "where k = uid group by k"), _topo(8), _cols)
+    by_key = {ch.key: ch for ch in g.channels.values()
+              if ch.kind == "hash_shuffle"}
+    # x only feeds AVG → tolerant; w feeds SUM and MIN → exact; the
+    # join keys are never candidates
+    assert by_key["uid"].quant_cols == ["x"]
+    assert by_key["k"].quant_cols == []
+
+
+# -- execution: ICI vs host plane differentials ----------------------------
+
+
+def test_join_ici_byte_equal_to_host_plane(monkeypatch, cluster):
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "host")
+    want = cluster.query(JOIN_SQL)
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    b0 = GLOBAL.get("dq/ici_bytes")
+    cb0 = GLOBAL.get("dq/channel_bytes")
+    got = cluster.query(JOIN_SQL)
+    _frames_equal(got, want)
+    # the edge's bytes moved planes: device collective, zero npz frames
+    assert GLOBAL.get("dq/ici_bytes") > b0
+    assert GLOBAL.get("dq/channel_bytes") == cb0
+    assert GLOBAL.get("dq/ici_frames") >= 2 * NW * NW
+
+
+def test_string_and_nullable_columns_cross_ici(monkeypatch, cluster):
+    """Dictionary (string) and masked (NULL-bearing numeric) codecs:
+    shuffle edges whose payload is not plain numerics still match the
+    host plane byte-for-byte."""
+    sql = ("select tag, count(*) as n, sum(v) as s, sum(nv) as sn "
+           "from t, u where k = uid group by tag order by tag")
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "host")
+    want = cluster.query(sql)
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    got = cluster.query(sql)
+    # nv sums are NOT dyadic — still equal because every worker's rows
+    # land in producer order on both planes
+    _frames_equal(got, want)
+
+
+def test_zero_row_and_skewed_shapes(monkeypatch, cluster):
+    for sql in (
+            # 0-row: no t row survives the filter
+            "select k, count(*) as n, sum(w) as s from t, u "
+            "where k = uid and v < -1 group by k order by k",
+            # skew: one key → every exchanged row lands on ONE consumer
+            "select k, count(*) as n, sum(w) as s from t, u "
+            "where k = uid and k = 3 group by k order by k"):
+        monkeypatch.setenv("YDB_TPU_DQ_PLANE", "host")
+        want = cluster.query(sql)
+        monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+        got = cluster.query(sql)
+        _frames_equal(got, want)
+
+
+# -- quantization differentials --------------------------------------------
+
+
+def test_quant_tolerant_within_declared_tolerance(monkeypatch, cluster):
+    sql = ("select k, count(*) as n, sum(x) as s, avg(x) as a from t, u "
+           "where k = uid group by k order by k")
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    monkeypatch.setenv("YDB_TPU_DQ_QUANT", "0")
+    want = cluster.query(sql)
+    monkeypatch.setenv("YDB_TPU_DQ_QUANT", "1")
+    q0 = GLOBAL.get("dq/quant_bytes_saved")
+    got = cluster.query(sql)
+    # keys + COUNT bit-exact, SUM/AVG within the declared tolerance,
+    # and the saving is measured, not assumed
+    _frames_equal(got, want, rtol=QUANT_RTOL, loose_cols=("s", "a"))
+    assert GLOBAL.get("dq/quant_bytes_saved") > q0
+
+
+def test_quant_never_touches_keys_count_min_max(monkeypatch, cluster):
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    monkeypatch.setenv("YDB_TPU_DQ_QUANT", "0")
+    want = cluster.query(JOIN_SQL)
+    monkeypatch.setenv("YDB_TPU_DQ_QUANT", "1")
+    got = cluster.query(JOIN_SQL)
+    # w feeds SUM only → may quantize… but min/max columns (x) and the
+    # keys/count are bit-exact BY CONSTRUCTION (exact-context columns
+    # never enter quant_cols)
+    _frames_equal(got, want, rtol=QUANT_RTOL, loose_cols=("s",))
+    for col in ("k", "n", "mn", "mx"):
+        assert np.array_equal(got[col].to_numpy(), want[col].to_numpy())
+
+
+def test_quant_refused_on_unquantizable_column(monkeypatch, cluster):
+    """nv is NULL-bearing (object dtype on the wire): the planner may
+    prove it tolerant, but the runtime codec is a masked lane — the
+    quant request must be REFUSED (counted) and shipped exact, never
+    silently lossy."""
+    sql = ("select k, sum(nv) as sn from t, u where k = uid "
+           "group by k order by k")
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    monkeypatch.setenv("YDB_TPU_DQ_QUANT", "0")
+    want = cluster.query(sql)
+    monkeypatch.setenv("YDB_TPU_DQ_QUANT", "1")
+    r0 = GLOBAL.get("dq/quant_refused")
+    got = cluster.query(sql)
+    assert GLOBAL.get("dq/quant_refused") > r0
+    _frames_equal(got, want)         # exact: the refusal shipped verbatim
+
+
+# -- failure: mid-collective worker death → host-plane fallback ------------
+
+
+class _DieOnIciLand(LocalWorker):
+    """Worker whose device plane 'dies' mid-collective: the first landed
+    partition raises a transport error (the in-process analog of a chip
+    dropping out of the mesh between the all_to_all and the barrier)."""
+
+    def __init__(self, engine, name=""):
+        super().__init__(engine, name=name)
+        self.armed = True
+
+    def ici_land(self, channel, df, nbytes, src="ici", seq=None):
+        if self.armed:
+            self.armed = False
+            raise ConnectionError("worker lost mid-collective")
+        return super().ici_land(channel, df, nbytes, src=src, seq=seq)
+
+
+def test_mid_collective_death_falls_back_to_host(monkeypatch):
+    engines = [_mk_engine(i) for i in range(NW)]
+    workers = [_DieOnIciLand(engines[0], name="die0"),
+               LocalWorker(engines[1], name="ok1")]
+    c = ShardedCluster(workers, merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    f0 = GLOBAL.get("dq/ici_fallbacks")
+    got = c.query(JOIN_SQL)
+    assert GLOBAL.get("dq/ici_fallbacks") > f0
+    # the query still COMPLETED, correct, on the host plane
+    oracle = ShardedCluster([LocalWorker(_mk_engine(0, nw=1))])
+    oracle.key_columns["t"] = ["id"]
+    oracle.key_columns["u"] = ["uid"]
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "host")
+    want = oracle.query(JOIN_SQL)
+    _frames_equal(got, want)
+
+
+# -- broadcast edge + observability ----------------------------------------
+
+
+def test_broadcast_edge_rides_ici():
+    """Hand-built Broadcast edge on the ICI plane: all-gather lands
+    EVERY producer's rows on every consumer."""
+    from ydb_tpu.dq.graph import (BROADCAST, UNION_ALL, Channel, Stage,
+                                  StageGraph)
+    engines = [_mk_engine(i) for i in range(NW)]
+    workers = [LocalWorker(e, name=f"bc{i}")
+               for i, e in enumerate(engines)]
+    ch = Channel(id="dqc_ici_b1", kind=BROADCAST, src_stage="s0",
+                 dst_stage="s1", columns=["id", "v"],
+                 table="__xj_dq_ici_bcast", plane="ici")
+    out = Channel(id="dqc_ici_b2", kind=UNION_ALL, src_stage="s1")
+    g = StageGraph(
+        stages=[Stage(id="s0", sql="select id, v from t",
+                      outputs=[ch.id]),
+                Stage(id="s1",
+                      sql=f"select count(*) as c, sum(v) as s "
+                          f"from {ch.table}",
+                      inputs=[ch.id], outputs=[out.id]),
+                Stage(id="merge", inputs=[out.id], on="router",
+                      merge_sel=None)],
+        channels={ch.id: ch, out.id: out}, tag="icib")
+    got = DqTaskRunner(workers, engines[0]).run(g)
+    want_s = sum(i * 0.5 for i in range(ROWS))
+    assert list(got.c) == [ROWS, ROWS]       # each worker saw every row
+    assert list(got.s) == [want_s, want_s]
+
+
+def test_plane_visible_in_explain_and_sysview(monkeypatch, cluster):
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    plan = cluster.query(f"explain analyze {JOIN_SQL}")
+    text = "\n".join(plan["plan"])
+    assert "plane=ici" in text               # per-channel plane column
+    assert "plane ici" in text               # per-task profile rows
+    stats = cluster.query("select stage, plane, ici_bytes "
+                          "from `.sys/dq_stage_stats` "
+                          "where plane = 'ici'")
+    assert len(stats) > 0
+    assert (stats["ici_bytes"].to_numpy() > 0).all()
+
+
+def test_graph_validate_rejects_ici_router_bound():
+    from ydb_tpu.dq.graph import UNION_ALL, Channel, Stage, StageGraph
+    ch = Channel(id="c1", kind=UNION_ALL, src_stage="s0", plane="ici")
+    g = StageGraph(stages=[Stage(id="s0", sql="x", outputs=["c1"]),
+                           Stage(id="merge", inputs=["c1"],
+                                 on="router")],
+                   channels={"c1": ch}, tag="v")
+    with pytest.raises(ValueError, match="ICI-plane and router-bound"):
+        g.validate()
+
+
+def test_quantize_blocked_roundtrip_with_nan():
+    import jax.numpy as jnp
+
+    from ydb_tpu.parallel.collective import (dequantize_blocked,
+                                             quantize_blocked)
+    rng = np.random.default_rng(7)
+    x = rng.normal(size=(4, 256)) * 13.0
+    x[1, 3] = np.nan
+    x[2, :] = 0.0                             # all-zero block: scale 1
+    q, s = quantize_blocked(jnp.asarray(x))
+    assert q.dtype == jnp.int8 and s.shape == (4, 2)
+    back = np.asarray(dequantize_blocked(q, s, np.float64))
+    assert np.isnan(back[1, 3]) and not np.isnan(back[1, 4])
+    finite = ~np.isnan(x)
+    # per-value error bounded by half a quant step of the block's scale
+    np.testing.assert_allclose(back[finite], x[finite],
+                               atol=float(np.nanmax(np.abs(x)) / 127))
+    assert (back[2, :] == 0).all()

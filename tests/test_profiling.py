@@ -190,9 +190,12 @@ def test_dq_trace_assembles_one_cross_worker_tree():
     workers = {by_id[s.parent_id].attrs.get("worker")
                for s in spans if s.name == "task-exec"}
     assert workers == {"local:w0", "local:w1"}
-    # stage stats: channel bytes/rows populated
+    # stage stats: channel bytes/rows populated — on whichever plane
+    # the shuffle edges lowered to (host frames, or the device
+    # collective's ici_bytes under the conftest 8-device mesh)
     stats = list(eng.dq_stage_stats)
-    assert stats and sum(r["bytes"] for r in stats) > 0
+    assert stats and sum(r["bytes"] + r.get("ici_bytes", 0)
+                         for r in stats) > 0
     assert any(r["worker"] == "router" for r in stats)
 
 
@@ -290,13 +293,15 @@ def test_sysview_dq_stage_stats_shape():
     c, engines = mk_dq_cluster()
     c.query("select count(*) as n from t, u where k = uid")
     eng = engines[0]
-    df = eng.query('select stage, worker, rows, bytes, frames, exec_ms, '
+    df = eng.query('select stage, worker, rows, bytes, frames, plane, '
+                   'ici_bytes, exec_ms, '
                    'input_wait_ms, backpressure_wait_ms, attempts '
                    'from ".sys/dq_stage_stats"')
     assert len(df) >= 3                      # ≥2 worker tasks + router
     assert set(df.worker) >= {"local:w0", "local:w1", "router"}
     assert (df.attempts >= 1).all()
-    assert df.bytes.sum() > 0
+    # channel traffic lands on the plane the edge lowered to
+    assert df.bytes.sum() + df.ici_bytes.sum() > 0
     # composes with SQL like any table
     agg = eng.query('select worker, sum(rows) as r from '
                     '".sys/dq_stage_stats" group by worker '

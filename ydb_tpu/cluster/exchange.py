@@ -63,7 +63,19 @@ def hash_partition(df: pd.DataFrame, key: str, n_parts: int,
     if not notna.all():
         df = df[notna]
         col = df[key]
-    vals = col.to_numpy()
+    part = key_buckets(col.to_numpy(), n_parts, kind)
+    return [df[part == p] for p in range(n_parts)]
+
+
+def key_buckets(vals: np.ndarray, n_parts: int, kind: str = None
+                ) -> np.ndarray:
+    """Per-value consumer bucket for a NULL-free key array — the ONE
+    routing function every channel plane shares. The host plane's
+    `hash_partition` splits frames by it; the ICI plane
+    (`ydb_tpu/dq/ici.py`) feeds the same buckets into the device
+    all_to_all, so a key hashes to the same consumer no matter which
+    plane its edge lowered to (and the two sides of a join agree even
+    when their edges took different planes)."""
     if kind is None:                  # no schema available: dtype guess
         if vals.dtype == object or vals.dtype.kind in ("U", "S", "T"):
             kind = "string"
@@ -103,8 +115,7 @@ def hash_partition(df: pd.DataFrame, key: str, n_parts: int,
         else:
             arr = arr.astype(np.int64)
         h = splitmix64(np, arr)
-    part = (h % np.uint64(n_parts)).astype(np.int64)
-    return [df[part == p] for p in range(n_parts)]
+    return (h % np.uint64(n_parts)).astype(np.int64)
 
 
 def pack_frame(header: dict, df: pd.DataFrame) -> bytes:

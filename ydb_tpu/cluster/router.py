@@ -269,16 +269,34 @@ class ShardedCluster:
             cols = cache[table] = list(resp["columns"])
         return cols
 
+    def _ici_devices(self) -> int:
+        """Devices of ONE JAX mesh the DQ runner can drive directly: the
+        worker set must be entirely in-process (`LocalWorker` — gRPC
+        endpoints are separate OS processes with separate meshes, DCN
+        seam) and this process must expose at least one device per
+        worker. 0 = host plane only."""
+        if not self.workers or \
+                not all(hasattr(w, "ici_land") for w in self.workers):
+            return 0
+        try:
+            import jax
+            n = len(jax.devices())
+        except Exception:                    # noqa: BLE001 — no backend,
+            return 0                         # no device plane
+        return n if n >= len(self.workers) else 0
+
     def _lower(self, stmt: ast.Select):
         from ydb_tpu.dq.lower import DqLowerError, DqTopology, lower_select
         if self.hive is not None:
             topo = DqTopology.from_hive(
                 self.hive, replicated=set(self.replicated),
-                key_columns=dict(self.key_columns))
+                key_columns=dict(self.key_columns),
+                ici_devices=self._ici_devices())
         else:
             topo = DqTopology(n_workers=len(self.workers),
                               replicated=set(self.replicated),
-                              key_columns=dict(self.key_columns))
+                              key_columns=dict(self.key_columns),
+                              ici_devices=self._ici_devices())
         try:
             return lower_select(stmt, topo, self._table_columns)
         except DqLowerError as e:
@@ -504,6 +522,15 @@ class ShardedCluster:
             lines.append(f"  stage {stage.id} on={stage.on} "
                          f"in={list(stage.inputs)} "
                          f"out={list(stage.outputs)}")
+        # per-channel data plane: which edges go device-resident (ICI
+        # collective) vs host gRPC frames — the operator-facing half of
+        # the pluggable-plane lowering
+        for ch in graph.channels.values():
+            lines.append(
+                f"  channel {ch.id} kind={ch.kind} plane={ch.plane}"
+                + (f" key={ch.key}" if ch.key else "")
+                + (f" quant_cols={ch.quant_cols}" if ch.quant_cols
+                   else ""))
         if not stmt.analyze:
             return pd.DataFrame({"plan": lines})
         # run the SAME lowered graph the listing above describes —
@@ -518,6 +545,8 @@ class ShardedCluster:
             lines.append(
                 f"  {r['stage']}@{r['worker']}: rows {r['rows']} | "
                 f"bytes {r['bytes']} | frames {r['frames']} | "
+                f"plane {r.get('plane', 'host')} | "
+                f"ici-bytes {r.get('ici_bytes', 0)} | "
                 f"exec {r['exec_ms']:.1f}ms | flush {r['flush_ms']:.1f}ms"
                 f" | input-wait {r['input_wait_ms']:.1f}ms | "
                 f"backpressure {r['backpressure_wait_ms']:.1f}ms | "

@@ -19,6 +19,25 @@ frames return in the task response and the final router stage merges
 them locally. Worker-bound channels land in each consumer's exchange
 buffer and materialize as transient `__xj_*` tables before the consumer
 stage runs (the stage barrier).
+
+Every channel additionally carries a *data plane* — which wire its rows
+actually cross:
+
+  host   npz frames over the workers' gRPC front (`cluster/exchange.py`
+         ChannelWriter → ExchangePut), the DCN seam; always available;
+  ici    device-resident redistribution over the JAX mesh
+         (`ydb_tpu/dq/ici.py`: bucketize + `lax.all_to_all` + compact,
+         broadcast as all-gather), chosen at lowering time when BOTH
+         endpoints' tasks run on devices of the same mesh — no npz, no
+         gRPC, bytes counted on `dq/ici_bytes` instead of
+         `dq/channel_bytes`. A failed ICI exchange falls back to
+         re-running the edge on the host plane.
+
+`quant_cols` lists the columns the planner PROVED aggregation-tolerant
+(pure SUM/AVG inputs behind a final reduction — EQuARX, arxiv
+2506.17615): the ICI plane may block-quantize exactly these (int8 +
+per-block scale) under `YDB_TPU_DQ_QUANT=1`; keys and group-by columns
+are never listed, so they always cross exact.
 """
 
 from __future__ import annotations
@@ -32,6 +51,10 @@ UNION_ALL = "union_all"
 MERGE = "merge"
 
 CHANNEL_KINDS = (HASH_SHUFFLE, BROADCAST, UNION_ALL, MERGE)
+
+PLANE_HOST = "host"
+PLANE_ICI = "ici"
+CHANNEL_PLANES = (PLANE_HOST, PLANE_ICI)
 
 # consumer-side temp tables must live inside the shuffle-temp namespace
 # the channel RPCs enforce (`server/service.py` SHUFFLE_TMP_PREFIX)
@@ -47,6 +70,10 @@ class Channel:
     key: str = ""                   # hash_shuffle: routing column
     columns: list = field(default_factory=list)   # produced column names
     table: str = ""                 # consumer-side temp table name
+    plane: str = PLANE_HOST         # host (gRPC frames) | ici (mesh)
+    # columns proven aggregation-tolerant by the lowering — the ONLY
+    # candidates for block quantization on the ICI plane
+    quant_cols: list = field(default_factory=list)
 
     @property
     def router_bound(self) -> bool:
@@ -110,6 +137,13 @@ class StageGraph:
             if ch.kind in (HASH_SHUFFLE, BROADCAST) and ch.router_bound:
                 raise ValueError(f"{ch.kind} channel {ch.id} cannot be "
                                  "router-bound")
+            if ch.plane not in CHANNEL_PLANES:
+                raise ValueError(f"bad channel plane {ch.plane!r}")
+            if ch.plane == PLANE_ICI and ch.router_bound:
+                # router-bound channels collect in the task response;
+                # there is no device edge to ride
+                raise ValueError(f"channel {ch.id} cannot be ICI-plane "
+                                 "and router-bound")
             if not ch.router_bound and not ch.table.startswith("__xj_"):
                 raise ValueError(f"channel temp {ch.table!r} outside the "
                                  "__xj_* namespace")
@@ -129,6 +163,8 @@ class StageGraph:
                 f"{c}:{self.channels[c].kind}"
                 + (f"({self.channels[c].key})"
                    if self.channels[c].key else "")
+                + (f" plane={self.channels[c].plane}"
+                   if self.channels[c].plane != PLANE_HOST else "")
                 for c in s.outputs)
             lines.append(f"stage {s.id} on={s.on}"
                          + (f" inputs={s.inputs}" if s.inputs else "")

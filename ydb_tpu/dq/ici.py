@@ -1,0 +1,409 @@
+"""The DQ channel ICI data plane — device-resident redistribution.
+
+A host-plane channel serializes every partition to an npz frame and
+round-trips it through gRPC (`cluster/exchange.py ChannelWriter` →
+ExchangePut), so shuffle bandwidth between chips on the SAME mesh is
+gRPC-bound. When the lowering marks an edge `plane="ici"` (both
+endpoints' tasks run on devices of one JAX mesh — `dq/lower.py
+_assign_planes`), the runner executes the redistribution here instead:
+
+  hash_shuffle   bucketize + `lax.all_to_all` + compact — the portable
+                 collective shuffle of `parallel/shuffle.py` (arxiv
+                 2112.01075), over the SAME per-row buckets the host
+                 plane would compute (`cluster/exchange.key_buckets`),
+                 so a key routes to the same consumer on either plane
+                 and the two sides of a join agree even if their edges
+                 lowered differently;
+  broadcast      all-gather of every producer's rows to every consumer.
+
+On top, optional EQuARX-style block quantization (arxiv 2506.17615):
+columns the lowering PROVED aggregation-tolerant (`Channel.quant_cols`
+— pure SUM/AVG inputs behind a final reduction) cross the wire as int8
+codes + per-block float32 scales (~1/8 the bytes) when
+`YDB_TPU_DQ_QUANT=1`; keys, group-bys and every other exact-context
+column always ship verbatim. A quant request the runtime cannot honor
+(non-float column) is REFUSED loudly — counted on `dq/quant_refused`,
+shipped exact — never silently lossy.
+
+Anything this plane cannot express (exotic dtypes, mixed object
+columns, a mesh that went away) raises `IciPlaneError`; the runner
+catches it and re-runs the edge on the host plane — correctness never
+depends on the fast path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pandas as pd
+
+from ydb_tpu.parallel.collective import (QUANT_BLOCK, bucket_segments,
+                                         compact_segments,
+                                         dequantize_blocked,
+                                         exchange_segments, gather_all,
+                                         quantize_blocked)
+
+AXIS = "shards"
+
+
+class IciPlaneError(Exception):
+    """This edge cannot (or could not) run device-resident; the runner
+    falls back to the host plane."""
+
+
+def quant_enabled() -> bool:
+    """`YDB_TPU_DQ_QUANT` lever: 0/unset = off (byte-equal frames)."""
+    return os.environ.get("YDB_TPU_DQ_QUANT", "0").strip() == "1"
+
+
+# -- mesh + compiled-exchange caches ---------------------------------------
+
+_MESHES: dict = {}
+_FNS: dict = {}
+
+
+def _mesh(ndev: int):
+    import jax
+    from jax.sharding import Mesh
+    m = _MESHES.get(ndev)
+    if m is None:
+        devs = jax.devices()
+        if len(devs) < ndev:
+            raise IciPlaneError(
+                f"ICI plane needs {ndev} mesh devices, platform has "
+                f"{len(devs)}")
+        m = _MESHES[ndev] = Mesh(np.array(devs[:ndev]), (AXIS,))
+    return m
+
+
+# -- column codecs ---------------------------------------------------------
+#
+# Every landed column must be indistinguishable from the host plane's
+# npz round trip: plain numeric dtypes pass through; object columns
+# (how `to_pandas` renders NULL-bearing numerics and strings) ride as
+# typed arrays + valid masks (+ a shared dictionary for strings) and
+# decode back to object-with-None.
+
+_NUM = "num"
+_MASK_INT = "maskint"
+_MASK_FLOAT = "maskfloat"
+_DICT = "dict"
+
+
+def _classify(series_per_dev: list, col: str, hint: str):
+    """One codec per column, decided over ALL producers (the same
+    column can be int64 on a NULL-free shard and object on another)."""
+    dts = {str(s.dtype) for s in series_per_dev if len(s)}
+    if not dts:
+        dts = {hint or "float64"}
+    objish = {"object", "str", "string"}
+    if not (dts & objish):
+        if len(dts) > 1:
+            raise IciPlaneError(f"column {col!r}: producers disagree on "
+                                f"dtype ({sorted(dts)})")
+        np_dt = np.dtype(next(iter(dts)))
+        if np_dt.kind not in "iufb":
+            raise IciPlaneError(f"column {col!r}: dtype {np_dt} is not "
+                                "ICI-encodable")
+        return (_NUM, np_dt)
+    vals = [v for s in series_per_dev for v in s.dropna().tolist()]
+    if all(isinstance(v, (int, np.integer)) and not isinstance(v, bool)
+           for v in vals):
+        return (_MASK_INT, np.dtype(np.int64))
+    if all(isinstance(v, (int, float, np.integer, np.floating))
+           and not isinstance(v, bool) for v in vals):
+        return (_MASK_FLOAT, np.dtype(np.float64))
+    if all(isinstance(v, str) for v in vals):
+        # shared dictionary across every producer: codes agree on all
+        # devices, values ship once host-side (metadata, not row bytes)
+        values = sorted(set(vals))
+        return (_DICT, np.dtype(np.int32), values)
+    raise IciPlaneError(f"column {col!r}: mixed object values are not "
+                        "ICI-encodable")
+
+
+def _encode(series: pd.Series, spec, cap: int):
+    """→ (data[cap], valid[cap]) numpy arrays for one producer."""
+    n = len(series)
+    valid = np.ones(cap, np.bool_)
+    valid[n:] = False
+    if spec[0] == _NUM:
+        data = np.zeros(cap, spec[1])
+        data[:n] = series.to_numpy(dtype=spec[1], copy=False)
+        return data, valid
+    notna = series.notna().to_numpy() if n else np.zeros(0, np.bool_)
+    valid[:n] = notna
+    data = np.zeros(cap, spec[1])
+    if spec[0] == _DICT:
+        code_of = {v: i for i, v in enumerate(spec[2])}
+        vals = series.to_numpy()
+        data[:n] = [code_of[v] if m else 0
+                    for v, m in zip(vals, notna)]
+    elif n:
+        if series.dtype != object:        # NULL-free numeric producer
+            data[:n] = series.to_numpy(dtype=spec[1], copy=False)
+        else:
+            vals = series.to_numpy()
+            data[:n] = [spec[1].type(v) if m else 0
+                        for v, m in zip(vals, notna)]
+    return data, valid
+
+
+def _decode(spec, data: np.ndarray, valid: np.ndarray):
+    """Per-consumer column: device output rows → the pandas column the
+    host plane's npz round trip would have landed."""
+    data = np.asarray(data)
+    valid = np.asarray(valid)
+    if spec[0] == _NUM:
+        return data.astype(spec[1], copy=False)
+    if spec[0] == _DICT:
+        pool = np.asarray(spec[2], dtype=object)
+        out = np.array(
+            pool[np.clip(data.astype(np.int64), 0,
+                         max(len(pool) - 1, 0))]
+            if len(pool) else np.zeros(len(data), object),
+            dtype=object)
+    else:
+        out = data.astype(spec[1], copy=False).astype(object)
+    out[~valid] = None
+    return out
+
+
+# -- the exchange ----------------------------------------------------------
+
+
+def _wire_bytes_per_row(spec, quantized: bool) -> float:
+    """Bytes one row of this column occupies on the interconnect (data
+    + valid mask; quantized columns ride int8 codes + amortized
+    per-block scale)."""
+    if quantized:
+        return 1 + 4.0 / QUANT_BLOCK + 1
+    return spec[1].itemsize + 1
+
+
+def _build_shuffle_fn(mesh, ndev, cap, seg, names, dtypes, quant_names):
+    """Compile the shard-mapped bucketize → (quantize) → all_to_all →
+    (dequantize) → compact program for one signature. `seg` is the
+    per-target segment capacity: smaller than `cap` cuts wire bytes
+    proportionally (uniform hashing puts ~rows/ndev in each target);
+    the returned overflow flag tells the host to rerun with full
+    segments when a target bucket didn't fit (the DQ channel spilling
+    analog, same discipline as `DistributedAgg.run`)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ydb_tpu.parallel._compat import shard_map
+
+    def per_device(arrays, valids, bucket, length):
+        env = {n: (arrays[n][0], valids[n][0]) for n in names}
+        stacked_d, stacked_v, cnts, ovf = bucket_segments(
+            env, bucket[0], length[0], cap, seg, ndev, names)
+        scales = {}
+        for n in quant_names:
+            stacked_d[n], scales[n] = quantize_blocked(stacked_d[n])
+        recv_d, recv_v, recv_c = exchange_segments(
+            stacked_d, stacked_v, cnts, names, axis=AXIS)
+        recv_s = {n: jax.lax.all_to_all(scales[n], AXIS, 0, 0,
+                                        tiled=False)
+                  for n in quant_names}
+        for n in quant_names:
+            recv_d[n] = dequantize_blocked(recv_d[n], recv_s[n],
+                                           dtypes[n])
+        env2, tot = compact_segments(recv_d, recv_v, recv_c, seg, ndev,
+                                     names)
+        out_d = {n: env2[n][0] for n in names}
+        out_v = {n: (env2[n][1] if env2[n][1] is not None
+                     else jnp.ones_like(out_d[n], dtype=jnp.bool_))
+                 for n in names}
+        return out_d, out_v, tot, ovf
+
+    def wrapper(arrays, valids, bucket, length):
+        out_d, out_v, tot, ovf = per_device(arrays, valids, bucket,
+                                            length)
+        return ({n: x[None] for n, x in out_d.items()},
+                {n: x[None] for n, x in out_v.items()}, tot[None],
+                ovf[None])
+
+    pspec_in = ({n: P(AXIS, None) for n in names},
+                {n: P(AXIS, None) for n in names},
+                P(AXIS, None), P(AXIS))
+    return jax.jit(shard_map(
+        wrapper, mesh=mesh, in_specs=pspec_in,
+        out_specs=(P(AXIS, None), P(AXIS, None), P(AXIS), P(AXIS)),
+        check_vma=False))
+
+
+def _build_broadcast_fn(mesh, ndev, cap, names):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ydb_tpu.parallel._compat import shard_map
+
+    def wrapper(arrays, valids, length):
+        d = {n: arrays[n][0] for n in names}
+        v = {n: valids[n][0] for n in names}
+        env2, tot = gather_all(d, v, length[0], cap, ndev, names,
+                               axis=AXIS)
+        out_d = {n: env2[n][0] for n in names}
+        out_v = {n: (env2[n][1] if env2[n][1] is not None
+                     else jnp.ones_like(out_d[n], dtype=jnp.bool_))
+                 for n in names}
+        return ({n: x[None] for n, x in out_d.items()},
+                {n: x[None] for n, x in out_v.items()}, tot[None])
+
+    pspec_in = ({n: P(AXIS, None) for n in names},
+                {n: P(AXIS, None) for n in names},
+                P(AXIS))
+    return jax.jit(shard_map(
+        wrapper, mesh=mesh, in_specs=pspec_in,
+        out_specs=(P(AXIS, None), P(AXIS, None), P(AXIS)),
+        check_vma=False))
+
+
+def exchange(ch, dfs: list, key_kind: str = None,
+             dtypes_hint: dict = None, counters=None) -> tuple:
+    """Execute one ICI-plane channel over its producers' stage outputs.
+
+    `dfs[d]` is mesh device d's stage output (one per worker, worker
+    order). Returns `(out_dfs, stats)`: the per-consumer landed frames
+    and `{"ici_bytes", "ici_frames", "quant_bytes_saved", "quant_cols",
+    "quant_refused"}`. Raises `IciPlaneError` when the edge cannot run
+    device-resident (the caller falls back to the host plane)."""
+    from ydb_tpu.dq.graph import BROADCAST, HASH_SHUFFLE
+    from ydb_tpu.ops.device import bucket_capacity
+
+    ndev = len(dfs)
+    if ndev < 2:
+        raise IciPlaneError("ICI plane needs at least 2 producers")
+    mesh = _mesh(ndev)
+    if ch.kind not in (HASH_SHUFFLE, BROADCAST):
+        raise IciPlaneError(f"channel kind {ch.kind!r} has no ICI form")
+
+    columns = None
+    for df in dfs:
+        if list(df.columns):
+            columns = list(df.columns)
+            break
+    if columns is None:
+        columns = list(ch.columns)
+    if not columns:
+        raise IciPlaneError(f"channel {ch.id}: no columns to exchange")
+
+    if ch.kind == HASH_SHUFFLE:
+        from ydb_tpu.cluster.exchange import key_buckets
+        # host-plane parity: NULL join keys drop (inner semantics), and
+        # the bucket per row is the SAME hash the host plane routes by
+        dropped = []
+        buckets = []
+        for df in dfs:
+            keep = df[ch.key].notna()
+            df = df[keep] if not keep.all() else df
+            dropped.append(df)
+            try:
+                buckets.append(
+                    key_buckets(df[ch.key].to_numpy(), ndev, key_kind)
+                    if len(df) else np.zeros(0, np.int64))
+            except ValueError as e:
+                raise IciPlaneError(f"channel {ch.id} key {ch.key!r}: "
+                                    f"{e}") from e
+        dfs = dropped
+
+    hints = dtypes_hint or {}
+    specs = {c: _classify([df[c] for df in dfs], c, hints.get(c))
+             for c in columns}
+
+    # quantization: only lowering-proven columns, only plain floats,
+    # only with the lever on. A declared column the runtime cannot
+    # quantize is refused LOUDLY and shipped exact.
+    quant_names: list = []
+    refused: list = []
+    if quant_enabled():
+        for c in ch.quant_cols:
+            spec = specs.get(c)
+            if spec is not None and spec[0] == _NUM \
+                    and spec[1].kind == "f":
+                quant_names.append(c)
+            elif spec is not None:
+                refused.append(c)
+        if refused and counters is not None:
+            counters.inc("dq/quant_refused", len(refused))
+
+    cap = bucket_capacity(max(max((len(df) for df in dfs), default=0),
+                              1), minimum=QUANT_BLOCK)
+    arrays = {}
+    valids = {}
+    for c in columns:
+        enc = [_encode(df[c] if c in df.columns
+                       else pd.Series(np.zeros(0, specs[c][1])),
+                       specs[c], cap) for df in dfs]
+        arrays[c] = np.stack([d for (d, _v) in enc])
+        valids[c] = np.stack([v for (_d, v) in enc])
+    lengths = np.array([len(df) for df in dfs], np.int32)
+
+    names = tuple(columns)
+    dt_sig = tuple((c, specs[c][0], str(specs[c][1])) for c in names)
+    if ch.kind == HASH_SHUFFLE:
+        bucket = np.zeros((ndev, cap), np.int32)
+        for d, b in enumerate(buckets):
+            bucket[d, :len(b)] = b.astype(np.int32)
+        # segment sizing: uniform hashing sends ~rows/ndev to each
+        # target, so 2× that (power-of-two) usually fits and cuts wire
+        # bytes vs full-capacity segments; a skewed edge overflows on
+        # device and reruns ONCE with seg = cap, which cannot overflow
+        # (a target receives at most one producer's full row count)
+        max_rows = max((len(df) for df in dfs), default=0)
+        seg = min(cap, bucket_capacity(
+            max(1, (2 * max_rows + ndev - 1) // ndev),
+            minimum=QUANT_BLOCK))
+        while True:
+            sig = ("shuffle", ndev, cap, seg, dt_sig,
+                   tuple(quant_names))
+            fn = _FNS.get(sig)
+            if fn is None:
+                dtypes = {c: specs[c][1] for c in names}
+                fn = _FNS[sig] = _build_shuffle_fn(
+                    mesh, ndev, cap, seg, names, dtypes,
+                    tuple(quant_names))
+            out_d, out_v, lens, ovf = fn(arrays, valids, bucket,
+                                         lengths)
+            if not bool(np.any(np.asarray(ovf))):
+                break
+            assert seg < cap, "full-capacity segments cannot overflow"
+            seg = cap
+    else:
+        seg = cap                      # broadcast gathers full buffers
+        sig = ("broadcast", ndev, cap, dt_sig)
+        fn = _FNS.get(sig)
+        if fn is None:
+            fn = _FNS[sig] = _build_broadcast_fn(mesh, ndev, cap, names)
+        out_d, out_v, lens = fn(arrays, valids, lengths)
+
+    lens = np.asarray(lens)
+    out_dfs = []
+    for d in range(ndev):
+        n = int(lens[d])
+        cols = {c: _decode(specs[c], np.asarray(out_d[c][d][:n]),
+                           np.asarray(out_v[c][d][:n]))
+                for c in columns}
+        out_dfs.append(pd.DataFrame(cols, columns=columns))
+
+    # wire accounting: what the collective actually moved — every
+    # (src, dst) pair carries one seg-row segment per column (payload +
+    # valid mask; broadcast replicates each producer's full cap-row
+    # buffer to every device), plus the per-segment row counts
+    per_row = sum(_wire_bytes_per_row(specs[c], c in quant_names)
+                  for c in columns)
+    exact_row = sum(_wire_bytes_per_row(specs[c], False)
+                    for c in columns)
+    segs = ndev * ndev
+    stats = {
+        "ici_bytes": int(segs * seg * per_row + segs * 4),
+        "ici_frames": segs,
+        "quant_bytes_saved": int(segs * seg * (exact_row - per_row)),
+        "quant_cols": list(quant_names),
+        "quant_refused": list(refused),
+    }
+    return out_dfs, stats

@@ -28,11 +28,31 @@ import uuid
 from dataclasses import dataclass, field
 
 from ydb_tpu.dq.graph import (DQ_TMP_PREFIX, HASH_SHUFFLE, INPUT_TABLE,
-                              MERGE, UNION_ALL, Channel, Stage,
-                              StageGraph)
+                              MERGE, PLANE_ICI, UNION_ALL, Channel,
+                              Stage, StageGraph)
 from ydb_tpu.sql import ast, render
 
 AGGS = ("sum", "count", "min", "max", "avg")
+
+# aggregates whose inputs tolerate bounded per-value error (a final
+# reduction absorbs it — the EQuARX stance): their argument columns may
+# block-quantize on the ICI plane. COUNT/MIN/MAX do NOT qualify: count
+# ignores values but min/max REPORT one, and a quantized extremum would
+# surface verbatim in the result
+TOLERANT_AGGS = ("sum", "avg")
+
+
+def plane_mode() -> str:
+    """The `YDB_TPU_DQ_PLANE` lever: `auto` (ICI where both endpoints
+    share a mesh), `host` (force gRPC frames everywhere — the byte-equal
+    escape hatch), `ici` (refuse to lower rather than fall back)."""
+    import os
+    mode = (os.environ.get("YDB_TPU_DQ_PLANE", "auto").strip().lower()
+            or "auto")
+    if mode not in ("auto", "host", "ici"):
+        raise DqLowerError(f"YDB_TPU_DQ_PLANE={mode!r} — expected "
+                           "auto | host | ici")
+    return mode
 
 
 class DqLowerError(Exception):
@@ -50,10 +70,20 @@ class DqTopology:
     replicated: set = field(default_factory=set)
     key_columns: dict = field(default_factory=dict)  # sharded: table -> pk
     placement_epoch: int = 0
+    # devices of ONE JAX mesh the runner can drive directly (0 = workers
+    # are separate OS processes — no shared mesh, host plane only). Set
+    # by the router when every worker is in-process and the process
+    # exposes at least n_workers devices: that is the "both endpoints on
+    # the same mesh" condition the ICI plane needs.
+    ici_devices: int = 0
+
+    @property
+    def ici_capable(self) -> bool:
+        return 2 <= self.n_workers <= self.ici_devices
 
     @classmethod
-    def from_hive(cls, hive, replicated=(), key_columns=None
-                  ) -> "DqTopology":
+    def from_hive(cls, hive, replicated=(), key_columns=None,
+                  ici_devices: int = 0) -> "DqTopology":
         orphans = hive.orphaned_shards()
         if orphans:
             # refusing beats silently returning a partial scan: these
@@ -69,7 +99,8 @@ class DqTopology:
                 "the cluster has no queryable topology")
         return cls(n_workers=len(eps), replicated=set(replicated),
                    key_columns=dict(key_columns or {}),
-                   placement_epoch=hive.epoch)
+                   placement_epoch=hive.epoch,
+                   ici_devices=int(ici_devices))
 
 
 # -- AST helpers (moved from cluster/router.py — shared by lowerings) ------
@@ -280,6 +311,35 @@ def cross_equality(e, a: str, b: str, binds: dict, table_cols: dict):
     return None
 
 
+def split_name_contexts(node, exact: list, tolerant: list,
+                        in_tolerant: bool = False) -> None:
+    """Collect column references by usage context: inside a SUM/AVG
+    argument (`tolerant` — a final reduction absorbs bounded per-value
+    error) vs anywhere else (`exact` — keys, group-bys, filters,
+    COUNT/MIN/MAX args, ORDER BY). The quantization planner only trusts
+    a column that NEVER appears in an exact context."""
+    if isinstance(node, ast.Name):
+        (tolerant if in_tolerant else exact).append(node.parts)
+        return
+    if isinstance(node, ast.FuncCall) and node.name in AGGS:
+        inner = node.name in TOLERANT_AGGS and not node.distinct
+        for a in node.args:
+            if hasattr(a, "__dataclass_fields__"):
+                split_name_contexts(a, exact, tolerant, inner)
+        return
+    for f in getattr(node, "__dataclass_fields__", ()):
+        v = getattr(node, f)
+        vs = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vs:
+            if isinstance(x, tuple):
+                for y in x:
+                    if hasattr(y, "__dataclass_fields__"):
+                        split_name_contexts(y, exact, tolerant,
+                                            in_tolerant)
+            elif hasattr(x, "__dataclass_fields__"):
+                split_name_contexts(x, exact, tolerant, in_tolerant)
+
+
 def rewrite_relation(rel, temp_of: dict):
     """Swap sharded TableRefs for their shuffle-temp names, keeping the
     original bind name as the alias so every column reference resolves
@@ -376,7 +436,29 @@ def lower_select(sel: ast.Select, topo: DqTopology,
         _lower_two_phase(b, sel, inputs=[])
     g = b.graph()
     g.placement_epoch = topo.placement_epoch
+    _assign_planes(g, topo)
     return g
+
+
+def _assign_planes(g: StageGraph, topo: DqTopology) -> None:
+    """Pick each channel's data plane. Worker-bound edges (both
+    endpoints are worker tasks) go device-resident when the topology
+    says every worker sits on one JAX mesh; router-bound edges always
+    collect over the host plane. `YDB_TPU_DQ_PLANE` overrides."""
+    mode = plane_mode()
+    if mode == "host":
+        return                         # default plane on every channel
+    if mode == "ici" and not topo.ici_capable:
+        raise DqLowerError(
+            f"YDB_TPU_DQ_PLANE=ici but the topology is not "
+            f"device-colocated ({topo.n_workers} worker(s), "
+            f"{topo.ici_devices} mesh device(s)) — the ICI plane needs "
+            "every worker on one JAX mesh")
+    if not topo.ici_capable:
+        return
+    for ch in g.channels.values():
+        if not ch.router_bound:
+            ch.plane = PLANE_ICI
 
 
 def _lower_two_phase(b: _Builder, sel: ast.Select, inputs: list) -> None:
@@ -579,6 +661,21 @@ def _lower_shuffle_scans(b: _Builder, sel: ast.Select, sharded: list,
     used[a].add(key_a)
     used[bt].add(key_b)
 
+    # quantization proof: a shipped column is aggregation-tolerant iff
+    # EVERY reference to it sits inside a SUM/AVG argument — those feed
+    # a final reduction that absorbs the per-value quant error. Keys,
+    # group-bys, filters and COUNT/MIN/MAX inputs must cross exact.
+    exact_refs: list = []
+    tol_refs: list = []
+    split_name_contexts(sel, exact_refs, tol_refs)
+    exact_cols: dict = {t: set() for t in binds.values()}
+    tol_cols: dict = {t: set() for t in binds.values()}
+    for refs, bucket in ((exact_refs, exact_cols), (tol_refs, tol_cols)):
+        for parts in refs:
+            t = attribute(parts, binds, cols)
+            if t is not None:
+                bucket[t].add(parts[-1])
+
     temp_of = {t: f"{DQ_TMP_PREFIX}{b.tag}_{t}" for t in sharded}
     channels = []
     for t, key in ((a, key_a), (bt, key_b)):
@@ -596,6 +693,8 @@ def _lower_shuffle_scans(b: _Builder, sel: ast.Select, sharded: list,
         s = Stage(id=f"s{len(b.stages)}", sql=render.select(stage_sel))
         ch = b.channel(HASH_SHUFFLE, src=s.id, dst="join", key=key,
                        columns=sorted(used[t]), table=temp_of[t])
+        ch.quant_cols = sorted(
+            ((tol_cols[t] - exact_cols[t]) & used[t]) - {key})
         s.outputs = [ch.id]
         b.stages.append(s)
         channels.append(ch.id)

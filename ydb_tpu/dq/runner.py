@@ -95,6 +95,10 @@ class DqTaskRunner:
         # `dq_stage_stats` ring (`.sys/dq_stage_stats`) after the run
         self.stage_stats: list = []
         self._input_waits: dict = {}         # (stage id, widx) -> ms
+        # per-stage device-plane wire accounting (filled by the ICI
+        # exchanges): stage id -> {"ici_bytes", "ici_frames",
+        # "quant_bytes_saved"} — attributed into the stage-stats rows
+        self._ici_stage_stats: dict = {}
         # endpoints whose last RPC died at the transport level: later
         # attempts/stages skip them (reroute single-task stages, raise
         # DqWorkerLost for per-shard ones) instead of re-timing-out —
@@ -177,17 +181,35 @@ class DqTaskRunner:
     def _run_worker_stage(self, graph, stage) -> None:
         from ydb_tpu.utils.metrics import GLOBAL_HIST
         self.counters.inc("dq/stages")
+        if self._stage_ici_channels(graph, stage) \
+                and not all(hasattr(w, "ici_land") for w in self.workers):
+            # defense in depth: the lowering promised a shared mesh the
+            # runner's worker set cannot honor (e.g. a gRPC endpoint
+            # joined after lowering) — the host plane is always correct
+            self._flip_to_host(graph, stage,
+                               "workers are not mesh-colocated")
         t_stage = time.perf_counter()
         with self._span("dq-stage", stage=stage.id,
                         tasks=len(self._task_workers(stage))):
             self._materialize_inputs(graph, stage)
-            specs = []
-            for cid in stage.outputs:
-                ch = graph.channels[cid]
-                specs.append({"channel": ch.id, "kind": ch.kind,
-                              "key": ch.key, "n_peers": len(self.workers),
-                              "peers": [w.endpoint for w in self.workers]})
-            results, tasks = self._run_stage_attempts(graph, stage, specs)
+            results, tasks = self._run_stage_attempts(
+                graph, stage, self._output_specs(graph, stage))
+            ici_chs = self._stage_ici_channels(graph, stage)
+            if ici_chs:
+                try:
+                    self._run_ici_exchanges(graph, stage, ici_chs,
+                                            results)
+                except Exception as e:       # noqa: BLE001 — ANY failed
+                    # device exchange (mid-collective worker death,
+                    # codec refusal, mesh gone) falls back to re-running
+                    # the edge on the host plane: same stage programs,
+                    # fresh host frames, the receivers' (src, seq) dedup
+                    # guards the overlap
+                    self._flip_to_host(graph, stage,
+                                       f"{type(e).__name__}: {e}")
+                    self._drop_outputs(graph, stage)
+                    results, tasks = self._run_stage_attempts(
+                        graph, stage, self._output_specs(graph, stage))
         # success-only, matching the router stage and query/latency_ms:
         # a timed-out stage would inject an rpc-timeout artifact
         GLOBAL_HIST.observe("dq/stage_ms",
@@ -206,6 +228,77 @@ class DqTaskRunner:
                               resp.get("bytes_shipped", 0))
             self.counters.inc("dq/frames", resp.get("frames_shipped", 0))
             self._note_task_stats(graph, stage, tasks[i], resp, i)
+
+    # -- channel planes ------------------------------------------------------
+
+    def _output_specs(self, graph, stage) -> list:
+        specs = []
+        for cid in stage.outputs:
+            ch = graph.channels[cid]
+            spec = {"channel": ch.id, "kind": ch.kind, "key": ch.key,
+                    "n_peers": len(self.workers),
+                    "peers": [w.endpoint for w in self.workers]}
+            if ch.plane == "ici":
+                spec["plane"] = "ici"
+            specs.append(spec)
+        return specs
+
+    @staticmethod
+    def _stage_ici_channels(graph, stage) -> list:
+        return [graph.channels[cid] for cid in stage.outputs
+                if graph.channels[cid].plane == "ici"]
+
+    def _flip_to_host(self, graph, stage, reason: str) -> None:
+        """Re-lower this stage's ICI edges onto the host plane (the
+        always-available data plane) — counted so operators see every
+        edge that did NOT go device-resident as planned."""
+        for ch in self._stage_ici_channels(graph, stage):
+            ch.plane = "host"
+            self.counters.inc("dq/ici_fallbacks")
+        self._ici_stage_stats.pop(stage.id, None)
+
+    def _run_ici_exchanges(self, graph, stage, ici_chs, results) -> None:
+        """Execute the stage's device-resident edges: ONE collective per
+        channel over every producer's stage output (`dq/ici.py`), the
+        per-consumer partitions landing straight in each worker's
+        exchange buffer — no npz, no gRPC. Bytes count on `dq/ici_bytes`
+        (`dq/channel_bytes` stays untouched for these edges)."""
+        from ydb_tpu.dq import ici
+        by_idx = {i: resp for (i, resp, _e) in results}
+        dfs = []
+        for i in range(len(self.workers)):
+            resp = by_idx.get(i)
+            if resp is None or "ici_df" not in resp:
+                raise ici.IciPlaneError(
+                    f"stage {stage.id}: task w{i} shipped no device "
+                    "frame")
+            dfs.append(resp["ici_df"])
+        hint: dict = {}
+        for resp in by_idx.values():
+            hint.update(resp.get("dtypes") or {})
+        agg = self._ici_stage_stats.setdefault(
+            stage.id, {"ici_bytes": 0, "ici_frames": 0,
+                       "quant_bytes_saved": 0})
+        for ch in ici_chs:
+            kkind = None
+            for resp in by_idx.values():
+                kkind = (resp.get("ici_key_kinds") or {}).get(ch.id) \
+                    or kkind
+            with self._span("ici-exchange", channel=ch.id, kind=ch.kind):
+                out_dfs, stats = ici.exchange(
+                    ch, dfs, key_kind=kkind, dtypes_hint=hint,
+                    counters=self.counters)
+            share = max(1, stats["ici_bytes"] // len(self.workers))
+            for i, w in enumerate(self.workers):
+                w.ici_land(ch.id, out_dfs[i], share,
+                           src=f"ici.{ch.id}", seq=i)
+            self.counters.inc("dq/ici_bytes", stats["ici_bytes"])
+            self.counters.inc("dq/ici_frames", stats["ici_frames"])
+            if stats["quant_bytes_saved"] > 0:
+                self.counters.inc("dq/quant_bytes_saved",
+                                  stats["quant_bytes_saved"])
+            for k in ("ici_bytes", "ici_frames", "quant_bytes_saved"):
+                agg[k] += max(0, stats[k])
 
     def _run_stage_attempts(self, graph, stage, specs):
         """The pending → running → finished/failed attempt loop. Every
@@ -350,6 +443,7 @@ class DqTaskRunner:
                "graph": graph.tag, "stage": stage.id, "worker": worker,
                "state": state, "attempts": int(attempts),
                "rows": 0, "bytes": 0, "frames": 0,
+               "plane": "host", "ici_bytes": 0,
                "exec_ms": 0.0, "flush_ms": 0.0,
                "input_wait_ms": 0.0, "backpressure_wait_ms": 0.0}
         row.update(stats)
@@ -359,12 +453,17 @@ class DqTaskRunner:
         """One `.sys/dq_stage_stats` row per finished task."""
         prof = resp.get("profile") or {}
         chans = prof.get("channels") or []
+        ici = self._ici_stage_stats.get(stage.id)
         self.stage_stats.append(self._stage_row(
             graph, stage, task["worker"], task["state"],
             task["attempts"],
             rows=int(resp.get("rows_in", 0)),
             bytes=int(resp.get("bytes_shipped", 0)),
             frames=int(resp.get("frames_shipped", 0)),
+            plane="ici" if ici else
+                  ("host" if stage.outputs else "-"),
+            ici_bytes=int(ici["ici_bytes"] // len(self.workers))
+            if ici else 0,
             exec_ms=float(prof.get("exec_ms", 0.0)),
             flush_ms=float(prof.get("flush_ms", 0.0)),
             input_wait_ms=float(
@@ -468,6 +567,7 @@ class DqTaskRunner:
             self.stage_stats.append(self._stage_row(
                 graph, stage, "router",
                 "finished" if ok else "failed", 1,
+                plane="-",
                 rows=sum(len(f) for got in
                          (self._collected.get(cid, {})
                           for cid in stage.inputs)
@@ -596,6 +696,13 @@ class LocalWorker:
         except Exception as e:
             rec["state"], rec["error"] = "failed", str(e)
             raise
+
+    def ici_land(self, channel: str, df, nbytes: int,
+                 src: str = "ici", seq=None) -> None:
+        """Land one ICI-exchanged partition straight in the exchange
+        buffer — the device plane's replacement for an ExchangePut frame
+        (same (src, seq) idempotency discipline, no npz, no gRPC)."""
+        self.exchange.put(channel, df, int(nbytes), src=src, seq=seq)
 
     def channel_open(self, channel: str, table: str, columns=None,
                      timeout=None) -> dict:

@@ -95,6 +95,28 @@ def _run_task_body(engine, executor, sql, outputs, src, send, token,
                     "rows": len(df), "bytes": 0,
                     "backpressure_wait_ms": 0.0})
                 continue
+            if out.get("plane") == "ici":
+                # device-resident edge: NO frames leave this task — the
+                # runner (which owns the mesh) collects every producer's
+                # stage output and executes the redistribution as ONE
+                # collective (`dq/ici.py`). Ship the block by reference
+                # (ICI edges only lower between in-process mesh
+                # workers) plus the schema's hash-kind verdict for the
+                # routing key, the same signal the host plane feeds
+                # `hash_partition`.
+                resp["ici_df"] = df
+                kkinds = resp.setdefault("ici_key_kinds", {})
+                key = out.get("key", "")
+                if key and block.schema.has(key):
+                    dt = block.schema.dtype(key)
+                    kkinds[out["channel"]] = (
+                        "string" if dt.is_string
+                        else "float" if dt.is_float else "int")
+                channel_stats.append({
+                    "channel": out["channel"], "frames": 0,
+                    "rows": len(df), "bytes": 0, "plane": "ici",
+                    "backpressure_wait_ms": 0.0})
+                continue
             n_peers = int(out["n_peers"])
             if kind == "hash_shuffle":
                 key = out["key"]

@@ -41,9 +41,13 @@ from ydb_tpu.ops import ir
 from ydb_tpu.ops.device import bucket_capacity
 from ydb_tpu.ops.xla_exec import _trace_program, compress
 from ydb_tpu.parallel._compat import shard_map
-from ydb_tpu.utils.hashing import hash_combine, splitmix64
+from ydb_tpu.parallel.collective import (AXIS, bucket_of, bucket_segments,
+                                         compact_segments,
+                                         exchange_segments)
 
-AXIS = "shards"
+# back-compat alias: callers historically imported the bucketizer from
+# here; the one implementation lives in parallel/collective.py now
+_bucket_of = bucket_of
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:
@@ -84,24 +88,6 @@ def _fuse_device_blocks(blocks, caps, pcap, names):
             d, v = d[:pcap], v[:pcap]
         out_d[n], out_v[n] = d, v
     return out_d, out_v, cnt
-
-
-def _bucket_of(env, key_names, ndev):
-    """Hash-partition bucket id per row (device-side, same hash family as
-    host shard routing — `ydb_tpu/utils/hashing.py`)."""
-    h = None
-    for k in key_names:
-        d, v = env[k]
-        # value-truncating int64 coercion for all key dtypes (float keys
-        # hash by truncated value — bitcast encodings are unavailable under
-        # TPU x64 emulation)
-        x = splitmix64(jnp, d.astype(jnp.int64))
-        if v is not None:
-            x = jnp.where(v, x, jnp.uint64(0))
-        h = x if h is None else hash_combine(jnp, h, x)
-    if h is None:
-        return None
-    return (h % jnp.uint64(ndev)).astype(jnp.int32)
 
 
 @dataclass
@@ -175,42 +161,17 @@ class DistributedAgg:
                     (c.name, c.dtype.kind.value, c.dtype.nullable)
                     for c in fschema.columns)
 
-            # hash shuffle: build ndev segments of seg rows each
-            bucket = _bucket_of(env, key_names, ndev)
-            iota = jnp.arange(pcap, dtype=jnp.int32)
-            active = iota < glen
-            seg_datas = {n: [] for n in names}
-            seg_valids = {n: [] for n in names}
-            counts = []
-            overflow = jnp.bool_(False)
-            for d_t in range(ndev):
-                mask = active & (bucket == d_t)
-                env_c, cnt = compress(env, glen, mask, pcap)
-                overflow = overflow | (cnt > seg)
-                counts.append(jnp.minimum(cnt, seg))
-                for n in names:
-                    seg_datas[n].append(env_c[n][0][:seg])
-                    v = env_c[n][1]
-                    seg_valids[n].append(
-                        v[:seg] if v is not None
-                        else jnp.ones((seg,), jnp.bool_))
-            stacked_d = {n: jnp.stack(seg_datas[n]) for n in names}      # (D, S)
-            stacked_v = {n: jnp.stack(seg_valids[n]) for n in names}
-            cnts = jnp.stack(counts)                                     # (D,)
-
-            recv_d = {n: jax.lax.all_to_all(stacked_d[n], AXIS, 0, 0,
-                                            tiled=False) for n in names}
-            recv_v = {n: jax.lax.all_to_all(stacked_v[n], AXIS, 0, 0,
-                                            tiled=False) for n in names}
-            recv_c = jax.lax.all_to_all(cnts[:, None], AXIS, 0, 0,
-                                        tiled=False)[:, 0]               # (D,)
-
+            # hash shuffle: build ndev segments of seg rows each, swap
+            # them over ICI, compact (shared with shuffle_join + the DQ
+            # ICI channel plane — parallel/collective.py)
+            bucket = bucket_of(env, key_names, ndev)
+            stacked_d, stacked_v, cnts, overflow = bucket_segments(
+                env, bucket, glen, pcap, seg, ndev, names)
+            recv_d, recv_v, recv_c = exchange_segments(
+                stacked_d, stacked_v, cnts, names)
             flat = ndev * seg
-            jrow = jnp.arange(seg, dtype=jnp.int32)
-            seg_mask = (jrow[None, :] < recv_c[:, None]).reshape(-1)
-            env2 = {n: (recv_d[n].reshape(-1), recv_v[n].reshape(-1))
-                    for n in names}
-            env2, tot = compress(env2, jnp.int32(flat), seg_mask, flat)
+            env2, tot = compact_segments(recv_d, recv_v, recv_c, seg,
+                                         ndev, names)
             fenv, flen, fsel, fschema = _trace_program(
                 final_prog, list(schema.columns), flat, env2, tot, params)
             if fsel is not None:

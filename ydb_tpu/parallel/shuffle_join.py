@@ -31,7 +31,10 @@ from ydb_tpu.ops.device import DeviceBlock, bucket_capacity
 from ydb_tpu.ops.join import _select_and_gather, build as build_table
 from ydb_tpu.ops.xla_exec import _trace_program, compress
 from ydb_tpu.parallel._compat import shard_map
-from ydb_tpu.parallel.shuffle import AXIS, _bucket_of, _fuse_device_blocks
+from ydb_tpu.parallel.collective import (AXIS, bucket_of, bucket_segments,
+                                         compact_segments,
+                                         exchange_segments)
+from ydb_tpu.parallel.shuffle import _fuse_device_blocks
 from ydb_tpu.utils.hashing import splitmix64
 
 
@@ -109,36 +112,17 @@ class ShuffleJoin:
                        params):
             env = {n: (arrays[n][0], valids[n][0]) for n in names}
             glen = length[0]
-            # --- route probe rows to their key's owner (ICI all_to_all)
-            bucket = _bucket_of(env, [probe_key], ndev)
-            iota = jnp.arange(pcap, dtype=jnp.int32)
-            active = iota < glen
-            seg_d = {n: [] for n in names}
-            seg_v = {n: [] for n in names}
-            counts = []
-            for d_t in range(ndev):
-                mask = active & (bucket == d_t)
-                env_c, cnt = compress(env, glen, mask, pcap)
-                counts.append(cnt)            # seg = pcap: cannot overflow
-                for n in names:
-                    seg_d[n].append(env_c[n][0])
-                    v = env_c[n][1]
-                    seg_v[n].append(v if v is not None
-                                    else jnp.ones((pcap,), jnp.bool_))
-            stacked_d = {n: jnp.stack(seg_d[n]) for n in names}
-            stacked_v = {n: jnp.stack(seg_v[n]) for n in names}
-            cnts = jnp.stack(counts)
-            recv_d = {n: jax.lax.all_to_all(stacked_d[n], AXIS, 0, 0)
-                      for n in names}
-            recv_v = {n: jax.lax.all_to_all(stacked_v[n], AXIS, 0, 0)
-                      for n in names}
-            recv_c = jax.lax.all_to_all(cnts[:, None], AXIS, 0, 0)[:, 0]
+            # --- route probe rows to their key's owner (ICI all_to_all;
+            # shared segment machinery — parallel/collective.py).
+            # seg = pcap: full-capacity segments cannot overflow
+            bucket = bucket_of(env, [probe_key], ndev)
+            stacked_d, stacked_v, cnts, _ovf = bucket_segments(
+                env, bucket, glen, pcap, pcap, ndev, names)
+            recv_d, recv_v, recv_c = exchange_segments(
+                stacked_d, stacked_v, cnts, names)
             flat = ndev * pcap
-            jrow = jnp.arange(pcap, dtype=jnp.int32)
-            seg_mask = (jrow[None, :] < recv_c[:, None]).reshape(-1)
-            env2 = {n: (recv_d[n].reshape(-1), recv_v[n].reshape(-1))
-                    for n in names}
-            env2, tot = compress(env2, jnp.int32(flat), seg_mask, flat)
+            env2, tot = compact_segments(recv_d, recv_v, recv_c, pcap,
+                                         ndev, names)
 
             # --- probe the LOCAL build partition (vectorized binsearch)
             d, v = env2[probe_key]

@@ -950,7 +950,9 @@ class QueryEngine:
                   "hive/worker_dead", "hive/workers_alive",
                   "hive/lease_expired", "hive/shards_replaced",
                   "hive/adopt_failed", "hive/failover_holds",
-                  "hive/placement_epoch", "dq/retry_rerouted"):
+                  "hive/placement_epoch", "dq/retry_rerouted",
+                  "dq/ici_bytes", "dq/ici_frames", "dq/ici_fallbacks",
+                  "dq/quant_bytes_saved", "dq/quant_refused"):
             c.setdefault(k, 0)
         c.setdefault("trace/sample_rate", self.trace_sample)
         c.setdefault("trace/profiles_held", len(self.profiles))

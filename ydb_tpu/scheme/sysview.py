@@ -93,6 +93,8 @@ def sysview_block(engine, name: str) -> HostBlock:
             "rows": int(r.get("rows", 0)),
             "bytes": int(r.get("bytes", 0)),
             "frames": int(r.get("frames", 0)),
+            "plane": str(r.get("plane", "host")),
+            "ici_bytes": int(r.get("ici_bytes", 0)),
             "exec_ms": float(r.get("exec_ms", 0.0)),
             "flush_ms": float(r.get("flush_ms", 0.0)),
             "input_wait_ms": float(r.get("input_wait_ms", 0.0)),
@@ -103,7 +105,9 @@ def sysview_block(engine, name: str) -> HostBlock:
                              ("stage", str), ("worker", str),
                              ("state", str), ("attempts", "int64"),
                              ("rows", "int64"), ("bytes", "int64"),
-                             ("frames", "int64"), ("exec_ms", "float64"),
+                             ("frames", "int64"), ("plane", str),
+                             ("ici_bytes", "int64"),
+                             ("exec_ms", "float64"),
                              ("flush_ms", "float64"),
                              ("input_wait_ms", "float64"),
                              ("backpressure_wait_ms", "float64")])

@@ -235,6 +235,21 @@ class QueryServicer:
             while len(tasks) > 512:          # bounded task table
                 tasks.popitem(last=False)
         try:
+            if any(o.get("plane") == "ici"
+                   for o in request.get("outputs") or []):
+                # an ICI-plane edge only lowers between in-process mesh
+                # workers; a gRPC worker has no shared mesh to ride and
+                # no way to ship a by-reference frame — refuse loudly so
+                # the runner's host-plane fallback takes over (state
+                # stamped failed like every other error path: the task
+                # table must never show a phantom running task)
+                msg = ("IciPlaneError: ici-plane task sent to a gRPC "
+                       "worker (no shared mesh)")
+                with self._lock:
+                    rec["state"] = "failed"
+                    rec["error"] = msg
+                return {"error": msg}
+
             def send(out, p, frame):
                 ExchangeClient(out["peers"][p]).put(frame)
 

@@ -185,6 +185,25 @@ HIST_FAMILIES = ("query/latency_ms", "query/parse_ms", "query/plan_ms",
 #                                 round that re-lowered onto the
 #                                 surviving Hive placement)
 #
+# DQ channel ICI plane (`ydb_tpu/dq/ici.py` — device-resident edges;
+# `dq/channel_bytes` above stays at 0 for an edge that went ICI):
+#   dq/ici_bytes                  interconnect bytes moved by device
+#                                 collectives (all_to_all segments +
+#                                 valid masks + row counts; all-gather
+#                                 for broadcast edges)
+#   dq/ici_frames                 (src, dst) segments exchanged
+#   dq/ici_fallbacks              ICI edges re-run on the host plane
+#                                 (mid-collective failure, codec
+#                                 refusal, or a worker set with no
+#                                 shared mesh)
+#   dq/quant_bytes_saved          wire bytes saved by EQuARX block
+#                                 quantization of planner-proven
+#                                 aggregation-tolerant columns
+#                                 (YDB_TPU_DQ_QUANT=1)
+#   dq/quant_refused              declared quant columns the runtime
+#                                 refused (non-float at execution time)
+#                                 and shipped exact instead
+#
 # Hive control-plane counters (`ydb_tpu/hive/`, the cluster membership/
 # placement/failover subsystem):
 #   hive/registered               workers registered (first time)

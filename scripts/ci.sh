@@ -16,6 +16,19 @@
 set -u
 cd "$(dirname "$0")/.."
 
+echo "== graftlint invariant gate (AST passes vs baseline) =="
+# fast static leg, runs FIRST (no JAX, <2 s): host-sync escapes in the
+# device-resident modules, cache keys missing YDB_TPU_* levers, guarded
+# state mutated outside its lock, unregistered counters, RPC surface
+# drift — any finding not in ydb_tpu/analysis/baseline.json fails, and
+# so does a baseline recording debt the tree no longer has
+python scripts/lint_gate.py
+lrc=$?
+if [ "$lrc" -ne 0 ]; then
+    echo "graftlint gate FAILED (rc=$lrc)" >&2
+    exit "$lrc"
+fi
+
 echo "== tier-1 tests (ROADMAP.md verify) =="
 set -o pipefail
 rm -f /tmp/_t1.log

@@ -1,0 +1,30 @@
+#!/usr/bin/env python
+"""CI leg: graftlint must be clean — findings ⊆ baseline, baseline not
+stale.
+
+The fast static leg of ci.sh (no JAX import, no device, <2 s): runs the
+five AST passes over the live tree and fails on
+
+  * any NEW finding (not excused by ydb_tpu/analysis/baseline.json,
+    a `# lint: allow-<pass>(reason)` pragma, or a file pragma), and
+  * a STALE baseline (the tree has less debt than the file records —
+    burning debt down must tighten the ratchet in the same change, or
+    the headroom silently re-fills).
+
+Fix a finding, pragma it with a reason a reviewer can judge, or — for
+a deliberate debt increase — regenerate via
+`python -m ydb_tpu.analysis --write-baseline` and justify the diff.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from ydb_tpu.analysis.__main__ import main          # noqa: E402
+
+if __name__ == "__main__":
+    rc = main(["--strict-shrink"])
+    if rc == 0:
+        print("lint gate OK")
+    sys.exit(rc)

@@ -1,0 +1,469 @@
+"""graftlint (ydb_tpu/analysis): per-pass fixture snippets — flagged,
+pragma-suppressed, and baseline-excused — plus baseline-ratchet
+mechanics and the live-tree self-check (the repo must be clean modulo
+its own checked-in baseline).
+"""
+
+import os
+import textwrap
+
+from ydb_tpu.analysis.core import Baseline, Project, run
+from ydb_tpu.analysis.passes.cache_key import CacheKeyPass
+from ydb_tpu.analysis.passes.counters import CounterRegistryPass
+from ydb_tpu.analysis.passes.host_sync import HostSyncPass
+from ydb_tpu.analysis.passes.locks import LockDisciplinePass
+from ydb_tpu.analysis.passes.rpc_surface import RpcSurfacePass
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _proj(**files):
+    return Project.from_sources(
+        {path: textwrap.dedent(src) for path, src in files.items()})
+
+
+def _run(passes, **files):
+    return run(_proj(**files), passes=passes)["findings"]
+
+
+# -- host-sync --------------------------------------------------------------
+
+
+def test_host_sync_flags_escapes_in_device_modules():
+    fs = _run([HostSyncPass()], **{"ydb_tpu/ops/x.py": """\
+        import numpy as np
+        import jax.numpy as jnp
+
+        def f(dev):
+            a = np.asarray(dev)          # flagged
+            b = dev.to_pandas()          # flagged
+            c = dev.item()               # flagged
+            d = float(jnp.sum(dev))      # flagged: cast wraps a jnp call
+            e = jnp.asarray(a)           # NOT flagged: host->device
+            g = float(3)                 # NOT flagged: plain cast
+            return a, b, c, d, e, g
+    """})
+    tokens = sorted(f.key.rsplit("::", 1)[1] for f in fs)
+    assert tokens == [".item()", ".to_pandas()", "float(device)",
+                      "np.asarray"]
+
+
+def test_host_sync_ignores_non_device_modules():
+    assert _run([HostSyncPass()], **{"ydb_tpu/query/x.py": """\
+        import numpy as np
+        def f(d):
+            return np.asarray(d)
+    """}) == []
+
+
+def test_host_sync_line_and_file_pragmas():
+    fs = _run([HostSyncPass()], **{"ydb_tpu/dq/x.py": """\
+        import numpy as np
+        def f(d):
+            a = np.asarray(d)  # lint: allow-host-sync(upload boundary)
+            # lint: allow-host-sync(next line excused)
+            b = np.asarray(d)
+            c = np.asarray(d)
+            return a, b, c
+    """})
+    assert len(fs) == 1 and fs[0].line == 6
+    assert _run([HostSyncPass()], **{"ydb_tpu/dq/y.py": """\
+        # lint: allow-file-host-sync(host lane module)
+        import numpy as np
+        def f(d):
+            return np.asarray(d), d.to_pandas()
+    """}) == []
+
+
+# -- cache-key --------------------------------------------------------------
+
+_TUNING_MOD = """\
+    import os
+
+    def my_tuning():  # lint: tuning-provider
+        return os.environ.get("YDB_TPU_FAKE_KNOB", "0")
+"""
+
+_CACHE_MOD = """\
+    from ydb_tpu.fake.tuning import my_tuning
+
+    _FNS = {}
+
+    def build_it(cap):
+        import jax
+        return jax.jit(lambda x: x * int(my_tuning()))
+
+    def covered(cap):
+        sig = (cap, my_tuning())
+        fn = _FNS.get(sig)
+        if fn is None:
+            fn = _FNS[sig] = build_it(cap)
+        return fn
+
+    def uncovered(cap):
+        sig = (cap,)
+        fn = _FNS.get(sig)
+        if fn is None:
+            fn = _FNS[sig] = build_it(cap)
+        return fn
+"""
+
+
+def test_cache_key_missing_lever_flagged_and_covered_clean():
+    fs = _run([CacheKeyPass()],
+              **{"ydb_tpu/fake/tuning.py": _TUNING_MOD,
+                 "ydb_tpu/fake/cache.py": _CACHE_MOD})
+    assert len(fs) == 1
+    assert "YDB_TPU_FAKE_KNOB" in fs[0].message
+    assert "uncovered" in fs[0].key
+
+
+def test_cache_key_pragma_suppresses():
+    fs = _run([CacheKeyPass()],
+              **{"ydb_tpu/fake/tuning.py": _TUNING_MOD,
+                 "ydb_tpu/fake/cache.py": _CACHE_MOD.replace(
+                     "        fn = _FNS.get(sig)\n"
+                     "        if fn is None:\n"
+                     "            fn = _FNS[sig] = build_it(cap)\n"
+                     "        return fn\n\n"
+                     "    def uncovered",
+                     "        fn = _FNS.get(sig)\n"
+                     "        if fn is None:\n"
+                     "            fn = _FNS[sig] = build_it(cap)\n"
+                     "        return fn\n\n"
+                     "    def uncovered", 1)})
+    # sanity: same module still flags; now suppress the uncovered site
+    assert len(fs) == 1
+    suppressed = _CACHE_MOD.replace(
+        "    def uncovered(cap):\n        sig = (cap,)\n"
+        "        fn = _FNS.get(sig)",
+        "    def uncovered(cap):\n        sig = (cap,)\n"
+        "        # lint: allow-cache-key(knob cannot change mid-process"
+        " here)\n"
+        "        fn = _FNS.get(sig)")
+    assert _run([CacheKeyPass()],
+                **{"ydb_tpu/fake/tuning.py": _TUNING_MOD,
+                   "ydb_tpu/fake/cache.py": suppressed}) == []
+
+
+def test_cache_key_ignores_unjitted_caches():
+    # a cache whose builder never reaches jit/shard_map is not a
+    # compiled-program cache — plain memo dicts stay lint-free
+    assert _run([CacheKeyPass()],
+                **{"ydb_tpu/fake/tuning.py": _TUNING_MOD,
+                   "ydb_tpu/fake/memo.py": """\
+        import os
+        _CACHE = {}
+        def memo(x):
+            key = (x,)
+            v = _CACHE.get(key)
+            if v is None:
+                v = _CACHE[key] = os.environ.get("YDB_TPU_FAKE_KNOB")
+            return v
+    """}) == []
+
+
+def test_cache_key_flags_live_regression_shape():
+    """The exact shape of the PR's live bug: a class whose _build traces
+    a program under a lever, cached by a key without the provider."""
+    fs = _run([CacheKeyPass()],
+              **{"ydb_tpu/fake/tuning.py": _TUNING_MOD,
+                 "ydb_tpu/fake/sj.py": """\
+        from ydb_tpu.fake.tuning import my_tuning
+
+        class FakeJoin:
+            def __init__(self):
+                self._fns = {}
+
+            def _build(self, cap):
+                import jax
+                k = int(my_tuning())
+                return jax.jit(lambda x: x * k)
+
+            def run(self, cap):
+                key = (cap,)
+                fn = self._fns.get(key)
+                if fn is None:
+                    fn = self._fns[key] = self._build(cap)
+                return fn
+    """})
+    assert len(fs) == 1 and "FakeJoin.run" in fs[0].key
+
+
+def test_cache_key_provider_fed_as_builder_argument():
+    """A provider CALLED in the enclosing function (its value feeding
+    the builder as an argument, the quant_names shape in dq/ici.py)
+    counts as a lever the key must cover."""
+    fs = _run([CacheKeyPass()],
+              **{"ydb_tpu/fake/tuning.py": _TUNING_MOD,
+                 "ydb_tpu/fake/arg.py": """\
+        from ydb_tpu.fake.tuning import my_tuning
+
+        _FNS = {}
+
+        def build_with(knob):
+            import jax
+            return jax.jit(lambda x: x * int(knob))
+
+        def site(cap):
+            knob = my_tuning()
+            sig = (cap,)
+            fn = _FNS.get(sig)
+            if fn is None:
+                fn = _FNS[sig] = build_with(knob)
+            return fn
+    """})
+    assert len(fs) == 1 and "YDB_TPU_FAKE_KNOB" in fs[0].message
+
+
+# -- locks ------------------------------------------------------------------
+
+_LOCKED_MOD = """\
+    import threading
+
+    class Table:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._rows = {}        # guarded-by: _mu
+
+        def good(self, k, v):
+            with self._mu:
+                self._rows[k] = v
+
+        def bad_setitem(self, k, v):
+            self._rows[k] = v
+
+        def bad_mutator(self, k):
+            self._rows.pop(k, None)
+
+        def bad_assign(self):
+            self._rows = {}
+
+        def _drain_locked(self):
+            self._rows.clear()
+
+        def caller(self):
+            with self._mu:
+                self._drain_locked()
+
+        def bad_caller(self):
+            self._drain_locked()
+"""
+
+
+def test_locks_flags_unguarded_mutations():
+    fs = _run([LockDisciplinePass()], **{"ydb_tpu/hive/x.py": _LOCKED_MOD})
+    got = sorted(f.key.split("::", 1)[1] for f in fs)
+    assert got == ["Table.bad_assign::_rows::assign",
+                   "Table.bad_caller::_drain_locked::call",
+                   "Table.bad_mutator::_rows::pop",
+                   "Table.bad_setitem::_rows::setitem"]
+
+
+def test_locks_pragma_and_init_exempt():
+    fs = _run([LockDisciplinePass()], **{"ydb_tpu/hive/y.py": """\
+        import threading
+
+        class T:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._d = {}      # guarded-by: _mu
+                self._d["boot"] = 1          # __init__ is exempt
+
+            def shed(self):
+                # lint: allow-locks(single-threaded shutdown path)
+                self._d.clear()
+    """})
+    assert fs == []
+
+
+def test_locks_unannotated_attrs_unchecked():
+    assert _run([LockDisciplinePass()], **{"ydb_tpu/hive/z.py": """\
+        class T:
+            def __init__(self):
+                self.free = {}
+
+            def touch(self):
+                self.free["x"] = 1
+    """}) == []
+
+
+# -- counters ---------------------------------------------------------------
+
+_METRICS_MOD = """\
+    COUNTER_REGISTRY = {
+        "good/hits": "a fine counter",
+        "good/fam/*": "a family",
+        "ghost/entry": "registered but never emitted",
+        "dyn/gauge": "(dynamic) emitted through a variable",
+    }
+"""
+
+
+def test_counters_registry_membership_and_wildcards():
+    fs = _run([CounterRegistryPass()],
+              **{"ydb_tpu/utils/metrics.py": _METRICS_MOD,
+                 "ydb_tpu/query/c.py": """\
+        from ydb_tpu.utils.metrics import GLOBAL
+
+        def f(kind, name):
+            GLOBAL.inc("good/hits")              # registered
+            GLOBAL.inc("good/typo_hits")         # flagged: unknown
+            GLOBAL.inc(f"good/fam/{kind}")       # wildcard family: ok
+            GLOBAL.inc(f"bad/fam/{kind}")        # flagged: no family
+            GLOBAL.inc(f"good/{kind}")           # flagged: head merely a
+            #                                      PREFIX of good/fam/*
+            # lint: allow-counters(lands in dyn/gauge)
+            GLOBAL.set(name, 1)
+            GLOBAL.inc(name)                     # flagged: dynamic
+    """})
+    kinds = sorted(f.key.rsplit("::", 1)[1] for f in fs)
+    assert kinds == sorted(["<dynamic>", "ghost/entry", 'f"bad/fam/…"',
+                            'f"good/…"', "good/typo_hits"])
+
+
+def test_counters_registry_missing_is_one_finding():
+    fs = _run([CounterRegistryPass()], **{"ydb_tpu/query/c.py": """\
+        from ydb_tpu.utils.metrics import GLOBAL
+        def f():
+            GLOBAL.inc("x/y")
+    """})
+    assert len(fs) == 1 and "registry-missing" in fs[0].key
+
+
+# -- rpc-surface ------------------------------------------------------------
+
+_SERVICE_TMPL = """\
+    class QueryServicer:
+        def execute_query(self, request, context):
+            pass
+
+        def frob(self, request, context):
+            pass
+
+        def _helper(self, request, context):
+            pass
+
+        def not_rpc(self):
+            pass
+
+
+    class ExchangeClient:
+        def put(self, frame):
+            pass
+
+
+    class Client:
+        def execute(self, sql):
+            pass
+    {client_extra}
+"""
+
+_RUNNER_TMPL = """\
+    class LocalWorker:
+        def execute(self, sql):
+            pass
+    {worker_extra}
+"""
+
+
+def test_rpc_surface_drift_flagged_both_sides():
+    fs = _run([RpcSurfacePass()], **{
+        "ydb_tpu/server/service.py":
+            _SERVICE_TMPL.format(client_extra=""),
+        "ydb_tpu/dq/runner.py": _RUNNER_TMPL.format(worker_extra=""),
+    })
+    keys = sorted(f.key for f in fs)
+    # `frob` is missing on Client AND LocalWorker; execute_query maps to
+    # `execute`, present on both
+    assert keys == [
+        "ydb_tpu/server/service.py::QueryServicer.frob::client",
+        "ydb_tpu/server/service.py::QueryServicer.frob::worker",
+    ]
+
+
+def test_rpc_surface_clean_when_mirrored():
+    fs = _run([RpcSurfacePass()], **{
+        "ydb_tpu/server/service.py": _SERVICE_TMPL.format(
+            client_extra="\n        def frob(self):\n            pass\n"),
+        "ydb_tpu/dq/runner.py": _RUNNER_TMPL.format(
+            worker_extra="\n        def frob(self):\n            pass\n"),
+    })
+    assert fs == []
+
+
+# -- baseline ratchet -------------------------------------------------------
+
+
+def _one_finding_project(n_calls=1):
+    body = "".join(f"    a{i} = np.asarray(d)\n" for i in range(n_calls))
+    return _proj(**{"ydb_tpu/ops/b.py":
+                    "import numpy as np\ndef f(d):\n" + body
+                    + "    return None\n"})
+
+
+def test_baseline_excuses_existing_debt_flags_growth():
+    passes = [HostSyncPass()]
+    base = Baseline.from_findings(
+        run(_one_finding_project(1), passes=passes)["findings"])
+    rep = run(_one_finding_project(1), passes=passes, baseline=base)
+    assert rep["new"] == [] and rep["excused"] == 1
+    grown = run(_one_finding_project(3), passes=passes, baseline=base)
+    assert len(grown["new"]) == 2          # same key, count ratchet
+    assert grown["excused"] == 1
+
+
+def test_baseline_reports_shrinkage_for_tightening():
+    passes = [HostSyncPass()]
+    base = Baseline.from_findings(
+        run(_one_finding_project(2), passes=passes)["findings"])
+    rep = run(_one_finding_project(0), passes=passes, baseline=base)
+    assert rep["new"] == []
+    (pass_id, keys), = rep["shrunk"].items()
+    assert pass_id == "host-sync"
+    ((_key, (allowed, have)),) = keys.items()
+    assert (allowed, have) == (2, 0)
+
+
+def test_baseline_roundtrips_through_disk(tmp_path):
+    passes = [HostSyncPass()]
+    base = Baseline.from_findings(
+        run(_one_finding_project(2), passes=passes)["findings"])
+    p = tmp_path / "b.json"
+    base.save(str(p))
+    loaded = Baseline.load(str(p))
+    assert loaded.entries == base.entries
+    assert Baseline.load(str(tmp_path / "missing.json")).entries == {}
+
+
+# -- the live tree ----------------------------------------------------------
+
+
+def test_live_tree_clean_modulo_baseline():
+    """The repo itself passes graftlint: findings ⊆ baseline.json. A new
+    host-sync escape, an unkeyed lever, an unguarded mutation, an
+    unregistered counter, or an RPC drift fails THIS test before CI."""
+    project = Project.from_dir(REPO)
+    baseline = Baseline.load(
+        os.path.join(REPO, "ydb_tpu", "analysis", "baseline.json"))
+    rep = run(project, baseline=baseline)
+    assert rep["new"] == [], \
+        "new graftlint findings:\n" + "\n".join(
+            f.render() for f in rep["new"])
+
+
+def test_live_tree_baseline_not_stale():
+    """Ratchet hygiene: baseline.json records no MORE debt than the
+    tree actually has — burn-downs must tighten the file in the same
+    change (scripts/lint_gate.py --strict-shrink enforces this in CI)."""
+    project = Project.from_dir(REPO)
+    baseline = Baseline.load(
+        os.path.join(REPO, "ydb_tpu", "analysis", "baseline.json"))
+    rep = run(project, baseline=baseline)
+    assert rep["shrunk"] == {}, f"tighten baseline.json: {rep['shrunk']}"
+
+
+def test_live_tree_has_expected_passes():
+    from ydb_tpu.analysis import load_passes
+    assert sorted(p.id for p in load_passes()) == [
+        "cache-key", "counters", "host-sync", "locks", "rpc-surface"]

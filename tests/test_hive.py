@@ -504,3 +504,30 @@ def test_kill9_mid_query_completes_after_replacement(tmp_path):
     assert d["counter_deltas"]["dq/retry_rerouted"] >= 1
     assert d["states"] == {"alive": 2, "dead": 1}
     assert d["replacement_latency_ms"] is not None
+
+
+def test_membership_sync_shards_owns_nodeinfo_mutation():
+    """graftlint locks fix: NodeInfo.shards/had_shards are membership
+    state, so the placement mirror mutates them through
+    `HiveMembership.sync_shards` under the membership lock (the Hive
+    used to rewrite them under only its placement lock) — and the sync
+    is visible, sorted, and sticky (`had_shards` survives losing every
+    shard, the rejoin-staleness input)."""
+    m = HiveMembership(lease_s=5.0)
+    m.register("w1:1", node_id="n1")
+    m.register("w2:1", node_id="n2")
+
+    m.sync_shards({"n1": ["s2", "s1"]})
+    assert m.get("n1").shards == ["s1", "s2"]
+    assert m.get("n1").had_shards is True
+    assert m.get("n2").shards == [] and m.get("n2").had_shards is False
+
+    # re-placement moves everything off n1: shards empty, the
+    # had-shards mark stays (a dead rejoiner is stale only if it HAD
+    # shards that were re-placed)
+    m.sync_shards({"n2": ["s1", "s2"]})
+    assert m.get("n1").shards == [] and m.get("n1").had_shards is True
+    assert m.get("n2").shards == ["s1", "s2"]
+    # concurrent readers see the table through the same lock
+    rows = {r["node_id"]: r for r in m.rows()}
+    assert rows["n2"]["shards"] == "s1,s2"

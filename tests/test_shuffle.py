@@ -137,3 +137,37 @@ def test_distributed_topk_hidden_sort_key():
     o = oracle[oracle.v > 100].sort_values(
         ["v", "k"], ascending=[False, True]).k.iloc[2:6]
     assert list(df.k) == list(o)
+
+
+def test_tuning_flip_recompiles_not_reuses(mesh, rng, monkeypatch):
+    """Cache-key completeness (graftlint cache-key pass): the group-by
+    tuning tuple is part of DistributedAgg's inner compiled-fn identity.
+    One instance crossing a YDB_TPU_GROUPBY_TILE_ROWS flip must compile
+    a SECOND program (and still agree with the first) — before the fix
+    the flipped run silently reused the program traced under the old
+    tile budget."""
+    partial = ir.Program().group_by(
+        ["k"], [ir.Agg("s", "sum", "v"), ir.Agg("n", "count_all")])
+    final = ir.Program().group_by(
+        ["k"], [ir.Agg("s", "sum", "s"), ir.Agg("n", "sum", "n")])
+    dag = DistributedAgg(partial, final, _schema(), mesh)
+    blocks, k, v, m = _blocks(rng, 8, 300, 29)
+
+    monkeypatch.delenv("YDB_TPU_GROUPBY_TILE_ROWS", raising=False)
+    out1 = dag.run(blocks).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    n_default = len(dag._fns)
+    out_cached = dag.run(blocks).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    assert len(dag._fns) == n_default          # same tuning: cache hit
+
+    monkeypatch.setenv("YDB_TPU_GROUPBY_TILE_ROWS", "64")
+    out2 = dag.run(blocks).to_pandas().sort_values("k") \
+        .reset_index(drop=True)
+    assert len(dag._fns) == n_default + 1, \
+        "tuning flip must compile a fresh program, not serve the stale one"
+
+    for out in (out_cached, out2):
+        assert list(out.k) == list(out1.k)
+        np.testing.assert_allclose(out.s, out1.s, rtol=1e-9)
+        np.testing.assert_array_equal(out.n, out1.n)

@@ -239,3 +239,28 @@ def test_shuffle_join_string_key_unreferenced_dim_values(eng):
     j = f.merge(d, left_on="tag", right_on="tag2")
     assert int(got.n[0]) == len(j)
     np.testing.assert_allclose(got.s[0], (j.v + j.w).sum(), rtol=1e-9)
+
+
+def test_shuffle_join_tuning_flip_rebuilds(eng, monkeypatch):
+    """Cache-key completeness (graftlint cache-key pass): the executor's
+    ShuffleJoin cache keys on the group-by tuning tuple. The SAME SQL
+    across a YDB_TPU_GROUPBY_TILE_ROWS flip must build a second
+    ShuffleJoin (its traced partial/rest programs are tiled under the
+    live knobs) and return the same answer — before the fix the flip
+    reused the instance traced under the old settings."""
+    sql = ("select g, sum(v * w) as s from fact join dim on k = k2 "
+           "group by g order by g")
+    monkeypatch.delenv("YDB_TPU_GROUPBY_TILE_ROWS", raising=False)
+    out1 = eng.query(sql)
+    n0 = len(eng.executor._shuffle_joins)
+    assert n0 >= 1
+    out_cached = eng.query(sql)
+    assert len(eng.executor._shuffle_joins) == n0     # same tuning: hit
+
+    monkeypatch.setenv("YDB_TPU_GROUPBY_TILE_ROWS", "256")
+    out2 = eng.query(sql)
+    assert len(eng.executor._shuffle_joins) == n0 + 1, \
+        "tuning flip must build a fresh ShuffleJoin, not serve the stale one"
+    for out in (out_cached, out2):
+        assert list(out.g) == list(out1.g)
+        np.testing.assert_allclose(out.s, out1.s, rtol=1e-9)

@@ -1,0 +1,31 @@
+"""graftlint — project-specific AST invariant checks with a baseline
+ratchet.
+
+The system's correctness rests on conventions no general-purpose linter
+knows about: program-shaping `YDB_TPU_*` levers must ride in every
+compiled-program cache key (a missed lever is a silent stale-cache
+wrong answer), shared state must be mutated under its owning lock,
+counters must exist in the registry the dashboards read, host-sync
+escapes must not creep back into the device-resident modules, and the
+three RPC surfaces (servicer / Client / LocalWorker) must not drift
+apart. Each convention is one `Pass` here; `python -m ydb_tpu.analysis`
+runs them all and compares against the checked-in baseline
+(`ydb_tpu/analysis/baseline.json`): existing debt is excused, any NEW
+finding fails — the compile-time-over-runtime stance of arxiv
+2112.01075 applied to our own invariants.
+
+Suppression grammar (a reason is mandatory):
+
+    x = np.asarray(d)   # lint: allow-host-sync(client result boundary)
+    # lint: allow-file-host-sync(host execution lane, never on device)
+
+The first form excuses one line (same line or the line directly
+above); the `allow-file-` form anywhere in a module excuses the whole
+file for that pass. `# lint: tuning-provider` on a `def` line marks a
+function as a cache-key tuning provider (see passes/cache_key.py).
+"""
+
+from ydb_tpu.analysis.core import (Baseline, Finding, Project, load_passes,
+                                   run)
+
+__all__ = ["Baseline", "Finding", "Project", "load_passes", "run"]

@@ -1,0 +1,97 @@
+"""CLI: `python -m ydb_tpu.analysis [--write-baseline] [--json] [...]`.
+
+Exit codes: 0 = clean (findings ⊆ baseline), 1 = new findings, 2 =
+setup error. `--strict-shrink` also fails when the tree has LESS debt
+than the baseline records — CI uses it so the ratchet file is tightened
+in the same PR that burns debt down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ydb_tpu.analysis.core import Baseline, Project, load_passes, run
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ydb_tpu.analysis",
+        description="graftlint: AST invariant checks with a baseline "
+                    "ratchet")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: parent of the package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline to the current findings")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--strict-shrink", action="store_true",
+                    help="fail when current debt < baseline (tighten "
+                         "the ratchet file in the same change)")
+    ap.add_argument("--pass", dest="only", default=None,
+                    help="run a single pass by id")
+    args = ap.parse_args(argv)
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    if not os.path.isdir(os.path.join(root, "ydb_tpu")):
+        print(f"error: {root} has no ydb_tpu/ package", file=sys.stderr)
+        return 2
+
+    project = Project.from_dir(root)
+    passes = load_passes()
+    if args.only:
+        passes = [p for p in passes if p.id == args.only]
+        if not passes:
+            print(f"error: no pass named {args.only!r}", file=sys.stderr)
+            return 2
+
+    if args.write_baseline:
+        if args.only:
+            # a single-pass rewrite would silently drop every OTHER
+            # pass's recorded debt from the file — refuse
+            print("error: --write-baseline regenerates ALL passes; "
+                  "drop --pass", file=sys.stderr)
+            return 2
+        findings = []
+        for p in passes:
+            findings.extend(p.run(project))
+        Baseline.from_findings(findings).save(args.baseline)
+        print(f"baseline: {len(findings)} findings -> {args.baseline}")
+        return 0
+
+    baseline = Baseline.load(args.baseline)
+    report = run(project, passes, baseline)
+    new, shrunk = report["new"], report["shrunk"]
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": len(report["findings"]),
+            "excused": report["excused"],
+            "new": [f.__dict__ for f in new],
+            "shrunk": {p: {k: list(v) for k, v in ks.items()}
+                       for p, ks in shrunk.items()},
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for pass_id, keys in sorted(shrunk.items()):
+            for key, (allowed, have) in sorted(keys.items()):
+                print(f"ratchet: [{pass_id}] {key}: baseline {allowed} "
+                      f"-> now {have} (tighten baseline.json)")
+        print(f"graftlint: {len(report['findings'])} findings "
+              f"({report['excused']} baselined, {len(new)} new)")
+
+    if new:
+        return 1
+    if args.strict_shrink and shrunk:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

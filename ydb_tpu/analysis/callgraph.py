@@ -1,0 +1,171 @@
+"""Name-based call graph + env-lever index over a Project.
+
+Precision notes (this is a linter, not a compiler): calls resolve by
+bare name across the whole package — `F.fused_cache_key(...)` resolves
+to any def named `fused_cache_key`. That over-approximates, which is
+the right failure mode for reachability of ENV LEVERS (a false
+"reachable" produces a finding someone reviews and pragmas; a false
+"unreachable" would hide a stale-cache bug). Generic method names that
+would wire everything to everything (`get`, `run`, `put`, ...) are
+stop-listed; instantiating a class pulls in `__init__`/`__post_init__`
+plus its `_build*` methods — the compile-builder convention used by
+`DistributedAgg`/`ShuffleJoin` — without dragging in every method."""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+LEVER_PREFIX = "YDB_TPU_"
+
+# names too generic to follow across modules
+_STOP = frozenset({
+    "get", "set", "put", "run", "add", "pop", "inc", "len", "str", "int",
+    "float", "bool", "list", "dict", "tuple", "sorted", "close", "open",
+    "items", "keys", "values", "append", "update", "join", "split",
+    "query", "execute", "render", "snapshot", "observe", "max", "min",
+    "range", "zip", "next", "iter", "repr", "type", "print", "format",
+})
+
+
+@dataclass
+class FuncInfo:
+    name: str                       # bare name
+    qual: str                       # Module-relative qualname
+    path: str                       # module path
+    node: ast.AST = None
+    levers: set = field(default_factory=set)    # direct YDB_TPU_* reads
+    calls: set = field(default_factory=set)     # bare names called
+    jits: bool = False              # contains a jit/shard_map call
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) \
+        and isinstance(node.value, str) else None
+
+
+def lever_reads(node: ast.AST) -> set:
+    """YDB_TPU_* names read under `node`: os.environ.get /
+    os.environ[...] / os.getenv, plus any lever-name literal passed as
+    a call argument (the `_int("YDB_TPU_X", default)` helper idiom).
+    Docstrings and bare string statements are NOT reads."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            for a in list(n.args) + [kw.value for kw in n.keywords]:
+                name = _const_str(a)
+                if name and name.startswith(LEVER_PREFIX):
+                    out.add(name)
+        elif isinstance(n, ast.Subscript):
+            name = _const_str(n.slice)
+            if name and name.startswith(LEVER_PREFIX):
+                out.add(name)
+        elif isinstance(n, ast.Compare):
+            for side in [n.left] + list(n.comparators):
+                name = _const_str(side)
+                if name and name.startswith(LEVER_PREFIX):
+                    out.add(name)
+    return out
+
+
+def call_names(node: ast.AST) -> set:
+    """Bare names of everything called under `node` (Name calls and
+    Attribute-call basenames)."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Name):
+                out.add(f.id)
+            elif isinstance(f, ast.Attribute):
+                out.add(f.attr)
+    return out
+
+
+class CallGraph:
+    def __init__(self, project):
+        self.funcs: dict[str, list[FuncInfo]] = {}     # bare name -> defs
+        self.by_qual: dict[str, FuncInfo] = {}
+        # class name -> its OWN method FuncInfos (not globally resolved)
+        self.class_methods: dict[str, list[FuncInfo]] = {}
+
+        for mod in project.modules.values():
+            self._index(mod)
+
+    def _index(self, mod) -> None:
+        def visit(node, prefix, cls_name=None):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{mod.path}::{prefix}{child.name}"
+                    # calls to our OWN nested helpers resolve here, not
+                    # globally (their bodies are already in this walk);
+                    # keeping the bare names would alias every nested
+                    # `wrapper`/`per_device` in the package together
+                    nested = {d.name for d in ast.walk(child)
+                              if isinstance(d, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))
+                              and d is not child}
+                    fi = FuncInfo(name=child.name, qual=qual,
+                                  path=mod.path, node=child,
+                                  levers=lever_reads(child),
+                                  calls=call_names(child) - nested)
+                    fi.jits = bool({"jit", "pjit", "shard_map"}
+                                   & fi.calls)
+                    self.funcs.setdefault(child.name, []).append(fi)
+                    self.by_qual[qual] = fi
+                    if cls_name is not None:
+                        self.class_methods.setdefault(cls_name, []) \
+                            .append(fi)
+                    visit(child, prefix + child.name + ".", None)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, prefix + child.name + ".", child.name)
+                else:
+                    visit(child, prefix, cls_name)
+
+        visit(mod.tree, "")
+
+    def _expand(self, name: str) -> list:
+        """Defs a bare called name may resolve to: its functions, plus —
+        when the name is a known class — the class's builder methods."""
+        out = list(self.funcs.get(name, ()))
+        for fi in self.class_methods.get(name, ()):
+            if fi.name in ("__init__", "__post_init__") \
+                    or fi.name.startswith("_build"):
+                out.append(fi)
+        return out
+
+    def reachable_levers(self, names, _depth: int = 12) -> set:
+        """Transitive YDB_TPU_* reads from a set of called bare names."""
+        seen: set = set()
+        levers: set = set()
+        frontier = [n for n in names if n not in _STOP]
+        for _ in range(_depth):
+            nxt = []
+            for name in frontier:
+                if name in seen or name in _STOP:
+                    continue
+                seen.add(name)
+                for fi in self._expand(name):
+                    levers |= fi.levers
+                    nxt.extend(c for c in fi.calls
+                               if c not in seen and c not in _STOP)
+            if not nxt:
+                break
+            frontier = nxt
+        return levers
+
+    def reaches(self, names, target: str) -> bool:
+        """Does any call path from `names` reach a def named `target`?"""
+        seen: set = set()
+        frontier = [n for n in names if n not in _STOP]
+        while frontier:
+            name = frontier.pop()
+            if name in seen or name in _STOP:
+                continue
+            seen.add(name)
+            if name == target:
+                return True
+            for fi in self._expand(name):
+                frontier.extend(c for c in fi.calls if c not in seen)
+        return target in names

@@ -1,0 +1,250 @@
+"""graftlint core — parsed-module project, pragma suppression, baseline
+ratchet, and the pass runner.
+
+Design notes:
+
+* Findings carry a line number for humans but are IDENTIFIED by a
+  line-free key `path::scope::token` (scope = enclosing def/class
+  qualname). The baseline stores `{pass: {key: count}}`, so unrelated
+  edits that move code around do not invalidate it; growth of the same
+  debt in the same function does.
+* Pragmas are read from real COMMENT tokens (tokenize), not regexed out
+  of source lines, so a `# lint:` inside a string literal never
+  suppresses anything.
+* A pass is project-scoped (it sees every parsed module at once) —
+  cross-module checks (cache-key reachability, RPC surface drift) need
+  the whole tree anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+_PRAGMA_RE = re.compile(
+    r"lint:\s*allow-(?P<file>file-)?(?P<pass>[a-z][a-z0-9-]*)"
+    r"\((?P<reason>[^)]*)\)")
+_MARKER_RE = re.compile(r"lint:\s*(?P<marker>[a-z][a-z0-9-]*)\s*$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    pass_id: str
+    path: str          # project-relative, forward slashes
+    line: int          # 1-based, for humans
+    key: str           # stable identity: path::scope::token
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+class ModuleInfo:
+    """One parsed source file: AST + per-line comments + pragmas."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        # line -> comment text (without leading '#'), from real tokens
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string.lstrip("#")
+        except tokenize.TokenError:
+            pass
+        # pass -> reason for whole-file suppressions
+        self.file_pragmas: dict[str, str] = {}
+        # line -> {pass: reason} for single-line suppressions
+        self.line_pragmas: dict[int, dict[str, str]] = {}
+        # line -> marker name ("tuning-provider", ...)
+        self.markers: dict[int, str] = {}
+        for ln, text in self.comments.items():
+            for m in _PRAGMA_RE.finditer(text):
+                if m.group("file"):
+                    self.file_pragmas[m.group("pass")] = m.group("reason")
+                else:
+                    self.line_pragmas.setdefault(ln, {})[m.group("pass")] \
+                        = m.group("reason")
+            m = _MARKER_RE.search(text)
+            if m:
+                self.markers[ln] = m.group("marker")
+
+    def suppressed(self, pass_id: str, line: int) -> bool:
+        """A finding at `line` is excused by a pragma on the same line,
+        on the line directly above, or by a file-level pragma."""
+        if pass_id in self.file_pragmas:
+            return True
+        for ln in (line, line - 1):
+            if pass_id in self.line_pragmas.get(ln, {}):
+                return True
+        return False
+
+    def marker_on_def(self, node: ast.AST, marker: str) -> bool:
+        """Is `# lint: <marker>` on the def line or the line above it?"""
+        ln = getattr(node, "lineno", 0)
+        return (self.markers.get(ln) == marker
+                or self.markers.get(ln - 1) == marker)
+
+    def scope_of(self, node: ast.AST) -> str:
+        """Qualname-ish enclosing scope of a node (for stable keys)."""
+        target_ln = getattr(node, "lineno", 0)
+        best = "<module>"
+        best_ln = 0
+
+        def walk(n, prefix):
+            nonlocal best, best_ln
+            for child in ast.iter_child_nodes(n):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    name = f"{prefix}{child.name}"
+                    if child.lineno <= target_ln \
+                            and child.end_lineno >= target_ln \
+                            and child.lineno >= best_ln:
+                        best, best_ln = name, child.lineno
+                    walk(child, name + ".")
+                else:
+                    walk(child, prefix)
+
+        walk(self.tree, "")
+        return best
+
+
+class Project:
+    """Every parsed module under the package root."""
+
+    def __init__(self, modules: dict[str, ModuleInfo]):
+        self.modules = modules        # rel path -> ModuleInfo
+
+    @classmethod
+    def from_dir(cls, root: str, package: str = "ydb_tpu") -> "Project":
+        mods: dict[str, ModuleInfo] = {}
+        pkg_root = os.path.join(root, package)
+        for dirpath, _dirs, files in os.walk(pkg_root):
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, root).replace(os.sep, "/")
+                with open(full, encoding="utf-8") as f:
+                    mods[rel] = ModuleInfo(rel, f.read())
+        return cls(mods)
+
+    @classmethod
+    def from_sources(cls, sources: dict[str, str]) -> "Project":
+        """In-memory project for fixture tests."""
+        return cls({p: ModuleInfo(p, s) for p, s in sources.items()})
+
+    def get(self, path: str):
+        return self.modules.get(path)
+
+    def under(self, *prefixes: str):
+        """Modules whose path starts with any prefix."""
+        for path in sorted(self.modules):
+            if any(path.startswith(p) for p in prefixes):
+                yield self.modules[path]
+
+
+class Pass:
+    """One invariant. Subclasses set `id`/`title` and implement
+    `check(project) -> [Finding]` WITHOUT worrying about pragmas — the
+    runner drops suppressed findings centrally."""
+
+    id = "base"
+    title = "base pass"
+
+    def check(self, project: Project) -> list:
+        raise NotImplementedError
+
+    def run(self, project: Project) -> list:
+        out = []
+        for f in self.check(project):
+            mod = project.get(f.path)
+            if mod is not None and mod.suppressed(self.id, f.line):
+                continue
+            out.append(f)
+        return out
+
+
+class Baseline:
+    """The ratchet file: `{pass: {key: count}}`. Existing debt passes;
+    NEW keys or growth of an existing key fail; shrinkage is reported so
+    the file can be tightened in the same change."""
+
+    def __init__(self, entries: dict | None = None):
+        self.entries: dict[str, dict[str, int]] = entries or {}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls({})
+        with open(path, encoding="utf-8") as f:
+            return cls(json.load(f))
+
+    @classmethod
+    def from_findings(cls, findings: list) -> "Baseline":
+        entries: dict[str, dict[str, int]] = {}
+        for f in findings:
+            per = entries.setdefault(f.pass_id, {})
+            per[f.key] = per.get(f.key, 0) + 1
+        return cls(entries)
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({p: dict(sorted(ks.items()))
+                       for p, ks in sorted(self.entries.items())},
+                      f, indent=1, sort_keys=True)
+            f.write("\n")
+
+    def compare(self, findings: list) -> tuple:
+        """→ (new_findings, excused_count, shrunk) where `shrunk` maps
+        pass -> {key: (baselined, current)} for ratchet tightening."""
+        current: dict[str, dict[str, list]] = {}
+        for f in findings:
+            current.setdefault(f.pass_id, {}).setdefault(f.key, []).append(f)
+        new: list = []
+        excused = 0
+        for pass_id, per_key in current.items():
+            base = self.entries.get(pass_id, {})
+            for key, fs in per_key.items():
+                allowed = base.get(key, 0)
+                excused += min(allowed, len(fs))
+                if len(fs) > allowed:
+                    new.extend(sorted(fs, key=lambda x: x.line)[allowed:])
+        shrunk: dict[str, dict[str, tuple]] = {}
+        for pass_id, base in self.entries.items():
+            per_key = current.get(pass_id, {})
+            for key, allowed in base.items():
+                have = len(per_key.get(key, []))
+                if have < allowed:
+                    shrunk.setdefault(pass_id, {})[key] = (allowed, have)
+        return new, excused, shrunk
+
+
+def load_passes() -> list:
+    from ydb_tpu.analysis.passes import ALL_PASSES
+    return [cls() for cls in ALL_PASSES]
+
+
+def run(project: Project, passes=None, baseline: Baseline | None = None):
+    """→ dict report: findings, new (vs baseline), excused, shrunk."""
+    passes = passes if passes is not None else load_passes()
+    findings: list = []
+    for p in passes:
+        findings.extend(p.run(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    report = {"findings": findings, "new": findings, "excused": 0,
+              "shrunk": {}}
+    if baseline is not None:
+        new, excused, shrunk = baseline.compare(findings)
+        report.update(new=sorted(new, key=lambda f: (f.path, f.line)),
+                      excused=excused, shrunk=shrunk)
+    return report

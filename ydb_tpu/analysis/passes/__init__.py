@@ -1,0 +1,8 @@
+from ydb_tpu.analysis.passes.cache_key import CacheKeyPass
+from ydb_tpu.analysis.passes.counters import CounterRegistryPass
+from ydb_tpu.analysis.passes.host_sync import HostSyncPass
+from ydb_tpu.analysis.passes.locks import LockDisciplinePass
+from ydb_tpu.analysis.passes.rpc_surface import RpcSurfacePass
+
+ALL_PASSES = (HostSyncPass, CacheKeyPass, LockDisciplinePass,
+              CounterRegistryPass, RpcSurfacePass)

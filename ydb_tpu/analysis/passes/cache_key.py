@@ -1,0 +1,197 @@
+"""cache-key — completeness of compiled-program cache keys against the
+`YDB_TPU_*` levers that can shape a traced program.
+
+The tuning-tuple rule (PR 5/6): any environment lever a traced/compiled
+program's builder reads must be a component of the cache key that
+decides whether an already-compiled program is reused — otherwise
+flipping the lever serves a program traced under the OLD setting: a
+silent stale-cache wrong answer (or a lying A/B gate).
+
+Mechanics:
+
+  * A *tuning provider* is a function marked `# lint: tuning-provider`
+    on its def line (e.g. `groupby_tuning`, `quant_enabled`). Its
+    direct lever reads are the levers it covers.
+  * A *cache site* is `<obj>.get(<keyvar>)` where the receiver's name
+    looks like a compiled-program cache (`cache`, `_fns`, `_FNS`,
+    `_aggs`, `_joins`) and `keyvar` is a local name.
+  * The site's *builder closure* = every function transitively callable
+    from the `if <entry> is None:` suite that fills the cache (class
+    instantiation pulls in `__init__`/`__post_init__`/`_build*` —
+    the compile-builder convention), plus levers read directly in the
+    enclosing function. Builders that never reach a `jit`/`shard_map`
+    are not program caches — skipped.
+  * The *key closure* = calls inside every assignment to `keyvar` in
+    the enclosing function, chased one hop through local names (so
+    `base_key = fused_cache_key(...); key = ("batched", base_key, …)`
+    still sees the providers `fused_cache_key` calls).
+
+A lever reachable from the builder but covered by no provider in the
+key closure is a finding. Levers read at module import time are exempt:
+they are process constants and cannot flip between queries.
+
+Known precision limit: coverage asks whether the key closure CALLS a
+provider (directly or transitively, e.g. through `fused_cache_key`),
+not whether the provider's VALUE flows into the key — a helper in the
+key expression that calls a provider and drops its result would
+wrongly count as coverage. Return-value dataflow is out of scope for
+an AST pass; key-building helpers must include what they consult (the
+`*cache_key*` functions here all do, pinned by the regression tests).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ydb_tpu.analysis.core import Finding, Pass
+from ydb_tpu.analysis.callgraph import CallGraph, call_names, lever_reads
+
+_CACHE_NAME = re.compile(r"(cache|_fns|_FNS|_aggs|_joins)", re.IGNORECASE)
+
+
+def _recv_name(func: ast.Attribute) -> str:
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    return ""
+
+
+def _enclosing_function(mod, node):
+    best = None
+    for n in ast.walk(mod.tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.lineno <= node.lineno <= n.end_lineno:
+            if best is None or n.lineno > best.lineno:
+                best = n
+    return best
+
+
+class CacheKeyPass(Pass):
+    id = "cache-key"
+    title = "YDB_TPU_* levers missing from compiled-program cache keys"
+
+    def _providers(self, project) -> dict:
+        """provider bare name -> set of levers it covers."""
+        out: dict[str, set] = {}
+        for mod in project.modules.values():
+            for n in ast.walk(mod.tree):
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and mod.marker_on_def(n, "tuning-provider"):
+                    out[n.name] = lever_reads(n)
+        return out
+
+    def check(self, project) -> list:
+        graph = CallGraph(project)
+        providers = self._providers(project)
+        out = []
+        for mod in project.modules.values():
+            for site in self._cache_sites(mod):
+                out.extend(self._check_site(mod, graph, providers, *site))
+        return out
+
+    # -- site discovery ----------------------------------------------------
+
+    def _cache_sites(self, mod):
+        """Yield (get_call, keyvar, entryvar) for cache lookups."""
+        for n in ast.walk(mod.tree):
+            if not (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)
+                    and isinstance(n.value, ast.Call)):
+                continue
+            call = n.value
+            f = call.func
+            if not (isinstance(f, ast.Attribute) and f.attr == "get"
+                    and _CACHE_NAME.search(_recv_name(f))
+                    and call.args
+                    and isinstance(call.args[0], ast.Name)):
+                continue
+            yield n, call.args[0].id, n.targets[0].id
+
+    # -- per-site analysis -------------------------------------------------
+
+    def _check_site(self, mod, graph, providers, assign, keyvar, entryvar):
+        fn = _enclosing_function(mod, assign)
+        if fn is None:
+            return []
+
+        # builder closure: calls in the `if <entry> is None:` suite(s)
+        builder_calls: set = set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.If) and self._tests_none(n.test, entryvar):
+                for stmt in n.body:
+                    builder_calls |= call_names(stmt)
+        if not builder_calls:
+            return []
+        # only compiled-program caches matter: the builder must reach a
+        # jit/shard_map trace boundary
+        if not (graph.reaches(builder_calls, "jit")
+                or graph.reaches(builder_calls, "shard_map")
+                or graph.reaches(builder_calls, "pjit")):
+            return []
+
+        levers = graph.reachable_levers(builder_calls)
+        levers |= lever_reads(fn)     # enclosing-function direct reads
+        # a provider CALLED in the enclosing function counts as a read
+        # of its levers: its value typically feeds the builder as an
+        # argument (quant_enabled() → quant_names → _build_shuffle_fn),
+        # shaping the traced program just the same
+        fn_calls = call_names(fn)
+        for pname, plevers in providers.items():
+            if pname in fn_calls:
+                levers |= plevers
+        if not levers:
+            return []
+
+        # key closure: calls in every assignment to keyvar, one hop
+        # through locally assigned names
+        local_assigns: dict[str, list] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        local_assigns.setdefault(t.id, []).append(n.value)
+        key_calls: set = set()
+        seen_names: set = set()
+        frontier = [keyvar]
+        for _hop in range(3):
+            nxt = []
+            for name in frontier:
+                if name in seen_names:
+                    continue
+                seen_names.add(name)
+                for value in local_assigns.get(name, ()):
+                    key_calls |= call_names(value)
+                    nxt.extend(x.id for x in ast.walk(value)
+                               if isinstance(x, ast.Name))
+            frontier = nxt
+        covered: set = set()
+        for pname, plevers in providers.items():
+            if pname in key_calls or graph.reaches(key_calls, pname):
+                covered |= plevers
+
+        missing = sorted(levers - covered)
+        out = []
+        scope = mod.scope_of(assign)
+        for lever in missing:
+            out.append(Finding(
+                self.id, mod.path, assign.lineno,
+                key=f"{mod.path}::{scope}::{keyvar}::{lever}",
+                message=f"cache key `{keyvar}` (scope {scope}) omits "
+                        f"lever {lever}: the builder's traced program "
+                        f"depends on it — add the tuning provider to "
+                        f"the key or pragma with the reason it cannot "
+                        f"go stale"))
+        return out
+
+    @staticmethod
+    def _tests_none(test, entryvar) -> bool:
+        return (isinstance(test, ast.Compare)
+                and isinstance(test.left, ast.Name)
+                and test.left.id == entryvar
+                and len(test.ops) == 1
+                and isinstance(test.ops[0], ast.Is)
+                and isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value is None)

@@ -1,0 +1,158 @@
+"""counters — every counter/histogram name must exist in the registry.
+
+`utils/metrics.py` holds `COUNTER_REGISTRY`, the machine-readable map
+of every counter family the dashboards and gates read. This pass walks
+every `GLOBAL.inc / .set / .set_max` and `GLOBAL_HIST.observe` call
+(and the injected-`counters` equivalents the hive uses) and checks the
+name literal against the registry:
+
+  * exact entries match exactly;
+  * entries ending `/*` match any name under that namespace, including
+    the head of an f-string name (`f"slow_query/{kind}"` matches
+    `slow_query/*`);
+  * a fully dynamic name (variable) needs a line pragma naming the
+    family it lands in.
+
+The reverse direction ratchets documentation drift: an exact registry
+entry that no code ever emits is a finding too (a dashboard reading it
+sees permanent zeros — exactly the typo'd-dashboard failure mode this
+pass exists to kill).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ydb_tpu.analysis.core import Finding, Pass
+
+REGISTRY_MODULE = "ydb_tpu/utils/metrics.py"
+REGISTRY_NAME = "COUNTER_REGISTRY"
+_METHODS = ("inc", "set", "set_max", "observe")
+
+
+def _recv_tail(func: ast.Attribute) -> str:
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    return ""
+
+
+def _counter_receiver(func: ast.Attribute) -> bool:
+    tail = _recv_tail(func)
+    if func.attr == "observe":
+        return tail == "GLOBAL_HIST" or tail.endswith("hist")
+    return tail == "GLOBAL" or tail == "counters" \
+        or tail.endswith("_counters")
+
+
+def load_registry(project) -> dict:
+    """name -> doc from the COUNTER_REGISTRY literal; None if absent."""
+    mod = project.get(REGISTRY_MODULE)
+    if mod is None:
+        return None
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and n.targets[0].id == REGISTRY_NAME:
+            try:
+                return dict(ast.literal_eval(n.value))
+            except (ValueError, SyntaxError):
+                return None
+    return None
+
+
+def _match(name: str, registry: dict) -> bool:
+    if name in registry:
+        return True
+    return any(name.startswith(entry[:-1])
+               for entry in registry if entry.endswith("/*"))
+
+
+class CounterRegistryPass(Pass):
+    id = "counters"
+    title = "counter names absent from COUNTER_REGISTRY"
+
+    def check(self, project) -> list:
+        registry = load_registry(project)
+        out = []
+        if registry is None:
+            out.append(Finding(
+                self.id, REGISTRY_MODULE, 1,
+                key=f"{REGISTRY_MODULE}::<module>::registry-missing",
+                message=f"{REGISTRY_NAME} dict literal not found in "
+                        f"{REGISTRY_MODULE}"))
+            return out
+        used_exact: set = set()
+        for mod in project.modules.values():
+            for n in ast.walk(mod.tree):
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in _METHODS
+                        and _counter_receiver(n.func) and n.args):
+                    continue
+                arg = n.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    name = arg.value
+                    used_exact.add(name)
+                    if not _match(name, registry):
+                        scope = mod.scope_of(n)
+                        out.append(Finding(
+                            self.id, mod.path, n.lineno,
+                            key=f"{mod.path}::{scope}::{name}",
+                            message=f"counter {name!r} is not in "
+                                    f"{REGISTRY_NAME} — register it in "
+                                    f"utils/metrics.py (typo'd names "
+                                    f"feed dashboards nobody reads)"))
+                elif isinstance(arg, ast.JoinedStr) and arg.values \
+                        and isinstance(arg.values[0], ast.Constant):
+                    # the literal head must lie INSIDE some family
+                    # (head startswith prefix). The reverse — a short
+                    # head like "engine/" that a family merely starts
+                    # with — proves nothing about where the full name
+                    # lands and must flag.
+                    head = str(arg.values[0].value)
+                    if not any(head.startswith(e[:-1])
+                               for e in registry if e.endswith("/*")):
+                        scope = mod.scope_of(n)
+                        out.append(Finding(
+                            self.id, mod.path, n.lineno,
+                            key=f"{mod.path}::{scope}::f\"{head}…\"",
+                            message=f"f-string counter head {head!r} "
+                                    f"matches no wildcard family in "
+                                    f"{REGISTRY_NAME}"))
+                else:
+                    scope = mod.scope_of(n)
+                    out.append(Finding(
+                        self.id, mod.path, n.lineno,
+                        key=f"{mod.path}::{scope}::<dynamic>",
+                        message="dynamic counter name — pragma it with "
+                                "the registry family it lands in"))
+        # reverse: exact registry entries nothing emits — skipping
+        # wildcards and entries declared "(dynamic)" (emitted through a
+        # variable, pragma'd at the site) or "(derived)" (computed in
+        # QueryEngine.counters(), not emitted through Counters)
+        reg_mod = project.get(REGISTRY_MODULE)
+        for entry in sorted(registry):
+            doc = str(registry[entry])
+            if "(dynamic)" in doc or "(derived)" in doc:
+                continue
+            if not entry.endswith("/*") and entry not in used_exact:
+                out.append(Finding(
+                    self.id, REGISTRY_MODULE,
+                    self._entry_line(reg_mod, entry),
+                    key=f"{REGISTRY_MODULE}::{REGISTRY_NAME}::{entry}",
+                    message=f"registry entry {entry!r} is emitted "
+                            f"nowhere — stale doc or a typo at the "
+                            f"emit site"))
+        return out
+
+    @staticmethod
+    def _entry_line(mod, entry: str) -> int:
+        needle = f'"{entry}"'
+        for i, line in enumerate(mod.lines, 1):
+            if needle in line:
+                return i
+        return 1

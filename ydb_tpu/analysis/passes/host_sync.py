@@ -1,0 +1,90 @@
+"""host-sync — device→host escape detector for the device-resident
+modules (`ops/`, `dq/`, `parallel/`).
+
+ROADMAP item 1's gate is "zero `to_pandas` calls inside a multi-stage
+plan": every implicit device→host synchronization inside the modules
+that are supposed to stay device-resident is debt this pass ratchets.
+Flagged forms:
+
+  * `<x>.to_pandas()` — the client-boundary materialization
+  * `<x>.item()` — scalar sync
+  * `np.asarray(<x>)` — implicit transfer when <x> is a device value
+    (undecidable statically, so EVERY np.asarray in these modules is
+    counted; host-only lanes carry a file pragma, upload paths a line
+    pragma — the point is that each one is either burned down or
+    visibly excused)
+  * `float(jnp...)` / `int(jnp...)` / `bool(np.any(...))` — builtin
+    cast directly wrapping a jnp/jax call
+
+The blessed escape is `jax.device_get(<pytree>)` — ONE batched
+transfer, visible at the call site — which this pass deliberately does
+not flag; burning down a baseline entry usually means folding N
+per-column `np.asarray` syncs into one `device_get`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ydb_tpu.analysis.core import Finding, Pass
+
+MODULES = ("ydb_tpu/ops/", "ydb_tpu/dq/", "ydb_tpu/parallel/")
+_CASTS = ("float", "int", "bool")
+
+
+def _numpy_aliases(tree: ast.AST) -> set:
+    out = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Import):
+            for a in n.names:
+                if a.name == "numpy":
+                    out.add(a.asname or "numpy")
+    return out
+
+
+def _has_jnp_call(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            root = n.func.value
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in ("jnp", "jax",
+                                                          "lax"):
+                return True
+    return False
+
+
+class HostSyncPass(Pass):
+    id = "host-sync"
+    title = "device→host escapes in device-resident modules"
+
+    def check(self, project) -> list:
+        out = []
+        for mod in project.under(*MODULES):
+            np_names = _numpy_aliases(mod.tree)
+            for n in ast.walk(mod.tree):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                token = None
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in ("to_pandas", "item") \
+                        and not n.args:
+                    token = f".{f.attr}()"
+                elif isinstance(f, ast.Attribute) and f.attr == "asarray" \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in np_names:
+                    token = f"{f.value.id}.asarray"
+                elif isinstance(f, ast.Name) and f.id in _CASTS and n.args \
+                        and _has_jnp_call(n.args[0]):
+                    token = f"{f.id}(device)"
+                if token is None:
+                    continue
+                scope = mod.scope_of(n)
+                out.append(Finding(
+                    self.id, mod.path, n.lineno,
+                    key=f"{mod.path}::{scope}::{token}",
+                    message=f"host sync `{token}` in device-resident "
+                            f"module (scope {scope}) — stay on device or "
+                            f"batch through one jax.device_get"))
+        return out

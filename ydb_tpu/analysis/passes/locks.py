@@ -1,0 +1,157 @@
+"""locks — `# guarded-by:` discipline for shared mutable state.
+
+Shared attributes in the concurrent subsystems (`hive/`, `dq/`,
+`cluster/`, `query/`, plus anything else that opts in) declare their
+owning lock on the line that initializes them:
+
+    self._nodes: dict = {}        # guarded-by: _mu
+
+Every MUTATION of a guarded attribute anywhere in the class must then
+sit inside `with self.<lock>:` (any `with` whose items include the
+lock), or inside a method whose name ends in `_locked` (the repo's
+"caller already holds it" convention). Conversely a call to a
+`*_locked` method must itself happen under a `with`. Reads are not
+checked — the sampled-read idiom (snapshot under lock, render outside)
+is deliberate here.
+
+Mutations recognized: assignment / augmented assignment to the
+attribute or a subscript of it, `del`, and calls of known mutating
+container methods (`append`, `pop`, `update`, `add`, ...). `__init__`/
+`__post_init__` are exempt (pre-publication, no concurrent observer).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ydb_tpu.analysis.core import Finding, Pass
+
+_GUARD_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "remove", "clear", "update", "setdefault", "add", "discard",
+    "difference_update", "intersection_update", "popitem",
+    "move_to_end",
+})
+_EXEMPT_METHODS = ("__init__", "__post_init__")
+
+
+def _self_attr(node):
+    """'attr' when node is `self.attr`, else None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class LockDisciplinePass(Pass):
+    id = "locks"
+    title = "guarded-by annotated state mutated outside its lock"
+
+    def check(self, project) -> list:
+        out = []
+        for mod in project.modules.values():
+            for n in mod.tree.body:
+                if isinstance(n, ast.ClassDef):
+                    out.extend(self._check_class(mod, n))
+        return out
+
+    def _check_class(self, mod, cls):
+        guards = self._guards(mod, cls)
+        if not guards:
+            return []
+        out = []
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            exempt = meth.name in _EXEMPT_METHODS
+            holds_by_suffix = meth.name.endswith("_locked")
+            for node, attr, what in self._mutations(meth, guards):
+                if exempt:
+                    continue
+                lock = guards[attr]
+                if holds_by_suffix or self._under_lock(meth, node, lock):
+                    continue
+                scope = f"{cls.name}.{meth.name}"
+                out.append(Finding(
+                    self.id, mod.path, node.lineno,
+                    key=f"{mod.path}::{scope}::{attr}::{what}",
+                    message=f"`self.{attr}` ({what}) is guarded-by "
+                            f"`{lock}` but mutated outside `with "
+                            f"self.{lock}:` in {scope}"))
+            # *_locked callees must be invoked under SOME declared lock
+            if not (exempt or holds_by_suffix):
+                for node in ast.walk(meth):
+                    if isinstance(node, ast.Call):
+                        callee = _self_attr(node.func)
+                        if callee and callee.endswith("_locked") \
+                                and not any(
+                                    self._under_lock(meth, node, lk)
+                                    for lk in set(guards.values())):
+                            scope = f"{cls.name}.{meth.name}"
+                            out.append(Finding(
+                                self.id, mod.path, node.lineno,
+                                key=f"{mod.path}::{scope}::{callee}::call",
+                                message=f"`self.{callee}()` requires the "
+                                        f"caller to hold a lock (the "
+                                        f"_locked convention) but {scope} "
+                                        f"calls it outside any `with`"))
+        return out
+
+    def _guards(self, mod, cls) -> dict:
+        """attr -> lock name from `# guarded-by:` trailing comments on
+        `self.<attr> = ...` lines anywhere in the class body."""
+        guards: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                m = _GUARD_RE.search(mod.comments.get(node.lineno, ""))
+                if not m:
+                    continue
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr:
+                        guards[attr] = m.group(1)
+        return guards
+
+    def _mutations(self, meth, guards):
+        """Yield (node, attr, what) for mutations of guarded attrs."""
+        for node in ast.walk(meth):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr in guards:
+                        yield node, attr, "assign"
+                    elif isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr in guards:
+                            yield node, attr, "setitem"
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    attr = _self_attr(t) or (
+                        _self_attr(t.value)
+                        if isinstance(t, ast.Subscript) else None)
+                    if attr in guards:
+                        yield node, attr, "del"
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                attr = _self_attr(node.func.value)
+                if attr in guards:
+                    yield node, attr, node.func.attr
+
+    @staticmethod
+    def _under_lock(meth, node, lock) -> bool:
+        """Is `node` lexically inside `with self.<lock>:` within meth?"""
+        for w in ast.walk(meth):
+            if isinstance(w, ast.With) \
+                    and w.lineno <= node.lineno <= w.end_lineno:
+                for item in w.items:
+                    if _self_attr(item.context_expr) == lock:
+                        return True
+        return False

@@ -1,0 +1,102 @@
+"""rpc-surface — the three control surfaces must not drift.
+
+`server/service.py QueryServicer` defines the RPC set; `server/
+service.py Client` is the caller every OS-cluster component uses; `dq/
+runner.py LocalWorker` is the SAME surface in-process (the 1-worker
+degenerate case and every single-process multi-engine test). A servicer
+method without a Client method is an RPC nothing can call; one without
+a LocalWorker method means in-process clusters silently diverge from OS
+clusters — the class of bug where a feature works in tests and fails
+the moment a real gRPC worker joins.
+
+Known renames and deliberate N/A holes are declared here (visible,
+reviewed) rather than inferred:
+
+  * `execute_query` ↔ Client.execute / LocalWorker.execute
+  * `exchange_put`  ↔ ExchangeClient.put / LocalWorker._land
+  * session/tx/hive-membership RPCs have no LocalWorker seat — the
+    in-process cluster has no session table, runs 2PC through the
+    coordinator directly, and registers with a Hive object, not over
+    its own loopback.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ydb_tpu.analysis.core import Finding, Pass
+
+SERVICE = "ydb_tpu/server/service.py"
+RUNNER = "ydb_tpu/dq/runner.py"
+
+# servicer method -> (client method | None, worker method | None);
+# None = deliberately absent on that surface, with the reason above
+NAME_MAP = {
+    "execute_query": ("execute", "execute"),
+    "exchange_put": ("put", "_land"),
+    "close_session": ("close", None),
+    "tx_prepare": ("tx_prepare", None),
+    "tx_decide": ("tx_decide", None),
+    "tx_resolve": ("tx_resolve", None),
+    "tx_in_doubt": ("tx_in_doubt", None),
+    "hive_register": ("hive_register", None),
+    "hive_heartbeat": ("hive_heartbeat", None),
+    "hive_nodes": ("hive_nodes", None),
+}
+
+
+def _class_methods(mod, cls_name: str):
+    for n in mod.tree.body:
+        if isinstance(n, ast.ClassDef) and n.name == cls_name:
+            return {m.name: m for m in n.body
+                    if isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))}
+    return None
+
+
+def _rpc_methods(servicer: dict) -> dict:
+    """Handlers with the (self, request, context) gRPC signature."""
+    out = {}
+    for name, node in servicer.items():
+        if name.startswith("_"):
+            continue
+        args = [a.arg for a in node.args.args]
+        if len(args) == 3 and args[0] == "self" and args[2] == "context":
+            out[name] = node
+    return out
+
+
+class RpcSurfacePass(Pass):
+    id = "rpc-surface"
+    title = "servicer / Client / LocalWorker surface drift"
+
+    def check(self, project) -> list:
+        svc_mod = project.get(SERVICE)
+        run_mod = project.get(RUNNER)
+        if svc_mod is None or run_mod is None:
+            return []
+        servicer = _class_methods(svc_mod, "QueryServicer")
+        client = _class_methods(svc_mod, "Client")
+        exch_client = _class_methods(svc_mod, "ExchangeClient") or {}
+        worker = _class_methods(run_mod, "LocalWorker")
+        if servicer is None or client is None or worker is None:
+            return []
+
+        out = []
+        for rpc, node in sorted(_rpc_methods(servicer).items()):
+            want_client, want_worker = NAME_MAP.get(rpc, (rpc, rpc))
+            if want_client is not None and want_client not in client \
+                    and want_client not in exch_client:
+                out.append(Finding(
+                    self.id, SERVICE, node.lineno,
+                    key=f"{SERVICE}::QueryServicer.{rpc}::client",
+                    message=f"RPC `{rpc}` has no Client method "
+                            f"`{want_client}` — nothing can call it"))
+            if want_worker is not None and want_worker not in worker:
+                out.append(Finding(
+                    self.id, RUNNER, node.lineno,
+                    key=f"{SERVICE}::QueryServicer.{rpc}::worker",
+                    message=f"RPC `{rpc}` has no LocalWorker method "
+                            f"`{want_worker}` — in-process clusters "
+                            f"diverge from OS clusters"))
+        return out

@@ -158,10 +158,10 @@ class ExchangeBuffer:
 
     def __init__(self, budget_bytes: int = 1 << 30):
         import threading
-        self._frames: dict = {}           # channel -> [(DataFrame, bytes)]
-        self._seen: dict = {}             # channel -> {(src, seq)}
-        self.bytes = 0
-        self.dup_frames = 0
+        self._frames: dict = {}           # guarded-by: _mu
+        self._seen: dict = {}             # guarded-by: _mu
+        self.bytes = 0                    # guarded-by: _mu
+        self.dup_frames = 0               # guarded-by: _mu
         self.budget = budget_bytes
         self._mu = threading.Lock()
 

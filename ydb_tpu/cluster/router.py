@@ -58,7 +58,8 @@ class ShardedCluster:
         import threading
         from ydb_tpu.query import QueryEngine
         from ydb_tpu.server import Client
-        self.workers = [ep if hasattr(ep, "execute") else Client(ep)
+        self.workers = [ep if hasattr(ep, "execute")     # guarded-by: _fo_mu
+                        else Client(ep)
                         for ep in endpoints]
         self.hive = hive
         self.failover_rounds = failover_rounds

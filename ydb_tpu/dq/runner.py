@@ -647,13 +647,18 @@ class LocalWorker:
     single-process clusters in tests."""
 
     def __init__(self, engine, name: str = ""):
+        import threading
         from ydb_tpu.cluster.exchange import ExchangeBuffer
         from ydb_tpu.utils.metrics import Counters
         self.engine = engine
         self.endpoint = f"local:{name or hex(id(engine))[2:]}"
         self.exchange = ExchangeBuffer()
         self._peers = [self]
-        self.tasks: dict = {}
+        # task table: mutated by the runner's pool threads while
+        # dq_tasks() snapshots it — same discipline as the servicer's
+        # _lock around its _dq_tasks table
+        self._tasks_mu = threading.Lock()
+        self.tasks: dict = {}            # guarded-by: _tasks_mu
         # worker-side task counters go to a private sink: runner and
         # worker share GLOBAL in-process, so counting on both sides
         # would report 2x the real dq/tasks|frames|channel_bytes
@@ -683,18 +688,22 @@ class LocalWorker:
                     outputs: list, src: str, timeout=None,
                     trace=None) -> dict:
         from ydb_tpu.dq import task as dq_task
-        rec = self.tasks.setdefault(task_id, {"stage": stage,
-                                              "attempts": 0})
-        rec["state"], rec["attempts"] = "running", rec["attempts"] + 1
+        with self._tasks_mu:
+            rec = self.tasks.setdefault(task_id, {"stage": stage,
+                                                  "attempts": 0})
+            rec["state"] = "running"
+            rec["attempts"] += 1
         try:
             resp = dq_task.run_task(
                 self.engine, sql, outputs, src,
                 send=lambda _o, p, frame: self._peers[p]._land(frame),
                 counters=self.task_counters, trace=trace)
-            rec["state"] = "finished"
+            with self._tasks_mu:
+                rec["state"] = "finished"
             return resp
         except Exception as e:
-            rec["state"], rec["error"] = "failed", str(e)
+            with self._tasks_mu:
+                rec["state"], rec["error"] = "failed", str(e)
             raise
 
     def ici_land(self, channel: str, df, nbytes: int,
@@ -721,8 +730,24 @@ class LocalWorker:
             self.exchange.drop(ch)
         return {"ok": True}
 
+    def dq_tasks(self, timeout=None) -> dict:
+        """Task-table snapshot — the DqTasks RPC surface, in-process
+        (per-record copies UNDER the lock, same as the servicer, so a
+        caller can't observe a record mid-mutation from a running task
+        thread)."""
+        with self._tasks_mu:
+            return {k: dict(v) for k, v in self.tasks.items()}
+
     def counters(self) -> dict:
         return self.engine.counters()
+
+    def health(self) -> dict:
+        """The Health RPC surface, in-process: the shared engine-level
+        payload (`server.service.health_snapshot` — one body, two
+        transports). No session table here — LocalWorker clusters
+        drive engines directly."""
+        from ydb_tpu.server.service import health_snapshot
+        return {**health_snapshot(self.engine), "sessions": 0}
 
     def hive_adopt_shard(self, root: str, tables=None,
                          timeout=None) -> dict:

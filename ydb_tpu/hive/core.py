@@ -47,12 +47,15 @@ class Hive:
         self.counters = counters or GLOBAL
         self.move_on_join = move_on_join
         self._mu = threading.Lock()          # placement transitions
-        self._adopting: set = set()          # shards mid-replay
+        self._adopting: set = set()          # guarded-by: _mu
         # failed replays back off before the sweep retries them — a
         # persistently failing adopt hook must not re-run its
         # seconds-long image replay inline in EVERY query's sweep
         self.adopt_retry_s = max(2.0, float(lease_s))
-        self._adopt_backoff: dict = {}       # shard -> earliest retry
+        # shard -> earliest retry (read by the planning step under _mu,
+        # so writes hold it too — concurrent sweep + fail_workers both
+        # run _replace)
+        self._adopt_backoff: dict = {}       # guarded-by: _mu
         self._pulse_thread = None
         self._pulse_stop = threading.Event()
 
@@ -144,6 +147,7 @@ class Hive:
             planned = [(s, old, target_of[old]) for (s, old, _n) in moves]
             self._adopting.update(s for (s, _o, _n) in planned)
         done = []
+        failed = []
         for (s, old, nid) in planned:
             node = self.membership.get(nid)
             try:
@@ -152,15 +156,20 @@ class Hive:
                                self.membership.get(old)
                                if old is not None else None)
                 done.append((s, old, nid))
-                self._adopt_backoff.pop(s, None)
                 self.counters.inc("hive/shards_replaced")
             except Exception:                # noqa: BLE001 — keep orphan
-                self._adopt_backoff[s] = \
-                    self.membership.clock() + self.adopt_retry_s
+                failed.append(s)
                 self.counters.inc("hive/adopt_failed")
+        retry_at = self.membership.clock() + self.adopt_retry_s
         with self._mu:
+            # backoff updates under _mu: the planning step above reads
+            # _adopt_backoff under the lock, and a concurrent _replace
+            # (sweep vs fail_workers) must not interleave a torn view
             for (s, _old, nid) in done:
                 self.placement.assign[s] = nid
+                self._adopt_backoff.pop(s, None)
+            for s in failed:
+                self._adopt_backoff[s] = retry_at
             if done:
                 self.placement.epoch += 1
             self._adopting.difference_update(
@@ -170,15 +179,15 @@ class Hive:
         return done
 
     def _sync_node_shards_locked(self) -> None:
-        """Mirror the placement back onto NodeInfo.shards (the sysview
-        and rejoin-staleness both read it)."""
+        """Mirror the placement back onto NodeInfo.shards. `_locked`
+        covers OUR lock (placement.assign is read under _mu); the
+        NodeInfo mutation itself happens inside the membership under
+        ITS lock (`sync_shards`) — rewriting peer-owned rows under the
+        wrong lock is exactly what graftlint's locks pass flags."""
         owned: dict = {}
         for s, nid in self.placement.assign.items():
             owned.setdefault(nid, []).append(s)
-        for n in self.membership.nodes():
-            n.shards = sorted(owned.get(n.node_id, ()), key=str)
-            if n.shards:
-                n.had_shards = True
+        self.membership.sync_shards(owned)
 
     # -- router-facing views ------------------------------------------------
 

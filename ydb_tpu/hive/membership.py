@@ -65,8 +65,11 @@ class HiveMembership:
         self._mu = threading.Lock()
         # registration order is placement order (dict preserves it) —
         # the router's worker list must keep the operator's endpoint
-        # order so pk-hash insert routing stays stable across restarts
-        self._nodes: dict[str, NodeInfo] = {}
+        # order so pk-hash insert routing stays stable across restarts.
+        # NodeInfo fields are part of this table's state: mutating them
+        # (shards/stale/load/...) requires _mu too, which is why the
+        # Hive's placement mirror goes through sync_shards below.
+        self._nodes: dict[str, NodeInfo] = {}   # guarded-by: _mu
 
     # -- registration / renewal --------------------------------------------
 
@@ -148,6 +151,18 @@ class HiveMembership:
             if newly:
                 self._gauge_locked()
         return newly
+
+    def sync_shards(self, owned: dict) -> None:
+        """Mirror a placement map back onto NodeInfo.shards (the sysview
+        and rejoin-staleness both read them). NodeInfo rows are THIS
+        registry's state, so the mutation holds OUR lock — the Hive used
+        to rewrite them under its placement lock only, which let a
+        concurrent rows()/register() observe half-synced shard lists."""
+        with self._mu:
+            for n in self._nodes.values():
+                n.shards = sorted(owned.get(n.node_id, ()), key=str)
+                if n.shards:
+                    n.had_shards = True
 
     def _gauge_locked(self) -> None:
         self.counters.set("hive/workers_alive",

@@ -71,9 +71,11 @@ def host_column(data, valid, dtype, dictionary) -> ColumnData:
     """Host materialization convention shared by every device→host path
     (`to_host`, the fused unpack): restore the schema dtype, collapse
     all-valid masks to None, reattach the dictionary."""
+    # lint: allow-host-sync(inputs already landed by the caller's batched device_get)
     d = np.asarray(data).astype(dtype.np)
     v = valid
     if v is not None:
+        # lint: allow-host-sync(inputs already landed by the caller's batched device_get)
         v = np.asarray(v)
         if v.all():
             v = None

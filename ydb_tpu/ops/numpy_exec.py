@@ -13,6 +13,8 @@ GroupBy.
 
 from __future__ import annotations
 
+# lint: allow-file-host-sync(CPU oracle lane — operates on host numpy only, never device values)
+
 from typing import Optional
 
 import numpy as np

@@ -609,10 +609,10 @@ def compute_windows_device(block, outer, final_sort=None, limit=None,
         n = int(n)
         dicts.update(pass_dicts)
         out = {}
+        # device_get above already landed host ndarrays — slice directly
         for name, (vals, valid) in host.items():
-            out[name] = (np.asarray(vals)[:n],
-                         None if valid is None
-                         else np.asarray(valid)[:n],
+            out[name] = (vals[:n],
+                         None if valid is None else valid[:n],
                          dicts.get(name))
         return out, n
     dev = fn(inputs)
@@ -620,7 +620,7 @@ def compute_windows_device(block, outer, final_sort=None, limit=None,
 
     out = {}
     for alias, (vals, valid) in host.items():
-        out[alias] = (np.asarray(vals)[:L],
-                      None if valid is None else np.asarray(valid)[:L],
+        out[alias] = (vals[:L],
+                      None if valid is None else valid[:L],
                       dicts.get(alias))
     return out

@@ -117,7 +117,7 @@ _SCATTER_MAX_BUCKETS = 1 << 16    # medium-domain single-scatter path bound
 # --------------------------------------------------------------------------
 
 
-def groupby_tuning() -> tuple:
+def groupby_tuning() -> tuple:  # lint: tuning-provider
     """(tile_rows, batch_cap, legacy) resolved from the environment.
 
     * YDB_TPU_GROUPBY_TILE_ROWS — value-column gathers inside the sorted
@@ -199,6 +199,7 @@ def groupby_trace_delta(mark: dict) -> dict:
 def _t_inc(name: str, by: int = 1, ns: str = "groupby") -> None:
     from ydb_tpu.utils.metrics import GLOBAL
     _TRACE.stats[name] = _TRACE.stats.get(name, 0) + by
+    # lint: allow-counters(groupby/* + sort/* trace names, all registered)
     GLOBAL.inc(f"{ns}/{name}", by)
 
 
@@ -206,6 +207,7 @@ def _t_max(name: str, value: int, ns: str = "groupby") -> None:
     from ydb_tpu.utils.metrics import GLOBAL
     if value > _TRACE.stats.get(name, -1):
         _TRACE.stats[name] = value
+    # lint: allow-counters(groupby/* + sort/* trace names, all registered)
     GLOBAL.set_max(f"{ns}/{name}", value)
 
 

@@ -39,7 +39,7 @@ from ydb_tpu.core.dtypes import DType, Kind
 from ydb_tpu.core.schema import Column, Schema
 from ydb_tpu.ops import ir
 from ydb_tpu.ops.device import bucket_capacity
-from ydb_tpu.ops.xla_exec import _trace_program, compress
+from ydb_tpu.ops.xla_exec import _trace_program, compress, groupby_tuning
 from ydb_tpu.parallel._compat import shard_map
 from ydb_tpu.parallel.collective import (AXIS, bucket_of, bucket_segments,
                                          compact_segments,
@@ -240,8 +240,13 @@ class DistributedAgg:
         lengths = np.array([b.length for b in blocks_per_device],
                            dtype=np.int32)
 
+        # groupby_tuning is part of the identity: _build traces the
+        # partial/final GroupBy under the env knobs live at trace time,
+        # and this instance can outlive a knob flip (tests construct
+        # DistributedAgg directly; the executor's outer cache already
+        # keys on the tuning, this inner cache must agree)
         sig = (cap, tuple(sorted(valid_names)), tuple(sorted(params)),
-               self.seg_rows)
+               self.seg_rows, groupby_tuning())
         entry = self._fns.get(sig)
         if entry is None:
             entry = self._build(cap, tuple(sorted(valid_names)),
@@ -305,7 +310,7 @@ class DistributedAgg:
             (ndev,), sh1, [fused[d][2][None] for d in range(ndev)])
 
         sig = (pcap, tuple(sorted(names)), tuple(sorted(params)),
-               self.seg_rows)
+               self.seg_rows, groupby_tuning())
         entry = self._fns.get(sig)
         if entry is None:
             entry = self._build(pcap, tuple(sorted(names)),
@@ -331,14 +336,20 @@ class DistributedAgg:
         out_cols = [Column(n, DType(Kind(k), nullable))
                     for (n, k, nullable) in out_sig]
         schema = Schema(out_cols)
-        flens = np.asarray(flens)
+        # ONE batched device→host transfer for every (column, device) —
+        # the to_host discipline (ops/device.py): each np.asarray on a
+        # device array is its own blocking round trip, 2·cols·ndev of
+        # them on a tunneled TPU before this was batched
+        host_d, host_v, flens = jax.device_get(
+            ({c.name: out_d[c.name] for c in out_cols},
+             {c.name: out_v[c.name] for c in out_cols}, flens))
         blocks = []
         for d in range(ndev):
             n = int(flens[d])
             cols = {}
             for c in out_cols:
-                data = np.asarray(out_d[c.name][d][:n]).astype(c.dtype.np)
-                v = np.asarray(out_v[c.name][d][:n])
+                data = host_d[c.name][d][:n].astype(c.dtype.np)
+                v = host_v[c.name][d][:n]
                 cols[c.name] = ColumnData(
                     data, None if v.all() else v, dicts.get(c.name))
             blocks.append(HostBlock(schema, cols, n))

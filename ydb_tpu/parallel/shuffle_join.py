@@ -29,7 +29,7 @@ from ydb_tpu.core.schema import Column, Schema
 from ydb_tpu.ops import ir
 from ydb_tpu.ops.device import DeviceBlock, bucket_capacity
 from ydb_tpu.ops.join import _select_and_gather, build as build_table
-from ydb_tpu.ops.xla_exec import _trace_program, compress
+from ydb_tpu.ops.xla_exec import _trace_program, compress, groupby_tuning
 from ydb_tpu.parallel._compat import shard_map
 from ydb_tpu.parallel.collective import (AXIS, bucket_of, bucket_segments,
                                          compact_segments,
@@ -237,8 +237,11 @@ class ShuffleJoin:
 
         payload_names = tuple(sorted(build_arrays["payload"]))
         pvalid_names = tuple(sorted(build_arrays["pvalid"]))
+        # groupby_tuning: _build traces rest_programs/partial (GroupBy
+        # lowerings read the tile/batch/legacy knobs) — same identity
+        # rule as every other compiled-program cache key
         key = (pcap, bcap, payload_names, pvalid_names,
-               tuple(sorted(params)))
+               tuple(sorted(params)), groupby_tuning())
         entry = self._fns.get(key)
         if entry is None:
             entry = self._build(pcap, bcap, payload_names, pvalid_names,

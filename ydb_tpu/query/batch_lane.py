@@ -102,13 +102,13 @@ class BatchLane:
         self.window_s = window_s
         self.max_batch = max(1, int(max_batch))
         self._mu = threading.Lock()
-        self._groups: dict = {}
+        self._groups: dict = {}              # guarded-by: _mu
         # (table, uid, data_version, snap.plan_step) -> src-id sig memo:
         # between commits the coordinator publishes no new plan step, so
         # a storm's members all hit one entry; ANY commit advances the
         # step and naturally invalidates (compaction/indexation run at
         # commit points). Bounded: cleared when it outgrows the window.
-        self._sig_memo: dict = {}
+        self._sig_memo: dict = {}            # guarded-by: _mu
 
     # -- eligibility / grouping --------------------------------------------
 
@@ -149,13 +149,18 @@ class BatchLane:
         from ydb_tpu.storage.device_cache import enumerate_scan_sources
         t = self.engine.catalog.table(name)
         memo_key = (name, t.uid, t.data_version, snap.plan_step)
-        sig = self._sig_memo.get(memo_key)
+        with self._mu:
+            sig = self._sig_memo.get(memo_key)
         if sig is None:
+            # enumerate outside the lock (it walks portions); publish
+            # under it — storm threads raced clear()+setitem unguarded
+            # here before the locks pass caught it
             _sources, ids = enumerate_scan_sources(t, snap, None)
             sig = (t.uid, t.data_version, tuple(ids))
-            if len(self._sig_memo) > 256:
-                self._sig_memo.clear()
-            self._sig_memo[memo_key] = sig
+            with self._mu:
+                if len(self._sig_memo) > 256:
+                    self._sig_memo.clear()
+                self._sig_memo[memo_key] = sig
         return sig
 
     # -- entry -------------------------------------------------------------

@@ -145,9 +145,9 @@ class QueryEngine:
             os.environ.get("YDB_TPU_TRACE_SAMPLE", "1") or 0)))
         self.slow_query_ms = float(
             os.environ.get("YDB_TPU_SLOW_QUERY_MS", "1000"))
-        self._slow_sqls: dict = {}       # sql -> worst ms (bounded)
+        self._slow_sqls: dict = {}       # guarded-by: _trace_mu
         self._trace_mu = threading.Lock()
-        self._trace_acc = 0.0            # deterministic rate accumulator
+        self._trace_acc = 0.0            # guarded-by: _trace_mu
         # assembled query profiles, last-N ring (`.sys/query_profiles`):
         # one record per SAMPLED outermost statement — sql, wall,
         # phase breakdown, and the full cross-worker span tree
@@ -167,7 +167,7 @@ class QueryEngine:
         # SELECT still needs (autocommit snapshots are not coordinator-
         # pinned; explicit txs pin theirs)
         from collections import Counter as _Counter
-        self._active_reads = _Counter()
+        self._active_reads = _Counter()  # guarded-by: _reads_mu
         self._reads_mu = threading.Lock()
         # admission rate limiting (Kesus/quoter analog): meter the
         # "queries" resource via engine.quoter.set_quota(...)
@@ -185,7 +185,7 @@ class QueryEngine:
             "YDB_TPU_PIPELINE_WINDOW", self.config.pipeline_window)))
         self._pipe_sem = threading.BoundedSemaphore(self.pipeline_window)
         self._pipe_mu = threading.Lock()
-        self._pipe_inflight = 0
+        self._pipe_inflight = 0          # guarded-by: _pipe_mu
         # multi-query batched dispatch lane (query/batch_lane.py): with
         # YDB_TPU_BATCH_WINDOW=<ms> > 0, same-shape SELECTs arriving
         # inside the window coalesce into ONE stacked fused execution
@@ -928,31 +928,11 @@ class QueryEngine:
             "pipeline/window": self.pipeline_window,
             "batch/window_ms": self.batch_window_ms,
         })
-        # pipeline stage + group-by trace + batching counters are always
-        # visible (zero before the first SELECT / fresh compile), so
-        # dashboards/probes never see missing keys
-        for k in ("pipeline/dispatched", "pipeline/in_flight",
-                  "pipeline/overlap_hits", "pipeline/readout_ms",
-                  "batch/batches", "batch/coalesced_queries",
-                  "batch/max_size", "batch/singles", "batch/fallbacks",
-                  "batch/declined", "batch/trace_errors",
-                  "batch/reservations", "batch/window_timeouts",
-                  "batch/lift_hits", "batch/lift_misses",
-                  "groupby/traces", "groupby/tiles", "groupby/gather_ops",
-                  "groupby/gather_ops_total", "groupby/batched_gathers",
-                  "groupby/scatter_ops", "groupby/sort_rows_max",
-                  "groupby/value_gather_rows_max",
-                  "groupby/join_bounded_plans", "dq/merge_groupby_stages",
-                  "sort/rows_max", "sort/operands_max",
-                  "slow_query/count", "trace/forced_slow",
-                  "program_cache/compiles", "program_cache/compile_ms",
-                  "hive/registered", "hive/heartbeats",
-                  "hive/worker_dead", "hive/workers_alive",
-                  "hive/lease_expired", "hive/shards_replaced",
-                  "hive/adopt_failed", "hive/failover_holds",
-                  "hive/placement_epoch", "dq/retry_rerouted",
-                  "dq/ici_bytes", "dq/ici_frames", "dq/ici_fallbacks",
-                  "dq/quant_bytes_saved", "dq/quant_refused"):
+        # always-visible counters (zero before the first SELECT / fresh
+        # compile), so dashboards/probes never see missing keys — the
+        # set is the registry's [viz] marks, one source of truth
+        from ydb_tpu.utils.metrics import ALWAYS_VISIBLE
+        for k in ALWAYS_VISIBLE:
             c.setdefault(k, 0)
         c.setdefault("trace/sample_rate", self.trace_sample)
         c.setdefault("trace/profiles_held", len(self.profiles))

@@ -1231,6 +1231,10 @@ class Executor:
                 payload_cols.append(Column(step.mark_col or "__mark",
                                            DType(_K.BOOL, False)))
             rest = [s for (k, s) in pipe.steps[j + 1:]]
+            # groupby_tuning in the key: the ShuffleJoin traces `rest`
+            # and `pipe.partial` (GroupBy lowerings read the tile/batch/
+            # legacy levers at trace time) — a knob flip must build a
+            # fresh join, not reuse a program tiled under old settings
             key = (tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
                          for c in in_schema.columns),
                    step.probe_key, step.kind,
@@ -1238,7 +1242,8 @@ class Executor:
                          for c in payload_cols),
                    ndev,
                    tuple(p.fingerprint() for p in rest),
-                   pipe.partial.fingerprint() if pipe.partial else "")
+                   pipe.partial.fingerprint() if pipe.partial else "",
+                   groupby_tuning())
             sj = self._shuffle_joins.get(key)
             if sj is None:
                 sj = SJ.ShuffleJoin(self.mesh, in_schema, step.probe_key,

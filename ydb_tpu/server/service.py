@@ -77,6 +77,31 @@ def _result_payload(block, stats) -> dict:
     }
 
 
+def health_snapshot(engine) -> dict:
+    """The engine-level health payload, shared by the gRPC Health RPC
+    and LocalWorker.health so the two surfaces cannot drift (graftlint
+    rpc-surface discipline). Lock-free by design — a liveness probe
+    must answer while a long query holds the execution lock. Callers
+    layer their transport-specific fields (sessions, uptime) on top."""
+    import jax
+    tables = [n for n, t in list(engine.catalog.tables.items())
+              if not getattr(t, "transient", False)]
+    issues = []
+    try:
+        devs = jax.devices()
+        platform = devs[0].platform if devs else "none"
+    except Exception as e:                   # noqa: BLE001
+        platform, issues = "unavailable", [f"device: {e}"]
+    return {
+        "status": "GOOD" if not issues else "DEGRADED",
+        "issues": issues,
+        "tables": len(tables),
+        "topics": len(engine.topics),
+        "durable": engine.catalog.store is not None,
+        "platform": platform,
+    }
+
+
 MAX_SESSIONS = 256
 
 import time as _time  # noqa: E402
@@ -95,7 +120,7 @@ class QueryServicer:
         # concurrently across the gRPC thread pool over MVCC snapshots.
         # This lock only guards the servicer's session table.
         self._lock = threading.Lock()
-        self._sessions: "OrderedDict" = OrderedDict()
+        self._sessions: "OrderedDict" = OrderedDict()   # guarded-by: _lock
         self._max_sessions = max_sessions
         # minimal bearer auth (ydb/core/security token check, radically
         # simplified): empty = open access; Ping/Health stay open (probes)
@@ -104,18 +129,20 @@ class QueryServicer:
         # pipeline directly, so this also shows how many RPCs genuinely
         # overlap dispatch/readout (exported with engine.counters())
         self._rpc_mu = threading.Lock()
-        self._rpc_inflight = 0
+        self._rpc_inflight = 0           # guarded-by: _rpc_mu
 
     def _rpc_enter(self, gauge: str) -> None:
         from ydb_tpu.utils.metrics import GLOBAL
         with self._rpc_mu:
             self._rpc_inflight += 1
+            # lint: allow-counters(gauge = server/rpc_in_flight, registered)
             GLOBAL.set(gauge, self._rpc_inflight)
 
     def _rpc_exit(self, gauge: str) -> None:
         from ydb_tpu.utils.metrics import GLOBAL
         with self._rpc_mu:
             self._rpc_inflight -= 1
+            # lint: allow-counters(gauge = server/rpc_in_flight, registered)
             GLOBAL.set(gauge, self._rpc_inflight)
 
     def _authed(self, request) -> bool:
@@ -123,16 +150,24 @@ class QueryServicer:
         return not self._token or hmac.compare_digest(
             str(request.get("token", "")), self._token)
 
-    def _session(self, session_id):
+    def _session_locked(self, session_id):
+        """Resolve-or-create a session. `_locked`: the CALLER holds
+        `_lock` — gRPC pool threads resolve sessions concurrently, and
+        unlocked two requests with one fresh session_id both built an
+        engine session (the loser leaked, staged tx and all) while the
+        LRU popitem raced close_session's pop. The lock is taken at the
+        call site (not here) so the resolve stays one acquisition on
+        the per-RPC hot path — the convention graftlint's locks pass
+        checks on both sides."""
         if not session_id:
             return None                      # default (autocommit) session
         s = self._sessions.get(session_id)
         if s is None:
             s = self.engine.session()
             self._sessions[session_id] = s
-            # bounded session table: evict the least-recently-used idle
-            # session (rolling back any open tx) — abandoned clients must
-            # not pin staged writes forever
+            # bounded session table: evict the least-recently-used
+            # idle session (rolling back any open tx) — abandoned
+            # clients must not pin staged writes forever
             while len(self._sessions) > self._max_sessions:
                 _sid, old = self._sessions.popitem(last=False)
                 if old.tx is not None:
@@ -159,7 +194,7 @@ class QueryServicer:
         self._rpc_enter("server/rpc_in_flight")
         try:
             with self._lock:
-                session = self._session(request.get("session_id"))
+                session = self._session_locked(request.get("session_id"))
             block = self.engine.execute(sql, session=session)
             stats = getattr(self.engine, "last_stats", None)
             return _result_payload(block, stats)
@@ -547,24 +582,8 @@ class QueryServicer:
         long query holds the execution lock, and reading approximate
         counts needs no consistency."""
         import time
-
-        import jax
-        eng = self.engine
-        tables = [n for n, t in list(eng.catalog.tables.items())
-                  if not getattr(t, "transient", False)]
-        issues = []
-        try:
-            devs = jax.devices()
-            platform = devs[0].platform if devs else "none"
-        except Exception as e:               # noqa: BLE001
-            platform, issues = "unavailable", [f"device: {e}"]
         return {
-            "status": "GOOD" if not issues else "DEGRADED",
-            "issues": issues,
-            "tables": len(tables),
-            "topics": len(eng.topics),
-            "durable": eng.catalog.store is not None,
-            "platform": platform,
+            **health_snapshot(self.engine),
             "sessions": len(self._sessions),
             "uptime_s": round(time.monotonic() - _STARTED, 1),
         }

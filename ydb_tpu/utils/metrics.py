@@ -159,98 +159,164 @@ class HistogramRegistry:
 
 GLOBAL_HIST = HistogramRegistry()
 
+# --------------------------------------------------------------------------
+# THE counter registry — every name the process may emit, with its doc.
+#
+# This is load-bearing, not a comment: `graftlint`'s counters pass
+# (ydb_tpu/analysis/passes/counters.py) fails CI when code increments a
+# name that is not here (typo'd names feed dashboards nobody reads) or
+# when an entry here is emitted nowhere (stale doc). Doc-string
+# conventions the tooling understands:
+#
+#   "[viz] ..."   always-visible on /counters (zero before first emit)
+#   "[hist] ..."  a GLOBAL_HIST family, surfaced as hist/<name>/{q}
+#   "(dynamic)"   emitted through a variable name (the call site
+#                 carries a `# lint: allow-counters(...)` pragma)
+#   "(derived)"   computed in QueryEngine.counters(), never emitted
+#                 through Counters methods
+#
+# Wildcard entries end with "/*" and admit an open-ended family.
+# --------------------------------------------------------------------------
+
+COUNTER_REGISTRY = {
+    # -- statement latency histograms (end-to-end + per phase) -------------
+    "query/latency_ms": "[hist] statement wall end-to-end",
+    "query/parse_ms": "[hist] statement parse phase",
+    "query/plan_ms": "[hist] statement plan phase",
+    "query/execute_ms": "[hist] statement execute phase",
+    # -- engine -------------------------------------------------------------
+    "engine/queries": "SELECTs executed",
+    "engine/statements": "statements executed (all kinds)",
+    "engine/rows_out": "result rows returned",
+    "engine/plan_cache_hits": "text-keyed plan cache hits",
+    "engine/plan_cache_misses": "text-keyed plan cache misses",
+    "engine/plan_cache_size": "(derived) live plan-cache entries",
+    "engine/throttled": "statements rejected by the quoter",
+    "engine/ttl_evicted": "rows dropped by TTL sweeps",
+    "engine/shard_splits": "shard split operations",
+    "engine/window_device_pushdown": "window queries on the device lane",
+    "engine/window_device_rows": "rows through the device window lane",
+    "engine/window_device_errors": "device window lane fallbacks",
+    "engine/host_lane/*": "host-lane residency by statement shape",
+    # -- executor -----------------------------------------------------------
+    "executor/fused_plans": "(derived) live fused-plan cache entries",
+    "executor/tiled_queries": "queries run through the tiled path",
+    "executor/shuffle_joins": "mesh shuffle-join executions",
+    "executor/spilled_rows": "rows spilled by the partition store",
+    "executor/spilled_bytes": "bytes spilled by the partition store",
+    # -- concurrent pipeline ------------------------------------------------
+    "pipeline/dispatched": "[viz] queries dispatched async",
+    "pipeline/in_flight": "[viz] dispatched-undrained gauge",
+    "pipeline/overlap_hits": "[viz] entries that found another in flight",
+    "pipeline/readout_ms": "[viz] cumulative readout wall",
+    "pipeline/window_timeouts": "admissions that outwaited the window",
+    "pipeline/window": "(derived) configured pipeline window",
+    # -- batched dispatch lane ---------------------------------------------
+    "batch/batches": "[viz] stacked executions dispatched",
+    "batch/coalesced_queries": "[viz] member queries across batches",
+    "batch/max_size": "[viz] largest batch ever sealed",
+    "batch/singles": "[viz] solo members run per-query",
+    "batch/fallbacks": "[viz] sealed batches that fell back per-member",
+    "batch/declined": "[viz] lane-ineligible statements",
+    "batch/trace_errors": "[viz] stacked-trace failures",
+    "batch/reservations": "[viz] single admission reservations taken",
+    "batch/window_timeouts": "[viz] members that outwaited the seal",
+    "batch/lift_hits": "[viz] plans with every literal lifted",
+    "batch/lift_misses": "[viz] plans the lift pass skipped",
+    "batch/window_ms": "(derived) configured batch window",
+    # -- admission ----------------------------------------------------------
+    "admission/active_queries": "admitted-statement gauge",
+    "admission/in_flight_bytes": "reserved working-set gauge",
+    "admission/waits": "admissions that had to queue",
+    "admission/timeouts": "admissions that hit the deadline",
+    "admission/wait_ms": "[hist] admission queue wait",
+    # -- DQ task-graph runtime ---------------------------------------------
+    "dq/stages": "stages executed (runner)",
+    "dq/tasks": "tasks launched (runner + worker)",
+    "dq/tasks_retried": "tasks re-run by a stage-level retry",
+    "dq/channel_bytes": "frame bytes shipped over host-plane channels",
+    "dq/frames": "frames shipped over host-plane channels",
+    "dq/local_stage_execs": "statements run as DQ stage programs",
+    "dq/channel_inflight_peak_bytes": "flow-control high watermark",
+    "dq/merge_groupby_stages":
+        "[viz] merge stages that are partial-agg merges",
+    "dq/retry_rerouted":
+        "[viz] tasks/statements re-routed off a transport-dead worker",
+    "dq/stage_ms": "[hist] per-stage wall",
+    "dq/channel_wait_ms":
+        "[hist] channel wait (input drain + writer backpressure)",
+    # -- DQ ICI plane (device-resident edges; dq/channel_bytes stays 0) ----
+    "dq/ici_bytes": "[viz] interconnect bytes moved by collectives",
+    "dq/ici_frames": "[viz] (src, dst) segments exchanged",
+    "dq/ici_fallbacks": "[viz] ICI edges re-run on the host plane",
+    "dq/quant_bytes_saved":
+        "[viz] wire bytes saved by EQuARX block quantization",
+    "dq/quant_refused":
+        "[viz] declared quant columns refused (shipped exact)",
+    # -- Hive control plane -------------------------------------------------
+    "hive/registered": "[viz] workers registered (first time)",
+    "hive/heartbeats": "[viz] lease renewals (push agents or pulse)",
+    "hive/worker_dead": "[viz] alive→dead transitions",
+    "hive/lease_expired": "[viz] the expiry subset of worker_dead",
+    "hive/workers_alive": "[viz] gauge: currently alive workers",
+    "hive/shards_replaced": "[viz] shards moved off dead workers",
+    "hive/shards_adopted": "shard images replayed INTO this node",
+    "hive/adopted_rows": "rows absorbed by those replays",
+    "hive/adopt_failed": "[viz] re-placements whose image replay raised",
+    "hive/rejoin_stale": "dead workers that re-registered re-placed",
+    "hive/failover_holds": "[viz] queries held at the placement barrier",
+    "hive/placement_epoch": "[viz] gauge: placement map version",
+    "hive/elections_won": "lease-election wins (pending→leader)",
+    "hive/leadership_lost": "leaders fenced by a lost lease",
+    "hive/standby_promotions": "engines booted from a standby root",
+    # -- sorted group-by trace counters (accrued at TRACE time; deltas
+    # visible only for freshly compiled shapes — the CI gather gate
+    # relies on that; emitted via _t_inc/_t_max in ops/xla_exec.py) ---------
+    "groupby/traces": "[viz] (dynamic) sorted group-by lowerings traced",
+    "groupby/tiles": "[viz] (dynamic) tiles across those traces",
+    "groupby/gather_ops":
+        "[viz] (dynamic) gathers above the tile-row budget",
+    "groupby/gather_ops_total": "[viz] (dynamic) every traced gather",
+    "groupby/batched_gathers":
+        "[viz] (dynamic) per-dtype multi-column 2-D gathers",
+    "groupby/scatter_ops": "[viz] (dynamic) scatter-reduces (legacy path)",
+    "groupby/sort_rows_max": "[viz] (dynamic) group-by sort row watermark",
+    "groupby/value_gather_rows_max":
+        "[viz] (dynamic) value-column gather row watermark",
+    "groupby/join_bounded_plans":
+        "[viz] plans whose group count a join build side bounded",
+    "sort/rows_max": "[viz] (dynamic) lax.sort row watermark",
+    "sort/operands_max": "[viz] (dynamic) lax.sort operand watermark",
+    # -- program / device caches -------------------------------------------
+    "program_cache/compiles": "[viz] fresh XLA compiles (timed shim)",
+    "program_cache/compile_ms": "[viz] cumulative compile wall",
+    "program_cache/hits": "(derived) ProgramCache hits",
+    "program_cache/misses": "(derived) ProgramCache misses",
+    "device_cache/hits": "(derived) HBM column cache hits",
+    "device_cache/misses": "(derived) HBM column cache misses",
+    "device_cache/bytes": "(derived) HBM column cache residency",
+    # -- tracing / slow queries --------------------------------------------
+    "trace/forced_slow": "[viz] statements force-sampled as offenders",
+    "trace/sample_rate": "(derived) configured sample rate",
+    "trace/profiles_held": "(derived) profile ring occupancy",
+    "slow_query/count": "[viz] over-threshold statements",
+    "slow_query/worst_ms": "worst statement wall seen",
+    "slow_query/*": "over-threshold statements by kind",
+    # -- servers ------------------------------------------------------------
+    "server/http_queries": "HTTP front statements",
+    "server/rpc_in_flight": "(dynamic) gRPC handler gauge",
+    "coordinator/plan_step": "(derived) last 2PC plan step",
+}
+
 # the fixed histogram families (always-visible keys on /counters — see
-# QueryEngine.counters): end-to-end + per-phase statement latency,
-# per-DQ-stage wall, channel wait (input drain + writer backpressure),
-# and memory-admission queueing
-HIST_FAMILIES = ("query/latency_ms", "query/parse_ms", "query/plan_ms",
-                 "query/execute_ms", "dq/stage_ms", "dq/channel_wait_ms",
-                 "admission/wait_ms")
+# QueryEngine.counters): derived from the registry's [hist] marks
+HIST_FAMILIES = tuple(sorted(
+    n for n, doc in COUNTER_REGISTRY.items() if doc.startswith("[hist]")))
 
-# DQ task-graph runtime counters (`ydb_tpu/dq/`), one namespace on the
-# existing /counters surface — router side counts stages/tasks/retries,
-# worker side counts local stage executions and channel traffic:
-#   dq/stages                     stages executed (runner)
-#   dq/tasks                      tasks launched (runner + worker)
-#   dq/tasks_retried              tasks re-run by a stage-level retry
-#   dq/channel_bytes              frame bytes shipped over channels
-#   dq/frames                     frames shipped over channels
-#   dq/local_stage_execs          statements run as DQ stage programs
-#   dq/channel_inflight_peak_bytes  flow-control high watermark
-#   dq/merge_groupby_stages       router merge stages that are partial-agg
-#                                 merges (ride the tiled sorted group-by)
-#   dq/retry_rerouted             tasks/statements re-routed off a
-#                                 transport-dead worker (single-task
-#                                 stage reroute, or a router failover
-#                                 round that re-lowered onto the
-#                                 surviving Hive placement)
-#
-# DQ channel ICI plane (`ydb_tpu/dq/ici.py` — device-resident edges;
-# `dq/channel_bytes` above stays at 0 for an edge that went ICI):
-#   dq/ici_bytes                  interconnect bytes moved by device
-#                                 collectives (all_to_all segments +
-#                                 valid masks + row counts; all-gather
-#                                 for broadcast edges)
-#   dq/ici_frames                 (src, dst) segments exchanged
-#   dq/ici_fallbacks              ICI edges re-run on the host plane
-#                                 (mid-collective failure, codec
-#                                 refusal, or a worker set with no
-#                                 shared mesh)
-#   dq/quant_bytes_saved          wire bytes saved by EQuARX block
-#                                 quantization of planner-proven
-#                                 aggregation-tolerant columns
-#                                 (YDB_TPU_DQ_QUANT=1)
-#   dq/quant_refused              declared quant columns the runtime
-#                                 refused (non-float at execution time)
-#                                 and shipped exact instead
-#
-# Hive control-plane counters (`ydb_tpu/hive/`, the cluster membership/
-# placement/failover subsystem):
-#   hive/registered               workers registered (first time)
-#   hive/heartbeats               lease renewals (push agents or pull
-#                                 pulse)
-#   hive/worker_dead              alive→dead transitions (lease expiry
-#                                 or observed transport failure)
-#   hive/lease_expired            the expiry subset of worker_dead
-#   hive/workers_alive            gauge: currently alive workers
-#   hive/shards_replaced          shards moved off dead workers (adopt
-#                                 hook succeeded)
-#   hive/shards_adopted           shard images replayed INTO this node
-#   hive/adopted_rows             rows absorbed by those replays
-#   hive/adopt_failed             re-placements whose image replay
-#                                 raised (shard stays orphaned, retried
-#                                 each sweep)
-#   hive/rejoin_stale             dead workers that re-registered after
-#                                 their shards were re-placed (excluded
-#                                 from sharded scans until re-imaged)
-#   hive/failover_holds           queries held at the placement barrier
-#                                 while a re-placement was in flight
-#   hive/placement_epoch          gauge: placement map version
-#   hive/elections_won            lease-election wins (pending→leader)
-#   hive/leadership_lost          leaders fenced by a lost lease
-#   hive/standby_promotions       engines booted from a standby root by
-#                                 a won election
-#
-# Sorted group-by trace counters (`ops/xla_exec.py`, accrued at TRACE
-# time — compile-cache hits re-trace nothing, so deltas show up only for
-# freshly compiled shapes; the CI gather-budget gate relies on that):
-#   groupby/traces                sorted group-by lowerings traced
-#   groupby/tiles                 tiles across those traces (P per trace)
-#   groupby/gather_ops            gathers ABOVE the tile-row budget — the
-#                                 ~30 ms full-capacity ops the round-8
-#                                 tiled path exists to eliminate
-#   groupby/gather_ops_total      every traced gather
-#   groupby/batched_gathers       per-dtype multi-column (2-D) gathers
-#   groupby/scatter_ops           scatter-reduces (legacy path only; the
-#                                 round-8 path is scatter-free)
-#   groupby/sort_rows_max         high watermark of group-by sort rows
-#   groupby/value_gather_rows_max high watermark of per-op value-column
-#                                 gather rows (≤ tile budget when tiling)
-#   groupby/join_bounded_plans    fused plans whose group count was
-#                                 bounded by an inner-join build side
-#   sort/rows_max, sort/operands_max  lax.sort compile-cliff axes across
-#                                 all device sorts (group-by + ORDER BY)
-
+# counters QueryEngine.counters() zero-fills so dashboards/probes never
+# see missing keys — the registry's [viz] marks
+ALWAYS_VISIBLE = tuple(sorted(
+    n for n, doc in COUNTER_REGISTRY.items() if doc.startswith("[viz]")))
 
 @dataclass
 class QueryStats:

@@ -25,8 +25,8 @@ class TokenBucket:
         self.rate = float(rate)
         self.burst = float(burst)
         self._clock = clock or time.monotonic
-        self._tokens = self.burst
-        self._last = self._clock()
+        self._tokens = self.burst        # guarded-by: _mu
+        self._last = self._clock()       # guarded-by: _mu
         self._mu = threading.Lock()   # admission runs on session threads
 
     def try_acquire(self, amount: float = 1.0) -> bool:

@@ -106,6 +106,20 @@ if [ "$irc" -ne 0 ]; then
     exit "$irc"
 fi
 
+echo "== resource-ledger memory gate (padding ratio, peak HBM, flight recorder, /metrics) =="
+# the bytes floor: the bench-shaped DQ join must report a padding ratio
+# from counters alone, a fused SELECT must measure nonzero mem/peak_bytes
+# with its .sys/query_memory row, the flight recorder must count exactly
+# one boundary transfer per fused SELECT (and pin to_pandas-inside-plan
+# nonzero on the DQ join), /metrics must parse as valid OpenMetrics, and
+# YDB_TPU_MEMLEDGER=0 must be byte-equal with every ledger counter silent
+JAX_PLATFORMS=cpu python scripts/memory_gate.py
+mrc=$?
+if [ "$mrc" -ne 0 ]; then
+    echo "memory gate FAILED (rc=$mrc)" >&2
+    exit "$mrc"
+fi
+
 echo "== DQ two-worker smoke (scan→join→agg over hash-shuffle edges) =="
 # two real OS worker processes; gates on result correctness AND the
 # dq/* counters being non-zero on router + workers (a refactor that

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""CI gate for the resource ledger (`ydb_tpu/utils/memledger.py`).
+
+Deterministic floor under a virtual 4-device mesh (subprocess, the
+`__graft_entry__.dryrun_multichip` stance):
+
+  1. the bench-shaped sharded×sharded DQ join reports a PADDING RATIO
+     from counters alone (`pad/padded_bytes` / `pad/live_bytes` > 1 —
+     the MULTICHIP_r06 capacity-padding tax is now a live gauge);
+  2. a fused SELECT measures nonzero `mem/peak_bytes` and lands a
+     `.sys/query_memory` row;
+  3. the host-transfer flight recorder counts EXACTLY the expected
+     boundary transfers for N fused SELECTs (one pytree readback each)
+     and pins the DQ join's `to_pandas`-inside-plan count nonzero;
+  4. `GET /metrics` serves valid OpenMetrics text: every line parses,
+     histogram buckets are cumulative and end at le="+Inf" == _count,
+     the exposition ends with `# EOF`;
+  5. `YDB_TPU_MEMLEDGER=0` runs the same join byte-equal with every
+     mem/pad/hostsync counter silent.
+
+Prints one JSON line; exit 0 = green.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NDEV = 4
+ROWS = 400
+N_SELECTS = 5
+JOIN_SQL = ("select k, count(*) as n, sum(v) as s "
+            "from t, u where k = uid group by k order by k")
+
+# value class covers scientific notation with NEGATIVE exponents too
+# (5e-05 is a valid OpenMetrics sample) plus +Inf/NaN spellings
+_OM_METRIC = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+insfa-]+$')
+
+
+def mk_cluster():
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.dq.runner import LocalWorker
+    from ydb_tpu.query import QueryEngine
+
+    engines = []
+    for wid in range(NDEV):
+        e = QueryEngine(block_rows=1 << 13)
+        e.execute("create table t (id Int64 not null, k Int64 not null, "
+                  "v Double not null, primary key (id))")
+        mine = [i for i in range(ROWS) if i % NDEV == wid]
+        e.execute("insert into t (id, k, v) values " + ", ".join(
+            f"({i}, {i % 11}, {i * 0.5})" for i in mine))
+        e.execute("create table u (uid Int64 not null, x Double not null, "
+                  "primary key (uid))")
+        mine_u = [i for i in range(11) if i % NDEV == wid]
+        if mine_u:
+            e.execute("insert into u (uid, x) values " + ", ".join(
+                f"({i}, {10.0 + i * 0.25})" for i in mine_u))
+        engines.append(e)
+    c = ShardedCluster([LocalWorker(e, name=f"mg{i}")
+                        for i, e in enumerate(engines)],
+                       merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    return c, engines
+
+
+def validate_openmetrics(text: str) -> list:
+    """Minimal OpenMetrics validator: every line is a comment
+    (HELP/TYPE/EOF) or a sample; histogram buckets are cumulative,
+    non-decreasing, and the +Inf bucket equals <name>_count; the
+    exposition ends with `# EOF`. Returns a list of violations."""
+    errs = []
+    lines = text.splitlines()
+    if not lines or lines[-1] != "# EOF":
+        errs.append("missing trailing # EOF")
+    buckets: dict = {}
+    counts: dict = {}
+    for i, line in enumerate(lines):
+        if line.startswith("#"):
+            if not re.match(r"^# (HELP|TYPE|EOF)", line):
+                errs.append(f"line {i + 1}: bad comment {line[:60]!r}")
+            continue
+        if not _OM_METRIC.match(line):
+            errs.append(f"line {i + 1}: unparsable sample {line[:60]!r}")
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        if name.endswith("_bucket"):
+            m = re.search(r'le="([^"]+)"\} (\S+)', line)
+            if m is None:
+                errs.append(f"line {i + 1}: bucket without le label")
+                continue
+            buckets.setdefault(name[:-len("_bucket")], []).append(
+                (m.group(1), float(m.group(2))))
+        elif name.endswith("_count"):
+            counts[name[:-len("_count")]] = float(line.split(" ")[-1])
+    for fam, bs in buckets.items():
+        cums = [c for (_le, c) in bs]
+        if any(b > a for a, b in zip(cums[1:], cums)):
+            errs.append(f"{fam}: buckets not cumulative")
+        if bs[-1][0] != "+Inf":
+            errs.append(f"{fam}: last bucket le={bs[-1][0]!r}, not +Inf")
+        elif fam in counts and bs[-1][1] != counts[fam]:
+            errs.append(f"{fam}: +Inf bucket {bs[-1][1]} != _count "
+                        f"{counts[fam]}")
+    return errs
+
+
+def child() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from ydb_tpu.server.http import serve_http
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    os.environ["YDB_TPU_DQ_PLANE"] = "auto"
+    out = {"ok": False}
+
+    def snap(keys):
+        return {k: GLOBAL.get(k) for k in keys}
+
+    pad_keys = ("pad/live_bytes", "pad/padded_bytes", "pad/waste_bytes")
+    hs_keys = ("hostsync/transfers", "hostsync/boundary_transfers",
+               "hostsync/bytes", "hostsync/to_pandas_in_plan")
+
+    # -- 1/3: the DQ bench join reports a padding ratio + pins
+    # to_pandas-inside-plan nonzero -------------------------------------
+    c, engines = mk_cluster()
+    c.query(JOIN_SQL)                    # warm: compile + dictionaries
+    pad0, hs0 = snap(pad_keys), snap(hs_keys)
+    res_on = c.query(JOIN_SQL)
+    pad_d = {k: GLOBAL.get(k) - v for k, v in pad0.items()}
+    hs_d = {k: GLOBAL.get(k) - v for k, v in hs0.items()}
+    ratio = pad_d["pad/padded_bytes"] / max(pad_d["pad/live_bytes"], 1)
+    out["padding"] = {**{k.split("/")[1]: int(v) for k, v in pad_d.items()},
+                      "padded_over_live": round(ratio, 2)}
+    out["to_pandas_in_plan"] = int(hs_d["hostsync/to_pandas_in_plan"])
+    pad_ok = pad_d["pad/padded_bytes"] > 0 and ratio > 1.0
+    in_plan_ok = hs_d["hostsync/to_pandas_in_plan"] > 0
+
+    # -- 2: fused SELECT peak + sysview row -----------------------------
+    eng = engines[0]
+    hs1 = snap(hs_keys)
+    peak0 = GLOBAL.get("mem/peak_bytes")
+    for _ in range(N_SELECTS):
+        eng.execute("select k, sum(v) as s from t group by k order by k")
+    mem = dict(eng.last_stats.memory or {})
+    hs_sel = {k: GLOBAL.get(k) - v for k, v in hs1.items()}
+    out["peak_device_bytes"] = int(mem.get("peak_bytes", 0))
+    out["mem_peak_counter"] = int(GLOBAL.get("mem/peak_bytes"))
+    peak_ok = mem.get("peak_bytes", 0) > 0 \
+        and GLOBAL.get("mem/peak_bytes") >= peak0 > -1
+    qm = eng.execute("select count(*) as n from `.sys/query_memory` "
+                     "where peak_bytes > 0").to_pandas()
+    sysview_ok = int(qm["n"][0]) > 0
+
+    # -- 3: flight recorder counts EXACTLY the expected boundary
+    # transfers (one pytree readback per fused SELECT) ------------------
+    out["select_transfers"] = {k.split("/")[1]: int(v)
+                               for k, v in hs_sel.items()}
+    transfers_ok = (hs_sel["hostsync/transfers"] == N_SELECTS
+                    and hs_sel["hostsync/boundary_transfers"]
+                    == N_SELECTS)
+
+    # -- 4: /metrics parses as OpenMetrics ------------------------------
+    front = serve_http(eng)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{front.port}/metrics") as r:
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+    finally:
+        front.stop()
+    errs = validate_openmetrics(text)
+    if "openmetrics-text" not in ctype:
+        errs.append(f"content-type {ctype!r}")
+    if "ydbtpu_mem_peak_bytes" not in text:
+        errs.append("mem/peak_bytes missing from exposition")
+    out["openmetrics_errors"] = errs[:8]
+    out["openmetrics_lines"] = len(text.splitlines())
+    om_ok = not errs
+
+    # -- 5: the lever off is byte-equal and silent ----------------------
+    os.environ["YDB_TPU_MEMLEDGER"] = "0"
+    try:
+        mem0 = snap(pad_keys + hs_keys + ("mem/alloc_bytes",))
+        res_off = c.query(JOIN_SQL)
+        silent = all(GLOBAL.get(k) == v for k, v in mem0.items())
+        byte_equal = list(res_on.columns) == list(res_off.columns) \
+            and len(res_on) == len(res_off) \
+            and all(np.array_equal(res_on[col].to_numpy(),
+                                   res_off[col].to_numpy())
+                    for col in res_on.columns)
+    finally:
+        os.environ.pop("YDB_TPU_MEMLEDGER", None)
+    out["lever_off_silent"] = bool(silent)
+    out["lever_off_byte_equal"] = bool(byte_equal)
+
+    out["ok"] = bool(pad_ok and in_plan_ok and peak_ok and sysview_ok
+                     and transfers_ok and om_ok and silent and byte_equal)
+    for name, v in (("pad_ok", pad_ok), ("in_plan_ok", in_plan_ok),
+                    ("peak_ok", peak_ok), ("sysview_ok", sysview_ok),
+                    ("transfers_ok", transfers_ok), ("om_ok", om_ok)):
+        out[name] = bool(v)
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 1
+
+
+def main() -> int:
+    if os.environ.get("MEMORY_GATE_CHILD") == "1":
+        return child()
+    from ydb_tpu.utils.vmesh import virtual_mesh_env
+    env = virtual_mesh_env(NDEV)
+    env["MEMORY_GATE_CHILD"] = "1"
+    env.pop("YDB_TPU_MEMLEDGER", None)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, timeout=900)
+    return r.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,347 @@
+"""Resource ledger (`ydb_tpu/utils/memledger.py`): device-memory
+accounting, padding-waste measurement, the host-transfer flight
+recorder, admission calibration, and the `YDB_TPU_MEMLEDGER=0`
+byte-equal escape hatch.
+
+Reference analogs: per-query memory in the KQP resource manager
+(`kqp_rm_service.h` TxMemory) and the `.sys` memory views — here the
+bytes companion of PR 7's time attribution.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ydb_tpu.query import QueryEngine
+from ydb_tpu.utils import memledger
+from ydb_tpu.utils.metrics import (GLOBAL, GLOBAL_HIST, COUNTER_REGISTRY,
+                                   render_openmetrics)
+
+
+def _mk_engine(rows: int = 600) -> QueryEngine:
+    eng = QueryEngine(block_rows=1 << 12)
+    eng.execute("create table t (id Int64 not null, k Int64 not null, "
+                "v Double not null, primary key (id)) "
+                "with (store = column)")
+    eng.execute("insert into t (id, k, v) values " + ", ".join(
+        f"({i}, {i % 7}, {i * 0.5})" for i in range(rows)))
+    return eng
+
+
+SQL = "select k, sum(v) as s from t group by k order by k"
+
+
+# -- ledger mechanics ------------------------------------------------------
+
+
+def test_ledger_alloc_peak_and_summary():
+    led = memledger.MemLedger()
+    led.alloc("upload", 100)
+    led.alloc("result", 50)
+    led.free("result", 50)
+    led.alloc("upload", 25)
+    s = led.summary()
+    assert s["peak_bytes"] == 150          # 100 + 50 before the free
+    assert s["alloc_bytes"] == 175
+    assert s["freed_bytes"] == 50
+    assert s["by_category"] == {"upload": 125, "result": 50}
+
+
+def test_ledger_pad_efficiency_and_waste():
+    led = memledger.MemLedger()
+    led.pad("seg", live_rows=100, padded_rows=400, live_bytes=800,
+            padded_bytes=3200)
+    led.pad("seg", live_rows=100, padded_rows=400, live_bytes=800,
+            padded_bytes=3200)
+    s = led.summary()
+    assert s["live_bytes"] == 1600
+    assert s["padded_bytes"] == 6400
+    assert s["waste_bytes"] == 4800
+    assert s["pad_efficiency"] == 0.25
+
+
+def test_nested_statement_contributes_to_outer_ledger():
+    led = memledger.open_statement()
+    assert led is not None
+    try:
+        assert memledger.open_statement() is None   # nested: not owned
+        memledger.record_alloc("upload", 10)
+        assert led.cur_bytes == 10
+    finally:
+        memledger.close_statement(led)
+    assert memledger.current() is None
+
+
+def test_registry_covers_ledger_families():
+    for name in ("mem/peak_bytes", "mem/alloc_bytes", "pad/waste_bytes",
+                 "hostsync/transfers", "hostsync/to_pandas_in_plan",
+                 "admission/calibrated"):
+        assert name in COUNTER_REGISTRY
+
+
+# -- engine integration ----------------------------------------------------
+
+
+def test_fused_select_measures_peak_and_one_boundary_transfer():
+    eng = _mk_engine()
+    t0 = GLOBAL.get("hostsync/transfers")
+    b0 = GLOBAL.get("hostsync/boundary_transfers")
+    eng.execute(SQL)
+    mem = eng.last_stats.memory
+    assert eng.executor.last_path == "fused"
+    assert mem["peak_bytes"] > 0
+    assert mem["by_category"].get("superblock", 0) > 0
+    # exactly ONE device→host readback for a fused SELECT — the pytree
+    # fetch; the flight recorder classifies it as an excused boundary
+    assert mem["transfers"] == 1
+    assert mem["boundary_transfers"] == 1
+    assert mem["to_pandas_in_plan"] == 0
+    assert GLOBAL.get("hostsync/transfers") - t0 == 1
+    assert GLOBAL.get("hostsync/boundary_transfers") - b0 == 1
+
+
+def test_padding_account_includes_capacity_buckets():
+    eng = _mk_engine(rows=600)     # 600 live rows in an 8192-row bucket
+    eng.execute(SQL)
+    mem = eng.last_stats.memory
+    sb = mem["pad"]["superblock"]
+    assert sb["live_rows"] == 600
+    assert sb["padded_rows"] >= 4096
+    assert mem["pad_efficiency"] is not None
+    assert 0 < mem["pad_efficiency"] < 1
+    assert mem["waste_bytes"] == mem["padded_bytes"] - mem["live_bytes"]
+
+
+def test_admission_calibration_recorded():
+    eng = _mk_engine()
+    c0 = GLOBAL.get("admission/calibrated")
+    eng.execute(SQL)
+    mem = eng.last_stats.memory
+    assert mem["admission_est_bytes"] is not None
+    assert mem["est_error_pct"] is not None
+    assert GLOBAL.get("admission/calibrated") > c0
+    h = GLOBAL_HIST.get("admission/est_error_pct")
+    assert h is not None and h.count > 0
+
+
+def test_ledger_attribution_under_concurrent_queries():
+    """Two queries racing on one device: each statement's ledger sees
+    ITS OWN working set (thread-local attribution), so the small scan
+    must not inherit the big scan's superblock bytes."""
+    eng = _mk_engine(rows=600)
+    eng.execute("create table big (id Int64 not null, v Double not null, "
+                "primary key (id)) with (store = column)")
+    eng.execute("insert into big (id, v) values " + ", ".join(
+        f"({i}, {i}.0)" for i in range(20000)))
+    sql_small = SQL
+    sql_big = "select sum(v) as s, sum(id) as si from big"
+    eng.execute(sql_small)
+    eng.execute(sql_big)              # warm both shapes
+    peaks = {}
+
+    def one(name, sql):
+        s = eng.session()
+        eng.execute(sql, session=s)
+        peaks[name] = eng.last_stats.memory["peak_bytes"]
+
+    ts = [threading.Thread(target=one, args=("small", sql_small)),
+          threading.Thread(target=one, args=("big", sql_big))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert peaks["small"] > 0 and peaks["big"] > 0
+    # big scans 20000 rows × 2 float64 columns (32768-capacity
+    # superblock ≈512KB); small scans 600 rows in an 8192 bucket —
+    # attribution swapped or summed would erase the gap
+    assert peaks["big"] > peaks["small"]
+
+
+def test_query_memory_sysview_shape():
+    eng = _mk_engine()
+    eng.execute(SQL)
+    df = eng.execute("select sql, kind, peak_bytes, pad_efficiency, "
+                     "transfers, est_error_pct from `.sys/query_memory` "
+                     "where peak_bytes > 0").to_pandas()
+    assert len(df) >= 1
+    row = df.iloc[-1]
+    assert row["kind"] == "select"
+    assert row["peak_bytes"] > 0
+    assert 0 <= row["pad_efficiency"] <= 1
+
+
+def test_device_transfers_sysview_shape():
+    eng = _mk_engine()
+    eng.execute(SQL)
+    df = eng.execute("select site, bytes, count, boundary from "
+                     "`.sys/device_transfers`").to_pandas()
+    assert len(df) >= 1
+    assert "ops/fused.py::fetch_fused_result" in set(df["site"])
+    fr = df[df["site"] == "ops/fused.py::fetch_fused_result"]
+    assert bool(fr["boundary"].iloc[-1]) is True
+    assert int(fr["bytes"].iloc[-1]) > 0
+
+
+def test_explain_analyze_renders_memory_line():
+    eng = _mk_engine()
+    out = eng.execute(f"explain analyze {SQL}").to_pandas()
+    txt = "\n".join(out["plan"])
+    assert "-- memory: peak" in txt
+    assert "pad eff" in txt
+
+
+# -- the flight recorder on a multi-stage (DQ) plan ------------------------
+
+
+def test_flight_recorder_pins_to_pandas_inside_plan():
+    """A 2-worker DQ join's stage programs each round-trip through
+    pandas (the baselined ROADMAP item 1 debt): the recorder pins the
+    count so a later PR can gate it to zero."""
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.dq.runner import LocalWorker
+
+    engines = []
+    for wid in range(2):
+        e = QueryEngine(block_rows=1 << 12)
+        e.execute("create table t (id Int64 not null, k Int64 not null, "
+                  "v Double not null, primary key (id))")
+        mine = [i for i in range(200) if i % 2 == wid]
+        e.execute("insert into t (id, k, v) values " + ", ".join(
+            f"({i}, {i % 5}, {i * 0.5})" for i in mine))
+        engines.append(e)
+    c = ShardedCluster([LocalWorker(e, name=f"ml{i}")
+                        for i, e in enumerate(engines)],
+                       merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    n0 = GLOBAL.get("hostsync/to_pandas_in_plan")
+    c.query("select k, sum(v) as s from t group by k order by k")
+    delta = GLOBAL.get("hostsync/to_pandas_in_plan") - n0
+    # every (stage, worker) task materializes once — a 2-worker
+    # scan→merge graph runs at least 2 worker tasks
+    assert delta >= 2
+    # the ring attributes them to the stage site
+    sites = {r["site"] for r in memledger.transfer_ring()
+             if r["to_pandas_in_plan"]}
+    assert "dq/task.py::stage_to_pandas" in sites
+
+
+# -- padding ledger on a skewed shuffle ------------------------------------
+
+
+def test_skewed_ici_shuffle_padding_reproduces_multichip_waste():
+    """The ICI exchange ships ndev² fixed-capacity segments; with the
+    hash routing everything into few buckets the live share collapses —
+    the measured padded/live ratio must land in the MULTICHIP_r06 waste
+    class (≥2×; the bench join measures ~3.5×), from counters alone."""
+    import pandas as pd
+
+    from ydb_tpu.dq.graph import Channel, HASH_SHUFFLE
+    from ydb_tpu.dq import ici
+
+    ndev = 4
+    led = memledger.open_statement()
+    assert led is not None
+    try:
+        # skew: every row carries one of TWO keys → at most 2 of the 16
+        # (src, dst) segments per column carry rows
+        dfs = [pd.DataFrame({
+            "k": np.where(np.arange(256) % 2 == 0, 3, 11).astype(np.int64),
+            "v": np.arange(256) * 0.5}) for _ in range(ndev)]
+        ch = Channel(id="skew", kind=HASH_SHUFFLE, src_stage="s1",
+                     dst_stage="s2", key="k", columns=["k", "v"])
+        out_dfs, stats = ici.exchange(ch, dfs, key_kind="int")
+        assert sum(len(d) for d in out_dfs) == ndev * 256
+        assert stats["pad_padded_bytes"] > 0
+        ratio = stats["pad_padded_bytes"] / max(stats["pad_live_bytes"], 1)
+        assert ratio >= 2.0, f"skewed shuffle only measured {ratio:.2f}x"
+        acc = led.summary()["pad"]["ici_frames"]
+        assert acc["padded_bytes"] == stats["pad_padded_bytes"]
+        assert acc["live_bytes"] == stats["pad_live_bytes"]
+    finally:
+        memledger.close_statement(led)
+
+
+# -- the escape hatch ------------------------------------------------------
+
+
+def test_memledger_off_is_byte_equal_and_silent(monkeypatch):
+    eng = _mk_engine()
+    on = eng.execute(SQL).to_pandas()
+    monkeypatch.setenv("YDB_TPU_MEMLEDGER", "0")
+    before = {k: GLOBAL.get(k) for k in
+              ("mem/alloc_bytes", "mem/ledgers", "pad/padded_bytes",
+               "hostsync/transfers", "hostsync/bytes")}
+    off = eng.execute(SQL).to_pandas()
+    assert eng.last_stats.memory == {}
+    for k, v in before.items():
+        assert GLOBAL.get(k) == v, f"{k} moved with the ledger off"
+    assert list(on.columns) == list(off.columns)
+    for col in on.columns:
+        assert np.array_equal(on[col].to_numpy(), off[col].to_numpy())
+
+
+# -- OpenMetrics exposition ------------------------------------------------
+
+
+def test_openmetrics_renders_cumulative_histograms():
+    eng = _mk_engine()
+    eng.execute(SQL)
+    text = render_openmetrics(eng.counters())
+    lines = text.splitlines()
+    assert lines[-1] == "# EOF"
+    assert "# TYPE ydbtpu_mem_peak_bytes gauge" in text
+    assert ("# HELP ydbtpu_mem_peak_bytes high-watermark of any single "
+            "query's device working set") in text
+    # histogram family: cumulative buckets ending at +Inf == _count
+    fams = [ln for ln in lines
+            if ln.startswith("ydbtpu_query_latency_ms_bucket")]
+    assert fams, "query latency histogram missing"
+    cums = [float(ln.rsplit(" ", 1)[1]) for ln in fams]
+    assert cums == sorted(cums)
+    assert 'le="+Inf"' in fams[-1]
+    count = [ln for ln in lines
+             if ln.startswith("ydbtpu_query_latency_ms_count")][0]
+    assert float(count.rsplit(" ", 1)[1]) == cums[-1]
+
+
+def test_metrics_http_endpoint():
+    import urllib.request
+
+    from ydb_tpu.server.http import serve_http
+    eng = _mk_engine()
+    eng.execute(SQL)
+    front = serve_http(eng)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{front.port}/metrics") as r:
+            assert "openmetrics-text" in r.headers.get("Content-Type", "")
+            body = r.read().decode()
+    finally:
+        front.stop()
+    assert body.endswith("# EOF\n")
+    assert "ydbtpu_engine_queries" in body
+
+
+# -- the transfer-ok pragma (one vocabulary, both honoring sides) ----------
+
+
+def test_transfer_ok_pragma_suppresses_host_sync_pass():
+    from ydb_tpu.analysis.core import Project
+    from ydb_tpu.analysis.passes.host_sync import (HostSyncPass,
+                                                   transfer_ok_reason)
+    src = (
+        "import numpy as np\n"
+        "def f(x, y):\n"
+        "    # lint: transfer-ok(client result boundary)\n"
+        "    a = np.asarray(x)\n"
+        "    b = np.asarray(y)\n"
+        "    return a, b\n")
+    project = Project.from_sources({"ydb_tpu/ops/fake.py": src})
+    findings = HostSyncPass().run(project)
+    # the pragma'd line is excused; the bare one still flags
+    assert len(findings) == 1
+    assert findings[0].line == 5
+    mod = project.get("ydb_tpu/ops/fake.py")
+    assert transfer_ok_reason(mod, 4) == "client result boundary"
+    assert transfer_ok_reason(mod, 5) is None

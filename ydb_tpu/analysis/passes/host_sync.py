@@ -20,16 +20,38 @@ The blessed escape is `jax.device_get(<pytree>)` — ONE batched
 transfer, visible at the call site — which this pass deliberately does
 not flag; burning down a baseline entry usually means folding N
 per-column `np.asarray` syncs into one `device_get`.
+
+Suppression vocabulary: `# lint: transfer-ok(reason)` on the line (or
+the line above) excuses a site as a legitimate boundary transfer — the
+SAME pragma the runtime flight recorder (`utils/memledger.py
+record_transfer(boundary=True)`) uses to classify a transfer as
+excused, so static excusal and runtime classification cannot drift
+apart. The generic `# lint: allow-host-sync(reason)` form keeps
+working (the central pragma machinery), but transfer-ok is the one
+vocabulary both sides speak.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 
 from ydb_tpu.analysis.core import Finding, Pass
 
 MODULES = ("ydb_tpu/ops/", "ydb_tpu/dq/", "ydb_tpu/parallel/")
 _CASTS = ("float", "int", "bool")
+_TRANSFER_OK_RE = re.compile(r"lint:\s*transfer-ok\(([^)]*)\)")
+
+
+def transfer_ok_reason(mod, line: int):
+    """The `# lint: transfer-ok(reason)` pragma on `line` or the line
+    directly above it (same placement rule as every other pragma), or
+    None. Shared with tests so the two honoring sides stay aligned."""
+    for ln in (line, line - 1):
+        m = _TRANSFER_OK_RE.search(mod.comments.get(ln, ""))
+        if m:
+            return m.group(1)
+    return None
 
 
 def _numpy_aliases(tree: ast.AST) -> set:
@@ -79,6 +101,10 @@ class HostSyncPass(Pass):
                         and _has_jnp_call(n.args[0]):
                     token = f"{f.id}(device)"
                 if token is None:
+                    continue
+                if transfer_ok_reason(mod, n.lineno) is not None:
+                    # excused boundary transfer — the flight recorder
+                    # counts it under hostsync/boundary_transfers
                     continue
                 scope = mod.scope_of(n)
                 out.append(Finding(

@@ -147,6 +147,12 @@ class DqTaskRunner:
         graph.validate()
         self._dtypes: dict = {}              # channel id -> {col: dtype}
         self._collected: dict = {}           # channel id -> {widx: frame}
+        # resource ledger for the whole graph run: a router-driven DQ
+        # query never passes through engine.execute() at this level, so
+        # the runner owns the statement ledger — the nested router-merge
+        # statement then contributes to it instead of opening its own
+        from ydb_tpu.utils import memledger
+        led = memledger.open_statement()
         try:
             for stage in graph.stages:
                 if stage.on == "router":
@@ -154,6 +160,11 @@ class DqTaskRunner:
                 self._run_worker_stage(graph, stage)
             raise DqError("stage graph ended without a router stage")
         finally:
+            if led is not None:
+                memledger.close_statement(led)
+                rm = getattr(self.engine, "_record_memory", None)
+                if rm is not None:
+                    rm(f"dq-graph:{graph.tag}", "dq", led)
             self._cleanup(graph)
             ring = getattr(self.engine, "dq_stage_stats", None)
             if ring is not None:
@@ -278,7 +289,8 @@ class DqTaskRunner:
             hint.update(resp.get("dtypes") or {})
         agg = self._ici_stage_stats.setdefault(
             stage.id, {"ici_bytes": 0, "ici_frames": 0,
-                       "quant_bytes_saved": 0})
+                       "quant_bytes_saved": 0,
+                       "pad_live_bytes": 0, "pad_padded_bytes": 0})
         for ch in ici_chs:
             kkind = None
             for resp in by_idx.values():
@@ -297,8 +309,9 @@ class DqTaskRunner:
             if stats["quant_bytes_saved"] > 0:
                 self.counters.inc("dq/quant_bytes_saved",
                                   stats["quant_bytes_saved"])
-            for k in ("ici_bytes", "ici_frames", "quant_bytes_saved"):
-                agg[k] += max(0, stats[k])
+            for k in ("ici_bytes", "ici_frames", "quant_bytes_saved",
+                      "pad_live_bytes", "pad_padded_bytes"):
+                agg[k] += max(0, stats.get(k) or 0)
 
     def _run_stage_attempts(self, graph, stage, specs):
         """The pending → running → finished/failed attempt loop. Every
@@ -444,6 +457,8 @@ class DqTaskRunner:
                "state": state, "attempts": int(attempts),
                "rows": 0, "bytes": 0, "frames": 0,
                "plane": "host", "ici_bytes": 0,
+               "pad_live_bytes": 0, "pad_padded_bytes": 0,
+               "pad_efficiency": 0.0,
                "exec_ms": 0.0, "flush_ms": 0.0,
                "input_wait_ms": 0.0, "backpressure_wait_ms": 0.0}
         row.update(stats)
@@ -464,6 +479,13 @@ class DqTaskRunner:
                   ("host" if stage.outputs else "-"),
             ici_bytes=int(ici["ici_bytes"] // len(self.workers))
             if ici else 0,
+            pad_live_bytes=int(ici["pad_live_bytes"]
+                               // len(self.workers)) if ici else 0,
+            pad_padded_bytes=int(ici["pad_padded_bytes"]
+                                 // len(self.workers)) if ici else 0,
+            pad_efficiency=round(ici["pad_live_bytes"]
+                                 / ici["pad_padded_bytes"], 3)
+            if ici and ici["pad_padded_bytes"] else 0.0,
             exec_ms=float(prof.get("exec_ms", 0.0)),
             flush_ms=float(prof.get("flush_ms", 0.0)),
             input_wait_ms=float(

@@ -80,7 +80,15 @@ def _run_task_body(engine, executor, sql, outputs, src, send, token,
         finally:
             executor.dq_stage_depth -= 1
     exec_ms = (time.perf_counter() - t0) * 1000.0
+    # the stage-chain host round trip — ROADMAP item 1's debt, pinned by
+    # the flight recorder (`hostsync/to_pandas_in_plan`) so "zero
+    # to_pandas inside a plan" becomes a counter gate, not a claim
     df = block.to_pandas()
+    from ydb_tpu.utils import memledger
+    memledger.record_transfer(
+        "dq/task.py::stage_to_pandas",
+        int(df.memory_usage(index=False).sum()),
+        to_pandas_in_plan=True)
     resp = {"ok": True, "rows_in": len(df),
             "dtypes": {c: str(df[c].dtype) for c in df.columns}}
     total_bytes = total_frames = 0

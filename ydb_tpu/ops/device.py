@@ -64,6 +64,11 @@ def to_device(block: HostBlock, capacity: Optional[int] = None,
             dicts[c.name] = cd.dictionary
     length = put(np.int32(block.length)) if device is not None \
         else jnp.int32(block.length)
+    # resource ledger: the upload's padded bytes (capacity bucket) vs the
+    # block's live rows — shape arithmetic only, never a sync
+    from ydb_tpu.utils import memledger
+    memledger.record_padded_buffers("device_block", "upload",
+                                    block.length, cap, arrays, valids)
     return DeviceBlock(block.schema, arrays, valids, length, cap, dicts)
 
 
@@ -71,11 +76,11 @@ def host_column(data, valid, dtype, dictionary) -> ColumnData:
     """Host materialization convention shared by every device→host path
     (`to_host`, the fused unpack): restore the schema dtype, collapse
     all-valid masks to None, reattach the dictionary."""
-    # lint: allow-host-sync(inputs already landed by the caller's batched device_get)
+    # lint: transfer-ok(inputs already landed by the caller's batched device_get)
     d = np.asarray(data).astype(dtype.np)
     v = valid
     if v is not None:
-        # lint: allow-host-sync(inputs already landed by the caller's batched device_get)
+        # lint: transfer-ok(inputs already landed by the caller's batched device_get)
         v = np.asarray(v)
         if v.all():
             v = None
@@ -91,7 +96,12 @@ def to_host(dblock: DeviceBlock) -> HostBlock:
     # tunneled TPU)
     sliced = {name: a[:n] for name, a in dblock.arrays.items()}
     vsliced = {name: v[:n] for name, v in dblock.valids.items()}
+    # lint: transfer-ok(result egress — the one batched client-boundary readback)
     host_a, host_v = jax.device_get((sliced, vsliced))
+    from ydb_tpu.utils import memledger
+    memledger.record_transfer("ops/device.py::to_host",
+                              memledger.deep_nbytes((host_a, host_v)),
+                              boundary=True)
     cols = {}
     for c in dblock.schema:
         cols[c.name] = host_column(host_a[c.name], host_v.get(c.name),

@@ -229,20 +229,33 @@ def fetch_fused_result(data_stacks, valid_stack, length, layout_box: dict,
     link. This is the deferred half of the device-result future: the
     dispatch returns immediately and this runs when the result is
     consumed, so concurrent queries overlap compute with D2H drains."""
+    from ydb_tpu.utils import memledger
     cap_out = (next(iter(data_stacks.values())).shape[1]
                if data_stacks else 0)
+    padded_bytes = memledger.deep_nbytes((data_stacks, valid_stack))
     if cap_out > (1 << 16):
         n = int(length)
         m = max(n, 1)
         data_stacks = {k: v[:, :m] for k, v in data_stacks.items()}
         if valid_stack is not None:
             valid_stack = valid_stack[:, :m]
+        # lint: transfer-ok(result egress — padding sliced off device-side first)
         host_stacks, host_valids = jax.device_get(
             (data_stacks, valid_stack))
     else:
+        # lint: transfer-ok(result egress — the fused path's ONE pytree readback)
         host_stacks, host_valids, n = jax.device_get(
             (data_stacks, valid_stack, length))
         n = int(n)
+    # capacity-sized outputs (group-by buckets, LIMIT buckets): the live
+    # result rows vs the power-of-two output capacity the program wrote
+    if cap_out:
+        memledger.record_pad(
+            "result_capacity", n, cap_out,
+            int(padded_bytes * min(n, cap_out) / cap_out), padded_bytes)
+    memledger.record_transfer(
+        "ops/fused.py::fetch_fused_result",
+        memledger.deep_nbytes((host_stacks, host_valids)), boundary=True)
     return _unpack_fused_host(host_stacks, host_valids, n, layout_box,
                               out_schema, out_dicts)
 
@@ -255,8 +268,13 @@ def fetch_fused_batch(data_stacks, valid_stack, lengths, layout_box: dict,
     its slice host-side. `member_rows[i]` is member i's batch-axis row
     (identical-query dedup maps every member to row 0; padded rows are
     never read). Returns [HostBlock], one per member."""
+    from ydb_tpu.utils import memledger
+    # lint: transfer-ok(result egress — one readback for the whole batch)
     host_stacks, host_valids, ns = jax.device_get(
         (data_stacks, valid_stack, lengths))
+    memledger.record_transfer(
+        "ops/fused.py::fetch_fused_batch",
+        memledger.deep_nbytes((host_stacks, host_valids)), boundary=True)
     out = []
     for b in member_rows:
         hs = {k: v[b] for k, v in host_stacks.items()}

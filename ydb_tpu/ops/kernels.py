@@ -95,7 +95,7 @@ _reg("abs", _rt_same, lambda xp, a, e: xp.abs(a[0]))
 
 
 def _safe_div(xp, a, b):
-    # lint: allow-host-sync(np.asarray only on the xp-is-np host lane — dtype probe)
+    # lint: transfer-ok(np.asarray only on the xp-is-np host lane — dtype probe, never a device value)
     num = a.astype(np.float64) if np.issubdtype(np.asarray(a).dtype if xp is np else a.dtype, np.integer) else a
     den = b.astype(num.dtype) if hasattr(b, "dtype") else b
     zero = den == 0

@@ -126,6 +126,34 @@ def gather_all(stacked_d, stacked_v, cnts, seg, ndev, names, axis=AXIS):
     return compress(env, jnp.int32(flat), seg_mask, flat)
 
 
+# -- padding-waste accounting ----------------------------------------------
+#
+# Every exchange built from these blocks ships FIXED-capacity segments
+# (the arxiv 2112.01075 static-shape stance), so the wire carries
+# padded_rows = ndev² · seg rows regardless of how many are live. The
+# account below is the shared host-side arithmetic the three call sites
+# (dq/ici.py, parallel/shuffle.py, parallel/shuffle_join.py) report into
+# the resource ledger — the measured form of the "~3.5× the live bytes"
+# MULTICHIP_r06 waste ROADMAP item 1 exists to delete.
+
+
+def segment_pad_account(kind: str, ndev: int, seg: int, live_rows: int,
+                        bytes_per_row: float) -> dict:
+    """Ledger + return the live-vs-padded account of one fixed-capacity
+    segment exchange: `ndev²` segments of `seg` rows each on the wire,
+    `live_rows` of them real."""
+    from ydb_tpu.utils import memledger
+    padded_rows = ndev * ndev * seg
+    live_bytes = int(live_rows * bytes_per_row)
+    padded_bytes = int(padded_rows * bytes_per_row)
+    memledger.record_pad(kind, live_rows, padded_rows, live_bytes,
+                         padded_bytes)
+    return {"live_rows": live_rows, "padded_rows": padded_rows,
+            "live_bytes": live_bytes, "padded_bytes": padded_bytes,
+            "efficiency": round(live_bytes / padded_bytes, 3)
+            if padded_bytes else None}
+
+
 # -- EQuARX block quantization (collective payload codec) ------------------
 
 

@@ -257,7 +257,9 @@ class DistributedAgg:
         dev_params = {k: jnp.asarray(v) for k, v in params.items()}
         out_d, out_v, flens, overflow = fn(arrays, valids, lengths,
                                            dev_params)
-        if bool(np.any(np.asarray(overflow))):
+        # ONE batched device_get for the overflow verdict (was a
+        # per-flag np.asarray sync — a baselined host-sync debt)
+        if jax.device_get(overflow).any():
             # overflowed rows were clamped on device, so that result is
             # partial — discard it, rebuild with full-capacity segments
             # (seg = pcap ≥ any per-bucket count: cannot overflow) and rerun
@@ -265,6 +267,13 @@ class DistributedAgg:
             self.seg_rows = 0
             return self.run(blocks_per_device, params)
         self._holder = holder
+        # padding-waste account of the shuffle's fixed-capacity segments
+        from ydb_tpu.parallel.collective import segment_pad_account
+        segment_pad_account(
+            "shuffle_segments", ndev, min(self.seg_rows or cap, cap),
+            int(lengths.sum()),
+            sum(a.dtype.itemsize for a in arrays.values())
+            + len(valids))
         dicts = {}
         for b in blocks_per_device:
             for name, cd in b.columns.items():
@@ -321,8 +330,12 @@ class DistributedAgg:
         out_d, out_v, flens, overflow = fn(arrays, valids, lengths,
                                            dev_params)
         # seg_rows=0 (full capacity) is the only mode used here — overflow
-        # is impossible, but keep the invariant checked
-        assert not bool(np.any(np.asarray(overflow)))
+        # is impossible, but keep the invariant checked (batched
+        # device_get, not a per-flag np.asarray sync)
+        assert not jax.device_get(overflow).any()
+        # NO pad record here: the partials' live row counts are
+        # device-resident scalars, and the ledger must never force a
+        # sync to measure — the host-input `run` path carries the gauge
         dicts = {}
         for blks in per_dev_blocks:
             for b in blks:
@@ -343,6 +356,10 @@ class DistributedAgg:
         host_d, host_v, flens = jax.device_get(
             ({c.name: out_d[c.name] for c in out_cols},
              {c.name: out_v[c.name] for c in out_cols}, flens))
+        from ydb_tpu.utils import memledger
+        memledger.record_transfer(
+            "parallel/shuffle.py::DistributedAgg._finish",
+            memledger.deep_nbytes((host_d, host_v)))
         blocks = []
         for d in range(ndev):
             n = int(flens[d])

@@ -60,21 +60,31 @@ def partition_build(built: HostBlock, key: str, payload: list, ndev: int):
     ns = np.zeros(ndev, np.int32)
     payload_np: dict = {n: None for n in payload}
     pvalid_np: dict = {}
-    for p, t in enumerate(tables):
-        kcap = t.keys_sorted.shape[0]
-        keys[p, :kcap] = np.asarray(t.keys_sorted)
+    # ONE batched device→host landing for every partition's keys/payload
+    # (was 2·cols·ndev per-array np.asarray round trips — a baselined
+    # host-sync debt); a partition already host-side passes through
+    fetched = jax.device_get(
+        [{"keys": t.keys_sorted, "payload": dict(t.payload),
+          "pvalid": dict(t.payload_valid)} for t in tables])
+    for p, (t, host) in enumerate(zip(tables, fetched)):
+        kcap = host["keys"].shape[0]
+        keys[p, :kcap] = host["keys"]
         ns[p] = t.n
         for n in payload:
-            arr = np.asarray(t.payload[n])
+            arr = host["payload"][n]
             if payload_np[n] is None:
                 payload_np[n] = np.zeros((ndev, cap), arr.dtype)
             payload_np[n][p, :len(arr)] = arr
-            pv = t.payload_valid.get(n)
+            pv = host["pvalid"].get(n)
             if pv is not None:
                 pvalid_np.setdefault(
                     n, np.zeros((ndev, cap), np.bool_))
-                pvalid_np[n][p, :len(pv)] = np.asarray(pv)
+                pvalid_np[n][p, :len(pv)] = pv
     dicts = dict(tables[0].dictionaries) if tables else {}
+    from ydb_tpu.utils import memledger
+    memledger.record_padded_buffers(
+        "shuffle_join_build", "build", int(ns.sum()), ndev * cap,
+        keys, payload_np, pvalid_np)
     return ({"keys": keys, "ns": ns, "payload": payload_np,
              "pvalid": pvalid_np},
             tables[0].schema if tables else Schema([]), dicts, cap)

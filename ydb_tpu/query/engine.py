@@ -159,6 +159,12 @@ class QueryEngine:
         # filled by DqTaskRunner when this engine drives a stage graph
         self.dq_stage_stats = _deque(maxlen=int(
             os.environ.get("YDB_TPU_DQ_STATS_RING", "256")))
+        # per-statement resource-ledger rollups, last-N ring
+        # (`.sys/query_memory`): peak device bytes, padding account,
+        # host transfers, admission calibration — one row per closed
+        # ledger (utils/memledger.py; empty under YDB_TPU_MEMLEDGER=0)
+        self.memory_stats = _deque(maxlen=int(
+            os.environ.get("YDB_TPU_MEMORY_RING", "256")))
         # per-statement result metadata is THREAD-LOCAL: concurrent
         # sessions must each see their own stats/trace/rows-affected
         self._tls = threading.local()
@@ -422,12 +428,21 @@ class QueryEngine:
             sampled=self._sample_decision(sql) if outermost else True)
         kind_box: list = []
         ok = False
+        # resource ledger (utils/memledger.py): one per OUTERMOST
+        # statement on this thread — a nested execute (EXPLAIN ANALYZE,
+        # DQ router merge) contributes to the enclosing ledger
+        from ydb_tpu.utils import memledger
+        led = memledger.open_statement()
         try:
             with ctx, self.tracer.span("statement", sql=sql[:60]):
                 block = self._execute_traced(sql, session, kind_box)
             ok = True
             return block
         finally:
+            if led is not None:
+                memledger.close_statement(led)
+                self._record_memory(sql, kind_box[0] if kind_box else "",
+                                    led)
             self.last_trace = self.tracer.end_trace()
             # profiles record USER statements: a DQ stage program run
             # through a legacy (context-free) caller is still internal
@@ -459,6 +474,29 @@ class QueryEngine:
                 self._trace_acc -= 1.0
                 return True
         return False
+
+    def _record_memory(self, sql: str, kind: str, led) -> None:
+        """Append one closed ledger to the `.sys/query_memory` ring.
+        Statements that never touched the device (DDL, constant
+        SELECTs) are skipped — a ring of zero rows would bury the
+        queries this view exists to rank."""
+        s = led.summary()
+        if not (s["peak_bytes"] or s["transfers"] or s["padded_bytes"]):
+            return
+        self.memory_stats.append({
+            "sql": sql, "kind": kind,
+            "peak_bytes": s["peak_bytes"],
+            "alloc_bytes": s["alloc_bytes"],
+            "live_bytes": s["live_bytes"],
+            "padded_bytes": s["padded_bytes"],
+            "waste_bytes": s["waste_bytes"],
+            "pad_efficiency": s["pad_efficiency"],
+            "transfers": s["transfers"],
+            "transfer_bytes": s["transfer_bytes"],
+            "to_pandas_in_plan": s["to_pandas_in_plan"],
+            "admission_est_bytes": s["admission_est_bytes"],
+            "est_error_pct": s["est_error_pct"],
+        })
 
     def _record_profile(self, sql: str, spans: list,
                         stage_stats: list = None, total_ms: float = None,
@@ -698,6 +736,10 @@ class QueryEngine:
         # floor: even column-less scans (count(*)) reserve a
         # nominal slot so admission can actually bound concurrency
         est = max(estimate_plan_bytes(self.catalog, plan, snap), 1 << 20)
+        # admission calibration: the ledger compares this estimate to
+        # the measured peak at close (`admission/est_error_pct`)
+        from ydb_tpu.utils import memledger
+        memledger.note_admission(est)
         try:
             block = None
             if self._batch_lane is not None:
@@ -865,6 +907,13 @@ class QueryEngine:
         if self.tracer.sampled:
             stats.phases = phase_breakdown(
                 self.tracer.spans[getattr(stats, "_span_mark", 0):])
+        # resource-ledger rollup as of NOW (the ledger closes in
+        # execute() after this statement returns; EXPLAIN ANALYZE and
+        # bench read stats.memory, so the live summary attaches here)
+        from ydb_tpu.utils import memledger
+        led = memledger.current()
+        if led is not None:
+            stats.memory = led.summary()
         # latency histograms count USER statements once: a nested
         # internal statement (EXPLAIN ANALYZE's re-entrant execute, the
         # DQ router-merge SELECT — its trace depth is >1) must not add a

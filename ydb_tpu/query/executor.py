@@ -308,6 +308,14 @@ class Executor:
         arrays, valids, lengths, K, CAP, sb_dicts = sb
         sb_valid_names = frozenset(valids.keys())
         dicts.update(sb_dicts)
+        # resource ledger: the scan's device working set is the stacked
+        # (K, CAP) superblock; live rows come from the host-side source
+        # blocks (no device sync)
+        from ydb_tpu.utils import memledger
+        memledger.record_padded_buffers(
+            "superblock", "superblock",
+            int(sum(b.length for b in sources)) if sources else 0,
+            K * CAP, arrays, valids)
 
         sort_params, sort_spec, rank_assigns = self._sort_setup_fused(
             plan, schema, dicts)
@@ -351,6 +359,10 @@ class Executor:
                 # the delta IS this program's trace+compile cost
                 dsp.attrs["compile_ms"] = round(
                     (_time.perf_counter() - t_disp) * 1000.0, 3)
+        # result buffers live in HBM until the future drains them
+        memledger.record_alloc(
+            "result_buffers",
+            memledger.deep_nbytes((data_stacks, valid_stack)))
 
         # readout deferred into the result future: the dispatch above is
         # async, and `fetch_fused_result` performs the ONE device→host
@@ -548,6 +560,10 @@ class Executor:
         arrays, valids, lengths, K, CAP, sb_dicts = sb
         sb_valid_names = frozenset(valids.keys())
         dicts.update(sb_dicts)
+        from ydb_tpu.utils import memledger
+        memledger.record_padded_buffers(
+            "superblock", "superblock",
+            int(sum(b.length for b in sources)), K * CAP, arrays, valids)
 
         sort_params, sort_spec, rank_assigns = self._sort_setup_fused(
             plan, schema, dicts)
@@ -647,6 +663,13 @@ class Executor:
             # cache only after the first successful dispatch, so a
             # trace-failing shape never parks a dead entry in the budget
             self._fused_cache[key] = (fn, layout_box, out_schema)
+        # batch-lane padding: the power-of-two axis bucket materializes
+        # Bb member slots of every stacked output for B live members
+        # (same-text dedup maps all members to one row — min() keeps the
+        # live share honest there)
+        memledger.record_padded_buffers(
+            "batch_lane", "result_buffers", min(B, Bb), Bb,
+            (data_stacks, valid_stack))
 
         out_dicts = {n2: d for n2, d in dicts.items() if out_schema.has(n2)}
         out_dicts.update({n2: d for n2, d in plan.result_dicts.items()

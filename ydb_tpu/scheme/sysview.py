@@ -20,7 +20,7 @@ PREFIX = ".sys/"
 
 VIEWS = ("tables", "partition_stats", "counters", "query_metrics",
          "top_queries_by_duration", "dq_stage_stats", "query_profiles",
-         "cluster_nodes")
+         "cluster_nodes", "query_memory", "device_transfers")
 
 
 def is_sysview(name: str) -> bool:
@@ -95,6 +95,9 @@ def sysview_block(engine, name: str) -> HostBlock:
             "frames": int(r.get("frames", 0)),
             "plane": str(r.get("plane", "host")),
             "ici_bytes": int(r.get("ici_bytes", 0)),
+            "pad_live_bytes": int(r.get("pad_live_bytes", 0)),
+            "pad_padded_bytes": int(r.get("pad_padded_bytes", 0)),
+            "pad_efficiency": float(r.get("pad_efficiency", 0.0) or 0.0),
             "exec_ms": float(r.get("exec_ms", 0.0)),
             "flush_ms": float(r.get("flush_ms", 0.0)),
             "input_wait_ms": float(r.get("input_wait_ms", 0.0)),
@@ -107,6 +110,9 @@ def sysview_block(engine, name: str) -> HostBlock:
                              ("rows", "int64"), ("bytes", "int64"),
                              ("frames", "int64"), ("plane", str),
                              ("ici_bytes", "int64"),
+                             ("pad_live_bytes", "int64"),
+                             ("pad_padded_bytes", "int64"),
+                             ("pad_efficiency", "float64"),
                              ("exec_ms", "float64"),
                              ("flush_ms", "float64"),
                              ("input_wait_ms", "float64"),
@@ -169,6 +175,52 @@ def sysview_block(engine, name: str) -> HostBlock:
                              ("capacity", "float64"),
                              ("load", "float64"), ("shards", str),
                              ("stale", "bool")])
+    if view == "query_memory":
+        # per-statement resource-ledger rollups (engine.memory_stats,
+        # filled when a statement's ledger closes — utils/memledger.py):
+        # the bytes companion of `query_metrics`
+        rows = [{
+            "sql": r.get("sql", ""), "kind": r.get("kind", ""),
+            "peak_bytes": int(r.get("peak_bytes", 0)),
+            "alloc_bytes": int(r.get("alloc_bytes", 0)),
+            "live_bytes": int(r.get("live_bytes", 0)),
+            "padded_bytes": int(r.get("padded_bytes", 0)),
+            "waste_bytes": int(r.get("waste_bytes", 0)),
+            "pad_efficiency": float(r.get("pad_efficiency") or 0.0),
+            "transfers": int(r.get("transfers", 0)),
+            "transfer_bytes": int(r.get("transfer_bytes", 0)),
+            "to_pandas_in_plan": int(r.get("to_pandas_in_plan", 0)),
+            "admission_est_bytes":
+                int(r.get("admission_est_bytes") or 0),
+            "est_error_pct": float(r.get("est_error_pct") or 0.0),
+        } for r in list(getattr(engine, "memory_stats", []))]
+        return _block(rows, [("sql", str), ("kind", str),
+                             ("peak_bytes", "int64"),
+                             ("alloc_bytes", "int64"),
+                             ("live_bytes", "int64"),
+                             ("padded_bytes", "int64"),
+                             ("waste_bytes", "int64"),
+                             ("pad_efficiency", "float64"),
+                             ("transfers", "int64"),
+                             ("transfer_bytes", "int64"),
+                             ("to_pandas_in_plan", "int64"),
+                             ("admission_est_bytes", "int64"),
+                             ("est_error_pct", "float64")])
+    if view == "device_transfers":
+        # the host-transfer flight recorder's recent-transfer ring
+        # (utils/memledger.py, process-wide): one row per recorded
+        # device→host readback, newest last
+        from ydb_tpu.utils.memledger import transfer_ring
+        rows = [{
+            "seq": int(r["seq"]), "site": r["site"],
+            "bytes": int(r["bytes"]), "count": int(r["count"]),
+            "boundary": bool(r["boundary"]),
+            "to_pandas_in_plan": bool(r["to_pandas_in_plan"]),
+        } for r in transfer_ring()]
+        return _block(rows, [("seq", "int64"), ("site", str),
+                             ("bytes", "int64"), ("count", "int64"),
+                             ("boundary", "bool"),
+                             ("to_pandas_in_plan", "bool")])
     raise KeyError(f"unknown system view {name!r} "
                    f"(have: {', '.join(PREFIX + v for v in VIEWS)})")
 

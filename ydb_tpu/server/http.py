@@ -8,6 +8,9 @@ same engine over plain HTTP so curl-class clients need no gRPC stack:
                    → {"columns": [...], "rows": [[...]], "stats": {...}}
   GET  /health     → the same payload as the gRPC Health RPC
   GET  /counters   → {"counters": {...}} (monitoring scrape endpoint)
+  GET  /metrics    → OpenMetrics text exposition (Prometheus scrape):
+                   every counter with its COUNTER_REGISTRY # HELP doc,
+                   histograms as cumulative buckets
   GET  /ready      → 200 "ok" (liveness probe)
 
 Bearer auth mirrors the gRPC front: `Authorization: Bearer <token>`
@@ -54,6 +57,25 @@ class HttpFront:
                     resp = servicer.counters({"token": self._token()},
                                              None)
                     self._send(401 if "error" in resp else 200, resp)
+                elif self.path == "/metrics":
+                    # OpenMetrics exposition — same auth as /counters
+                    # (Prometheus sends the token via bearer_token config)
+                    resp = servicer.counters({"token": self._token()},
+                                             None)
+                    if "error" in resp:
+                        self._send(401, resp)
+                        return
+                    from ydb_tpu.utils.metrics import render_openmetrics
+                    body = render_openmetrics(
+                        resp.get("counters", {})).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "application/openmetrics-text; version=1.0.0; "
+                        "charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 

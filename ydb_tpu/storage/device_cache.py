@@ -147,6 +147,12 @@ class DeviceColumnCache:
         key = (portion.id, col, None if device is None else device.id)
         hit = self._lookup(key)
         if hit is not None:
+            # the query's working set includes cache-resident columns —
+            # the ledger accounts residency per query, not per upload
+            from ydb_tpu.utils import memledger
+            memledger.record_padded_buffers(
+                "portion_column", "scan_columns", portion.num_rows,
+                hit[0].shape[0], hit[0], hit[1])
             return hit[0], hit[1]
         put = (lambda x: jax.device_put(x, device)) if device is not None \
             else jnp.asarray
@@ -159,6 +165,10 @@ class DeviceColumnCache:
         if cd.valid is not None:
             valid = put(np.pad(cd.valid, (0, pad)) if pad else cd.valid)
             nbytes += valid.nbytes
+        from ydb_tpu.utils import memledger
+        memledger.record_padded_buffers(
+            "portion_column", "scan_columns", portion.num_rows, cap,
+            data, valid)
         return self._insert(key, data, valid, nbytes)
 
     def superblock(self, table, storage_names: list, rename: dict,

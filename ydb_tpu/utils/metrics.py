@@ -123,6 +123,18 @@ class Histogram:
                 "p99": round(self.quantile(0.99), 3),
                 "max": round(self.max, 3)}
 
+    def cumulative(self) -> list:
+        """[(upper_bound, cumulative_count)] in bucket order, ending
+        with (inf, count) — the OpenMetrics histogram `_bucket{le=}`
+        series (cumulative by spec; the overflow bucket maps to
+        le=\"+Inf\")."""
+        out, acc = [], 0
+        for i in range(self.N_BUCKETS):
+            acc += self.counts[i]
+            out.append((self.BASE * self.GROWTH ** i, acc))
+        out.append((math.inf, self.count))
+        return out
+
 
 class HistogramRegistry:
     """Named histograms with the Counters locking discipline; surfaced
@@ -154,6 +166,17 @@ class HistogramRegistry:
             for name, h in self._h.items():
                 for k, v in h.snapshot().items():
                     out[f"hist/{name}/{k}"] = v
+        return out
+
+    def families(self) -> dict:
+        """Consistent per-histogram export payload (taken under the
+        lock, same torn-view discipline as snapshot()):
+        name -> {"buckets": [(le, cum)], "sum", "count"}."""
+        out = {}
+        with self._mu:
+            for name, h in self._h.items():
+                out[name] = {"buckets": h.cumulative(),
+                             "sum": h.sum, "count": h.count}
         return out
 
 
@@ -230,6 +253,28 @@ COUNTER_REGISTRY = {
     "admission/waits": "admissions that had to queue",
     "admission/timeouts": "admissions that hit the deadline",
     "admission/wait_ms": "[hist] admission queue wait",
+    "admission/calibrated":
+        "[viz] queries with both an estimate and a measured peak",
+    "admission/est_error_pct":
+        "[hist] admission estimate vs measured peak (|est-peak|/peak %)",
+    # -- resource ledger (utils/memledger.py): per-query device bytes ------
+    "mem/ledgers": "[viz] statements that closed a resource ledger",
+    "mem/alloc_bytes": "[viz] ledger: device bytes allocated (cumulative)",
+    "mem/freed_bytes": "[viz] ledger: device bytes released (cumulative)",
+    "mem/peak_bytes":
+        "[viz] high-watermark of any single query's device working set",
+    "mem/peak_mb": "[hist] per-query peak device working set (MB)",
+    # -- padding-waste accounting (live vs padded structure bytes) ---------
+    "pad/live_bytes": "[viz] live-row bytes through padded structures",
+    "pad/padded_bytes": "[viz] allocated/shipped bytes of those structures",
+    "pad/waste_bytes": "[viz] padded minus live — the padding tax",
+    # -- host-transfer flight recorder (device→host readbacks) -------------
+    "hostsync/transfers": "[viz] device→host transfers (flight recorder)",
+    "hostsync/bytes": "[viz] bytes those transfers moved",
+    "hostsync/boundary_transfers":
+        "[viz] the transfer-ok-excused boundary subset (client egress)",
+    "hostsync/to_pandas_in_plan":
+        "[viz] to_pandas materializations INSIDE a multi-stage plan",
     # -- DQ task-graph runtime ---------------------------------------------
     "dq/stages": "stages executed (runner)",
     "dq/tasks": "tasks launched (runner + worker)",
@@ -346,6 +391,10 @@ class QueryStats:
     # device_ms, readout_ms, compile_ms} — empty when the statement was
     # unsampled or never touched the device
     phases: dict = field(default_factory=dict)
+    # resource-ledger rollup (`utils/memledger.MemLedger.summary`):
+    # peak/alloc device bytes, padding live-vs-padded account, host
+    # transfers, admission calibration — empty when YDB_TPU_MEMLEDGER=0
+    memory: dict = field(default_factory=dict)
 
     def render(self) -> str:
         path = ("mesh-distributed" if self.distributed
@@ -378,6 +427,27 @@ class QueryStats:
                 for k in ("compile_ms", "build_ms", "upload_ms",
                           "dispatch_ms", "device_ms", "readout_ms")
                 if k in p))
+        if self.memory and (self.memory.get("peak_bytes")
+                            or self.memory.get("transfers")):
+            m = self.memory
+            mb = 1 << 20
+            line = f"\n-- memory: peak {m.get('peak_bytes', 0) / mb:.2f}MB"
+            if m.get("admission_est_bytes") is not None:
+                line += (f" (admitted {m['admission_est_bytes'] / mb:.2f}"
+                         f"MB")
+                if m.get("est_error_pct") is not None:
+                    line += f", err {m['est_error_pct']:.0f}%"
+                line += ")"
+            if m.get("pad_efficiency") is not None:
+                line += (f" | pad eff {m['pad_efficiency']:.2f} "
+                         f"(live {m.get('live_bytes', 0) / mb:.2f}MB / "
+                         f"padded {m.get('padded_bytes', 0) / mb:.2f}MB)")
+            line += (f" | host transfers {m.get('transfers', 0)} "
+                     f"({m.get('transfer_bytes', 0) / mb:.2f}MB")
+            if m.get("to_pandas_in_plan"):
+                line += f", {m['to_pandas_in_plan']} to_pandas-in-plan"
+            line += ")"
+            out += line
         return out
 
 
@@ -393,3 +463,81 @@ class Timer:
         out = (now - self.t0) * 1000.0
         self.t0 = now
         return out
+
+
+# --------------------------------------------------------------------------
+# OpenMetrics text exposition (the server's GET /metrics payload) — the
+# registry finally pays rent outside lint: every # HELP line is the
+# COUNTER_REGISTRY doc, histograms export as cumulative buckets per the
+# OpenMetrics spec, and any Prometheus can scrape the process.
+# --------------------------------------------------------------------------
+
+_OM_SANITIZE = None     # compiled lazily (re import stays off the hot path)
+
+
+def _om_name(name: str) -> str:
+    """Counter name → OpenMetrics metric name: `mem/peak_bytes` →
+    `ydbtpu_mem_peak_bytes` (slashes/dashes are label-illegal)."""
+    global _OM_SANITIZE
+    if _OM_SANITIZE is None:
+        import re
+        _OM_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+    return "ydbtpu_" + _OM_SANITIZE.sub("_", name)
+
+
+def _om_help(name: str) -> Optional[str]:
+    """Registry doc for a counter (exact entry, or its wildcard
+    family), with the [viz]/[hist] tooling marks stripped."""
+    doc = COUNTER_REGISTRY.get(name)
+    if doc is None:
+        for entry, d in COUNTER_REGISTRY.items():
+            if entry.endswith("/*") and name.startswith(entry[:-1]):
+                doc = f"{d} ({entry})"
+                break
+    if doc is None:
+        return None
+    for mark in ("[viz] ", "[hist] "):
+        if doc.startswith(mark):
+            doc = doc[len(mark):]
+    return doc.replace("\\", "\\\\").replace("\n", " ")
+
+
+def _om_value(v) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def render_openmetrics(counters: dict, hist_registry=None) -> str:
+    """OpenMetrics 1.0 text exposition of a counter snapshot plus the
+    process histograms. `counters`: the /counters payload (flattened
+    `hist/<name>/<q>` quantile keys are skipped — histograms export
+    properly as cumulative buckets from `hist_registry` instead).
+    Scalar counters export as gauges (several are gauges or
+    high-watermarks; OpenMetrics counters would forbid decreases)."""
+    hist_registry = hist_registry if hist_registry is not None \
+        else GLOBAL_HIST
+    lines: list = []
+    for name in sorted(counters):
+        if name.startswith("hist/"):
+            continue
+        om = _om_name(name)
+        doc = _om_help(name)
+        lines.append(f"# TYPE {om} gauge")
+        if doc:
+            lines.append(f"# HELP {om} {doc}")
+        lines.append(f"{om} {_om_value(counters[name])}")
+    for name, fam in sorted(hist_registry.families().items()):
+        om = _om_name(name)
+        doc = _om_help(name)
+        lines.append(f"# TYPE {om} histogram")
+        if doc:
+            lines.append(f"# HELP {om} {doc}")
+        for (le, cum) in fam["buckets"]:
+            le_s = "+Inf" if math.isinf(le) else repr(round(le, 6))
+            lines.append(f'{om}_bucket{{le="{le_s}"}} {int(cum)}')
+        lines.append(f"{om}_sum {_om_value(fam['sum'])}")
+        lines.append(f"{om}_count {int(fam['count'])}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"

@@ -237,6 +237,33 @@ def child_main(sf: float, progress_path: str, skip: list,
                     mem.get("transfer_bytes", 0))
                 if mem.get("est_error_pct") is not None:
                     rec["admission_est_error_pct"] = mem["est_error_pct"]
+            # critical-path stamp (utils/critpath.py): the blocking-
+            # chain class shares of the steady-state run — the raw rows
+            # of the artifact's ranked `speed_gap` section
+            cp = dict(getattr(eng.last_stats, "critical_path", {}) or {})
+            if cp.get("classes"):
+                rec["critical_path"] = {
+                    "classes": cp["classes"], "pct": cp.get("pct", {}),
+                    "wall_ms": cp.get("wall_ms", 0.0),
+                    "coverage": cp.get("coverage", 0.0),
+                    "non_device_ms": cp.get("non_device_ms", 0.0),
+                    "dominant_span": cp.get("dominant_span", ""),
+                    "dominant_class": cp.get("dominant_class", ""),
+                }
+            # per-query Perfetto timeline (`bench.py --trace-dir DIR`):
+            # one Chrome trace-event file per profiled query
+            tdir = os.environ.get("BENCH_TRACE_DIR")
+            if tdir and getattr(eng, "profiles", None):
+                try:
+                    from ydb_tpu.utils import chrometrace
+                    os.makedirs(tdir, exist_ok=True)
+                    with open(os.path.join(
+                            tdir, f"{name}.trace.json"), "w") as tf:
+                        json.dump(chrometrace.render(eng.profiles[-1]),
+                                  tf)
+                    rec["trace_file"] = f"{name}.trace.json"
+                except Exception as te:      # noqa: BLE001 — export
+                    rec["trace_error"] = f"{type(te).__name__}: {te}"
             if gated(name):
                 d = oracle_data()    # lazy gen OUTSIDE the timed window
                 t0 = time.perf_counter()
@@ -598,7 +625,31 @@ def run_suite(sf: float, suite_deadline: float,
                                   "admission_est_error_pct") if k in r}
             for q, r in results.items()
             if r.get("peak_device_bytes") is not None},
+        # the SPEED-GAP LEDGER (round-14): every query ranked by the
+        # critical-path milliseconds NOT spent executing on device,
+        # dominant span named — the machine-generated worklist for
+        # ROADMAP items 1–2 (where the 10× actually lives)
+        "speed_gap": _speed_gap(results),
     }
+
+
+def _speed_gap(results: dict) -> list:
+    """Rank queries by non-device critical-path ms (descending), each
+    with its dominant blocking span and per-class share of wall."""
+    rows = []
+    for q, r in results.items():
+        cp = r.get("critical_path")
+        if not cp:
+            continue
+        rows.append({
+            "query": q,
+            "non_device_ms": round(cp.get("non_device_ms", 0.0), 1),
+            "wall_ms": round(cp.get("wall_ms", 0.0), 1),
+            "dominant_span": cp.get("dominant_span", ""),
+            "dominant_class": cp.get("dominant_class", ""),
+            "class_pct": {k: v for k, v in (cp.get("pct") or {}).items()},
+        })
+    return sorted(rows, key=lambda r: -r["non_device_ms"])
 
 
 def _phase_geomean(phase_dicts: list) -> dict:
@@ -1116,6 +1167,16 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    # --trace-dir DIR (composable with every mode): write one Chrome
+    # trace-event JSON per profiled query into DIR — rides the
+    # environment into suite children
+    if "--trace-dir" in sys.argv:
+        _i = sys.argv.index("--trace-dir")
+        if _i + 1 >= len(sys.argv):
+            print("--trace-dir needs a directory", file=sys.stderr)
+            sys.exit(2)
+        os.environ["BENCH_TRACE_DIR"] = sys.argv[_i + 1]
+        del sys.argv[_i:_i + 2]
     if len(sys.argv) > 1 and sys.argv[1] == "--probe":
         probe_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "--concurrency":

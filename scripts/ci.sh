@@ -93,6 +93,21 @@ if [ "$trc" -ne 0 ]; then
     exit "$trc"
 fi
 
+echo "== critical-path gate (connected >=90% coverage, Perfetto export, clock rebase) =="
+# the critical-path/timeline floor: a 2-worker DQ join must extract a
+# CONNECTED critical path covering >=90% of the graph wall with every
+# segment class-labeled, distributed EXPLAIN ANALYZE must print the
+# per-class percentages, the Chrome trace-event export must validate
+# structurally (matched flows, monotone non-negative rebased
+# timestamps, >=1 channel flow arrow) and serve identically over
+# GET /trace/<id>, and a +5s worker clock skew must rebase away
+JAX_PLATFORMS=cpu python scripts/critpath_gate.py
+cprc=$?
+if [ "$cprc" -ne 0 ]; then
+    echo "critical-path gate FAILED (rc=$cprc)" >&2
+    exit "$cprc"
+fi
+
 echo "== DQ ICI-plane gate (4-device mesh: plane selection, byte-equal, bytes moved) =="
 # the pluggable channel-plane floor: on a virtual 4-device mesh a
 # sharded×sharded join must lower its shuffle edges to plane=ici,

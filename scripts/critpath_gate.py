@@ -1,0 +1,174 @@
+"""CI gate for the critical-path / timeline-export subsystem.
+
+Three deterministic legs over an in-process 2-worker DQ cluster (the
+same task/channel code the gRPC cluster runs, via
+`dq/runner.LocalWorker`):
+
+  1. CRITICAL PATH: a sharded×sharded shuffle join with forced tracing
+     must yield a CONNECTED critical path covering >=90% of the
+     measured graph wall, every segment labeled with one of
+     `critpath.CLASSES`, and the distributed EXPLAIN ANALYZE must print
+     the `-- critical path:` per-class percentage lines.
+  2. PERFETTO EXPORT: the same profile rendered as Chrome trace-event
+     JSON must validate structurally (`chrometrace.validate`: complete
+     X events, matched flow pairs, monotone non-negative rebased
+     timestamps) and carry at least one channel-edge flow arrow; the
+     HTTP front must serve the identical payload at `/trace/<id>`.
+  3. CLOCK ALIGNMENT: with one worker's tracer clock skewed +5 s, the
+     assembled tree must still place every worker task-exec span inside
+     its dq-task attempt span (the rebase is measured, not assumed),
+     with the offset stamped on the trace.
+
+Prints one JSON line; exit 0 = green.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def mk_cluster(skew_ms: float = 0.0):
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.dq.runner import LocalWorker
+    from ydb_tpu.query import QueryEngine
+
+    engines = []
+    for wid in range(2):
+        e = QueryEngine(block_rows=1 << 13)
+        e.execute("create table t (id Int64 not null, k Int64 not null, "
+                  "v Double not null, primary key (id))")
+        mine = [i for i in range(200) if i % 2 == wid]
+        e.execute("insert into t (id, k, v) values " + ", ".join(
+            f"({i}, {i % 7}, {i}.5)" for i in mine))
+        e.execute("create table u (uid Int64 not null, w Double not null, "
+                  "primary key (uid))")
+        mine_u = [i for i in range(7) if i % 2 == wid]
+        if mine_u:
+            e.execute("insert into u (uid, w) values " + ", ".join(
+                f"({i}, {i}.0)" for i in mine_u))
+        engines.append(e)
+    if skew_ms:
+        # the worker's `_now` hook: shift one worker's tracer clock so
+        # its span timestamps are wildly ahead of the router's
+        t1 = engines[1].tracer
+        real = t1._now                   # bound method
+        t1._now = lambda: real() + skew_ms
+    workers = [LocalWorker(engines[0], name="w0"),
+               LocalWorker(engines[1], name="w1")]
+    c = ShardedCluster(workers, merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    return c, engines
+
+
+SQL = ("select count(*) as n, sum(w) as s from t, u where k = uid")
+
+
+def leg_critpath() -> dict:
+    from ydb_tpu.utils import critpath
+    c, engines = mk_cluster()
+    got = c.query(SQL)
+    eng = engines[0]
+    prof = eng.profiles[-1] if eng.profiles else {}
+    cp = prof.get("critical_path") or {}
+    segs = cp.get("segments") or []
+    explain = c.query(f"explain analyze {SQL}")
+    text = "\n".join(explain["plan"].tolist())
+    ring = eng.query("select count(*) as n from "
+                     "`.sys/query_critical_path`")
+    return {
+        "result_ok": int(got.n[0]) > 0,
+        "path_extracted": bool(segs),
+        "connected": bool(cp.get("connected")),
+        "coverage_ge_90": float(cp.get("coverage", 0.0)) >= 0.90,
+        "all_segments_classed": bool(segs) and all(
+            s.get("class") in critpath.CLASSES for s in segs),
+        "explain_has_critpath_pct": "-- critical path:" in text
+        and "%" in text,
+        "sysview_rows": int(ring.n[0]) > 0,
+        "counters_nonzero":
+            eng.counters().get("crit/extractions", 0) > 0,
+    }
+
+
+def leg_perfetto() -> dict:
+    import urllib.request
+
+    from ydb_tpu.server.http import serve_http
+    from ydb_tpu.utils import chrometrace
+    c, engines = mk_cluster()
+    c.query(SQL)
+    eng = engines[0]
+    prof = eng.profiles[-1]
+    trace = chrometrace.render(prof)
+    errs = chrometrace.validate(trace)
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    front = serve_http(eng)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{front.port}/trace/"
+                f"{prof['trace_id']}", timeout=10) as r:
+            served = json.loads(r.read())
+    finally:
+        front.stop()
+    return {
+        "validates": not errs,
+        "errors": errs[:5],
+        "x_events": len(xs) > 0,
+        "ts_non_negative": all(e["ts"] >= 0 for e in xs),
+        "flow_arrow_present": chrometrace.flow_pairs(trace) >= 1,
+        "http_serves_same": served.get("traceEvents") is not None
+        and len(served["traceEvents"]) == len(trace["traceEvents"]),
+    }
+
+
+def leg_clock_skew() -> dict:
+    c, engines = mk_cluster(skew_ms=5000.0)
+    c.query(SQL)
+    eng = engines[0]
+    spans = eng.last_trace
+    by_id = {s.span_id: s for s in spans}
+    checked = 0
+    inside = 0
+    offset_stamped = False
+    for s in spans:
+        if s.name == "dq-task" and s.attrs.get("clock_offset_ms") \
+                is not None:
+            offset_stamped = True
+        if s.name != "task-exec":
+            continue
+        task = by_id.get(s.parent_id)
+        if task is None or task.name != "dq-task":
+            continue
+        checked += 1
+        # rebased: the worker span must sit inside its attempt span
+        # (a 5 s skew left raw would push it far outside)
+        if task.start_ms - 150.0 <= s.start_ms \
+                and s.start_ms + s.dur_ms <= task.start_ms \
+                + task.dur_ms + 150.0:
+            inside += 1
+    return {
+        "task_exec_spans": checked >= 2,
+        "all_rebased_inside_attempt": checked > 0 and inside == checked,
+        "offset_stamped": offset_stamped,
+    }
+
+
+def main() -> int:
+    crit = leg_critpath()
+    perfetto = leg_perfetto()
+    skew = leg_clock_skew()
+    ok = (all(v for k, v in crit.items())
+          and all(v for k, v in perfetto.items() if k != "errors")
+          and all(v for k, v in skew.items()))
+    print(json.dumps({"metric": "critpath_gate", "ok": ok,
+                      "critpath": crit, "perfetto": perfetto,
+                      "clock_skew": skew}), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

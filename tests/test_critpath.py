@@ -1,0 +1,392 @@
+"""Critical-path extraction, clock-aligned cross-worker timelines, and
+the Perfetto/Chrome trace export.
+
+The blocking-chain math is pinned on hand-built span DAGs (diamond,
+hidden channel wait, compile→execute, retry); the integration legs run
+real queries — local fused and 2-worker DQ — and check the surfaced
+forms: `QueryStats.critical_path`, EXPLAIN ANALYZE `-- critical path:`
+lines, `.sys/query_critical_path`, `crit/*` counters, `GET /trace/<id>`
+and the `YDB_TPU_CRITPATH=0` off-lever (byte-equal, counters frozen).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from ydb_tpu.query import QueryEngine
+from ydb_tpu.utils import chrometrace, critpath
+from ydb_tpu.utils.metrics import GLOBAL
+from ydb_tpu.utils.tracing import Span
+
+
+def sp(name, sid, parent, start, dur, **attrs):
+    return Span(name, 1, sid, parent, float(start), float(dur),
+                attrs=dict(attrs))
+
+
+# -- hand-built DAG math ----------------------------------------------------
+
+
+def test_diamond_takes_the_longer_parallel_branch():
+    spans = [
+        sp("dq-query", 1, None, 0, 100),
+        sp("task-exec", 2, 1, 0, 40),        # short branch — NOT on path
+        sp("task-exec", 3, 1, 0, 70),        # long branch — on path
+        sp("device-execute", 4, 1, 70, 30),  # tail
+    ]
+    cp = critpath.extract(spans)
+    names = [s["span_id"] for s in cp["segments"]]
+    assert 3 in names and 4 in names and 2 not in names
+    assert cp["connected"]
+    assert cp["coverage"] == pytest.approx(1.0, abs=0.01)
+    assert cp["classes"]["host_lane"] == pytest.approx(70, abs=0.1)
+    assert cp["classes"]["device_execute"] == pytest.approx(30, abs=0.1)
+
+
+def test_fully_hidden_channel_wait_stays_off_the_path():
+    spans = [
+        sp("execute", 1, None, 0, 100),
+        sp("device-execute", 2, 1, 0, 100),
+        sp("input-wait", 3, 1, 20, 30),      # entirely under the execute
+    ]
+    cp = critpath.extract(spans)
+    assert "channel_wait" not in cp["classes"]
+    assert cp["classes"]["device_execute"] == pytest.approx(100, abs=0.1)
+
+
+def test_serial_compile_then_execute_chain_splits_classes():
+    spans = [
+        sp("statement", 1, None, 0, 90),
+        sp("device-dispatch", 2, 1, 0, 50, compile_ms=40.0),
+        sp("device-execute", 3, 1, 50, 40),
+    ]
+    cp = critpath.extract(spans)
+    assert cp["classes"]["compile"] == pytest.approx(40, abs=0.1)
+    # 10ms dispatch tail + the 40ms execute
+    assert cp["classes"]["device_execute"] == pytest.approx(50, abs=0.1)
+    assert cp["connected"]
+
+
+def test_zero_and_single_span_queries():
+    empty = critpath.extract([])
+    assert empty["segments"] == [] and empty["wall_ms"] == 0.0
+    one = critpath.extract([sp("device-execute", 1, None, 5, 10)])
+    assert len(one["segments"]) == 1
+    assert one["classes"] == {"device_execute": 10.0}
+    assert one["coverage"] == pytest.approx(1.0)
+    assert one["dominant_class"] == "device_execute"
+
+
+def test_failed_attempt_does_not_extend_the_path():
+    spans = [
+        sp("dq-stage", 1, None, 0, 100),
+        sp("dq-task", 2, 1, 0, 40, state="failed", attempt=1),
+        sp("task-exec", 3, 2, 5, 30),            # child of the failure
+        sp("dq-task", 4, 1, 45, 50, state="finished", attempt=2),
+        sp("task-exec", 5, 4, 47, 45),
+    ]
+    cp = critpath.extract(spans)
+    ids = {s["span_id"] for s in cp["segments"]}
+    assert 2 not in ids and 3 not in ids
+    assert 5 in ids
+    # the pre-retry window is honest scheduler gap, not failed work
+    assert cp["classes"]["scheduler_gap"] > 0
+
+
+def test_zero_duration_mid_window_span_terminates():
+    """Regression: a 0-duration child strictly inside its parent's
+    window (rounded-away sub-µs work, a 0ms input-wait on a full
+    channel) must not be selectable as the blocking child — choosing it
+    left the walk's cursor unchanged and spun extract() forever."""
+    spans = [
+        sp("statement", 1, None, 0, 10),
+        sp("input-wait", 2, 1, 5, 0),            # zero duration, mid-window
+        sp("plan", 3, 1, 9.9995, 0.0004),        # sub-EPS sliver at t
+    ]
+    cp = critpath.extract(spans)                 # must return, not hang
+    assert cp["classes"]["host_lane"] == pytest.approx(10, abs=0.1)
+    assert 2 not in {s["span_id"] for s in cp["segments"]}
+    assert cp["connected"]
+
+
+def test_forest_without_root_gets_virtual_root_and_gap():
+    spans = [
+        sp("parse", 1, None, 0, 10),
+        sp("plan", 2, None, 20, 10),             # 10ms gap before it
+    ]
+    cp = critpath.extract(spans)
+    assert cp["wall_ms"] == pytest.approx(30)
+    assert cp["classes"]["host_lane"] == pytest.approx(20, abs=0.1)
+    assert cp["classes"]["scheduler_gap"] == pytest.approx(10, abs=0.1)
+    assert cp["connected"]
+
+
+def test_memory_join_rides_along():
+    cp = critpath.extract(
+        [sp("device-execute", 1, None, 0, 10)],
+        memory={"transfer_bytes": 1234, "transfers": 3,
+                "waste_bytes": 999, "pad_efficiency": 0.5,
+                "to_pandas_in_plan": 1})
+    assert cp["memory"]["transfer_bytes"] == 1234
+    assert cp["memory"]["pad_efficiency"] == 0.5
+    assert any("host transfers" in ln
+               for ln in critpath.render_lines(cp))
+
+
+# -- engine integration -----------------------------------------------------
+
+
+def mk_engine():
+    e = QueryEngine(block_rows=1 << 13)
+    e.execute("create table t (id Int64 not null, v Double not null, "
+              "primary key (id))")
+    e.execute("insert into t (id, v) values " + ", ".join(
+        f"({i}, {i}.5)" for i in range(64)))
+    return e
+
+
+def test_local_query_stats_and_explain_lines():
+    eng = mk_engine()
+    eng.query("select sum(v) as s, count(*) as n from t")
+    cp = eng.last_stats.critical_path
+    assert cp and cp["classes"]
+    assert cp["connected"]
+    assert cp["coverage"] >= 0.9
+    assert set(cp["classes"]) <= set(critpath.CLASSES)
+    df = eng.query("explain analyze select sum(v) as s from t")
+    text = "\n".join(df["plan"])
+    assert "-- critical path:" in text and "%" in text
+
+
+def test_sysview_and_counters():
+    eng = mk_engine()
+    before = GLOBAL.get("crit/extractions")
+    eng.query("select sum(v) as s from t")
+    assert GLOBAL.get("crit/extractions") > before
+    got = eng.query("select sql, coverage, connected, dominant_class "
+                    "from `.sys/query_critical_path`")
+    assert len(got) > 0
+    assert bool(got["connected"].to_numpy()[-1])
+    c = eng.counters()
+    assert c.get("crit/extractions", 0) > 0        # always-visible [viz]
+
+
+def test_chrome_render_validates_for_local_query():
+    eng = mk_engine()
+    eng.query("select sum(v) as s from t")
+    trace = chrometrace.render(eng.profiles[-1])
+    assert chrometrace.validate(trace) == []
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert xs and all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert "router" in names
+
+
+def test_http_trace_endpoint_serves_and_404s():
+    from ydb_tpu.server.http import serve_http
+    eng = mk_engine()
+    eng.query("select sum(v) as s from t")
+    prof = eng.profiles[-1]
+    front = serve_http(eng)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{front.port}/trace/"
+                f"{prof['trace_id']}", timeout=10) as r:
+            trace = json.loads(r.read())
+        assert chrometrace.validate(trace) == []
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{front.port}/trace/424242",
+                timeout=10)
+        assert ei.value.code == 404
+    finally:
+        front.stop()
+
+
+# -- DQ cluster: cross-worker timelines -------------------------------------
+
+
+def mk_cluster(skew_ms: float = 0.0):
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.dq.runner import LocalWorker
+
+    engines = []
+    for wid in range(2):
+        e = QueryEngine(block_rows=1 << 13)
+        e.execute("create table t (id Int64 not null, k Int64 not null, "
+                  "v Double not null, primary key (id))")
+        mine = [i for i in range(120) if i % 2 == wid]
+        e.execute("insert into t (id, k, v) values " + ", ".join(
+            f"({i}, {i % 7}, {i}.5)" for i in mine))
+        e.execute("create table u (uid Int64 not null, w Double not null, "
+                  "primary key (uid))")
+        mine_u = [i for i in range(7) if i % 2 == wid]
+        if mine_u:
+            e.execute("insert into u (uid, w) values " + ", ".join(
+                f"({i}, {i}.0)" for i in mine_u))
+        engines.append(e)
+    if skew_ms:
+        # inject clock skew via the WORKER's `_now` hook: every span
+        # this worker records is stamped `skew_ms` ahead — exactly the
+        # shape two OS worker processes with different process starts
+        # (or drifting clocks) produce over the DqRunTask RPC
+        t1 = engines[1].tracer
+        real = t1._now
+        t1._now = lambda: real() + skew_ms
+    workers = [LocalWorker(engines[0], name="w0"),
+               LocalWorker(engines[1], name="w1")]
+    c = ShardedCluster(workers, merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    return c, engines
+
+
+SQL = "select count(*) as n, sum(w) as s from t, u where k = uid"
+
+
+def _assert_gap_free(eng):
+    """Worker spans must sit inside their dq-task attempt spans on the
+    ROUTER timebase — the rebase is measured, not parent-snapped."""
+    spans = eng.last_trace
+    by_id = {s.span_id: s for s in spans}
+    checked = 0
+    for s in spans:
+        if s.name != "task-exec":
+            continue
+        task = by_id.get(s.parent_id)
+        if task is None or task.name != "dq-task":
+            continue
+        checked += 1
+        assert task.start_ms - 150.0 <= s.start_ms, \
+            (s.start_ms, task.start_ms)
+        assert s.start_ms + s.dur_ms <= task.start_ms + task.dur_ms \
+            + 150.0, (s, task)
+    assert checked >= 2          # both workers contributed
+    cp = eng.profiles[-1]["critical_path"]
+    assert cp["connected"] and cp["coverage"] >= 0.9
+
+
+def test_skewed_worker_clocks_still_assemble_gap_free():
+    # +8s and -8s skew: without clock alignment the worker subtrees
+    # would land seconds outside their attempt spans and the "timeline"
+    # would have giant holes/overlaps
+    for skew in (8000.0, -8000.0):
+        c, engines = mk_cluster(skew_ms=skew)
+        got = c.query(SQL)
+        assert int(got.n[0]) > 0
+        _assert_gap_free(engines[0])
+        # the offset estimate is stamped on the trace and ~cancels the
+        # injected skew (both tracers share one real clock here)
+        offs = [s.attrs["clock_offset_ms"] for s in engines[0].last_trace
+                if s.name == "dq-task"
+                and "clock_offset_ms" in s.attrs]
+        assert offs
+        # tolerance is loose (first-sample error is ±call-overhead
+        # asymmetry under GIL contention on a 1-core runner) but still
+        # ~30x tighter than the injected skew it must cancel
+        assert any(abs(o + skew) < 250.0 for o in offs)
+
+
+def test_unskewed_cluster_assembles_gap_free_too():
+    c, engines = mk_cluster()
+    c.query(SQL)
+    _assert_gap_free(engines[0])
+
+
+def test_dq_chrome_trace_has_worker_tracks_and_flow_arrows():
+    c, engines = mk_cluster()
+    c.query(SQL)
+    prof = engines[0].profiles[-1]
+    trace = chrometrace.render(prof)
+    assert chrometrace.validate(trace) == []
+    assert chrometrace.flow_pairs(trace) >= 1
+    lanes = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert {"local:w0", "local:w1"} <= lanes
+
+
+def test_dq_critical_path_classes_cover_channel_and_host_lane():
+    c, engines = mk_cluster()
+    c.query(SQL)
+    cp = engines[0].profiles[-1]["critical_path"]
+    assert cp["connected"] and cp["coverage"] >= 0.9
+    assert all(s["class"] in critpath.CLASSES for s in cp["segments"])
+    # a DQ stage chain runs through the host to_pandas lane today —
+    # the non-device share must be visible, not hidden in gaps
+    assert cp["non_device_ms"] > 0
+    assert cp["dominant_span"]
+
+
+# -- OTLP-uploader schema stamp ---------------------------------------------
+
+
+def test_trace_topic_export_is_version_stamped():
+    eng = mk_engine()
+    eng.create_topic("traces")
+    eng.trace_to_topic("traces")
+    eng.query("select sum(v) as s from t")
+    msgs = eng.topic("traces").read("c", 0, limit=10)
+    assert msgs
+    data = msgs[-1]["data"]
+    assert data["v"] == 2
+    assert data["timebase"] == "router"
+    assert data["spans"] and data["spans"][0]["name"] == "statement"
+
+
+# -- the YDB_TPU_CRITPATH=0 lever -------------------------------------------
+
+
+def test_critpath_off_is_byte_equal_and_frozen(monkeypatch):
+    import numpy as np
+    base = mk_engine()
+    want = base.query("select sum(v) as s, count(*) as n from t")
+
+    monkeypatch.setenv("YDB_TPU_CRITPATH", "0")
+    before = {k: GLOBAL.get(k) for k in
+              ("crit/extractions", "crit/non_device_ms")}
+    quiet = mk_engine()
+    got = quiet.query("select sum(v) as s, count(*) as n from t")
+    assert list(got.columns) == list(want.columns)
+    assert all(np.array_equal(got[c].to_numpy(), want[c].to_numpy())
+               for c in want.columns)
+    # extraction fully disabled: no stats, no profile field, no ring
+    # rows, counters frozen
+    assert quiet.last_stats.critical_path == {}
+    assert "critical_path" not in quiet.profiles[-1]
+    assert len(quiet.critpath_stats) == 0
+    assert {k: GLOBAL.get(k) for k in before} == before
+    # and the export endpoint refuses loudly instead of serving stale
+    from ydb_tpu.server.http import serve_http
+    front = serve_http(quiet)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{front.port}/trace/1", timeout=10)
+        assert ei.value.code == 409
+    finally:
+        front.stop()
+
+
+# -- graftlint: analysis-side modules ---------------------------------------
+
+
+def test_host_sync_pass_treats_critpath_as_analysis_side():
+    from ydb_tpu.analysis.core import Project
+    from ydb_tpu.analysis.passes.host_sync import (
+        ANALYSIS_SIDE, HostSyncPass,
+    )
+    assert "ydb_tpu/utils/critpath.py" in ANALYSIS_SIDE
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    project = Project.from_dir(repo)
+    findings = HostSyncPass().check(project)
+    assert not [f for f in findings if f.path in ANALYSIS_SIDE]
+
+
+def test_registry_covers_crit_families():
+    from ydb_tpu.utils.metrics import COUNTER_REGISTRY
+    for name in ("crit/extractions", "crit/disconnected",
+                 "crit/non_device_ms", "crit/coverage_pct", "crit/*"):
+        assert name in COUNTER_REGISTRY

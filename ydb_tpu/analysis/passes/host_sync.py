@@ -39,6 +39,16 @@ import re
 from ydb_tpu.analysis.core import Finding, Pass
 
 MODULES = ("ydb_tpu/ops/", "ydb_tpu/dq/", "ydb_tpu/parallel/")
+
+# analysis-side modules: pure host-side consumers of already-recorded
+# observability data (span trees, profile records) with NO device code
+# reachable — they never need transfer pragmas even if they land inside
+# a scanned prefix someday. `utils/critpath.py` walks span dicts;
+# `utils/chrometrace.py` renders them to JSON.
+ANALYSIS_SIDE = frozenset((
+    "ydb_tpu/utils/critpath.py",
+    "ydb_tpu/utils/chrometrace.py",
+))
 _CASTS = ("float", "int", "bool")
 _TRANSFER_OK_RE = re.compile(r"lint:\s*transfer-ok\(([^)]*)\)")
 
@@ -83,6 +93,8 @@ class HostSyncPass(Pass):
     def check(self, project) -> list:
         out = []
         for mod in project.under(*MODULES):
+            if mod.path in ANALYSIS_SIDE:
+                continue
             np_names = _numpy_aliases(mod.tree)
             for n in ast.walk(mod.tree):
                 if not isinstance(n, ast.Call):

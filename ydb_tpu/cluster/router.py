@@ -510,7 +510,11 @@ class ShardedCluster:
                     # empty-result query (the local path marks these
                     # "error" the same way)
                     kind="dq-select" if rows_out is not None
-                    else "dq-error")
+                    else "dq-error",
+                    # the graph run's closed ledger: critical-path
+                    # extraction costs its transferred/padded bytes
+                    # next to the blocking milliseconds
+                    memory=runner.mem_summary)
 
     def _explain(self, stmt: ast.Explain) -> pd.DataFrame:
         """Distributed EXPLAIN [ANALYZE]: the stage graph, and with
@@ -555,4 +559,10 @@ class ShardedCluster:
         tr = self.engine.tracer.render(self.engine.last_trace)
         if tr:
             lines += ["-- trace:"] + tr.split("\n")
+        # the distributed critical path (extracted in _record_profile
+        # from the SAME assembled tree rendered above): per-class % of
+        # the graph wall + the dominant span — the worklist line
+        from ydb_tpu.utils import critpath
+        prof = self.engine.profiles[-1] if self.engine.profiles else {}
+        lines += critpath.render_lines(prof.get("critical_path") or {})
         return pd.DataFrame({"plan": lines})

@@ -80,6 +80,46 @@ def _transport_kind(e) -> str:
     return "unavailable"
 
 
+# -- cross-worker clock alignment -------------------------------------------
+#
+# Worker span timestamps are worker-local monotonic clocks (each tracer
+# counts from its own process start): comparing them across workers —
+# DQ stage overlap, channel send→recv gaps — needs every span on ONE
+# timebase. The DqRunTask RPC boundary gives a free NTP-style estimator:
+# the runner stamps send/recv on the router clock, the worker stamps
+# receive/respond on its clock (`resp["profile"]["clock"]`), and the
+# midpoint difference is the router-minus-worker offset with ±RTT/2
+# uncertainty. EWMA-smoothed per worker HANDLE (Client / LocalWorker
+# objects persist across the per-query runners), observed on every task
+# RPC — sampled or not — so the estimate is warm by the first profiled
+# query.
+
+_CLOCK_ALPHA = 0.3
+
+
+def observe_clock(worker, t_send: float, t_recv: float,
+                  w_recv: float, w_send: float):
+    """One offset sample at an RPC boundary, folded into the worker's
+    EWMA. All times in ms on their respective tracer clocks. Returns
+    (offset_ms, err_ms): router_time ≈ worker_time + offset_ms."""
+    sample = ((t_send + t_recv) / 2.0) - ((w_recv + w_send) / 2.0)
+    err = max(0.0, ((t_recv - t_send) - (w_send - w_recv)) / 2.0)
+    prev = getattr(worker, "_clock_ewma", None)
+    if prev is None:
+        off = (sample, err)
+    else:
+        off = (_CLOCK_ALPHA * sample + (1 - _CLOCK_ALPHA) * prev[0],
+               _CLOCK_ALPHA * err + (1 - _CLOCK_ALPHA) * prev[1])
+    worker._clock_ewma = off
+    return off
+
+
+def worker_clock_offset(worker):
+    """The smoothed (offset_ms, err_ms) for a worker handle, or None
+    before its first observed RPC."""
+    return getattr(worker, "_clock_ewma", None)
+
+
 class DqTaskRunner:
     def __init__(self, workers: list, engine, counters=None,
                  stage_retries: int = 1, rpc_timeout: float = None):
@@ -106,6 +146,10 @@ class DqTaskRunner:
         # drive Hive failover
         self.transport_failed: set = set()
         self.transport_kinds: dict = {}      # endpoint -> timeout|unavailable
+        # closed resource-ledger summary of the last run() — the router
+        # joins it into the profile record so critical-path extraction
+        # can cost padded/transferred bytes next to the milliseconds
+        self.mem_summary: dict = None
         for w in self.workers:
             if hasattr(w, "bind_peers"):
                 try:
@@ -162,6 +206,7 @@ class DqTaskRunner:
         finally:
             if led is not None:
                 memledger.close_statement(led)
+                self.mem_summary = led.summary()
                 rm = getattr(self.engine, "_record_memory", None)
                 if rm is not None:
                     rm(f"dq-graph:{graph.tag}", "dq", led)
@@ -358,6 +403,8 @@ class DqTaskRunner:
                         "dq-task", task=tasks[i]["task"],
                         worker=w.endpoint, attempt=attempt + 1)
 
+            clock_offsets: dict = {}     # widx -> (offset_ms, err_ms)
+
             def one(iw):
                 i, w = iw
                 t = tasks[i]
@@ -365,6 +412,7 @@ class DqTaskRunner:
                 t["attempts"] = t.get("attempts", 0) + 1
                 self.counters.inc("dq/tasks")
                 sp = task_spans.get(i)
+                t_send = tracer._now() if tracer is not None else None
                 t0 = time.perf_counter()
                 try:
                     # src is attempt-INDEPENDENT on purpose: the stage
@@ -381,6 +429,21 @@ class DqTaskRunner:
                         timeout=self.rpc_timeout,
                         trace=self._trace_ctx(base_ctx, sp))
                     t["state"] = "finished"
+                    clk = (resp.get("profile") or {}).get("clock")
+                    if tracer is not None and clk is not None:
+                        # clock alignment: fold this RPC's boundary
+                        # stamps into the worker's EWMA offset; the
+                        # ingest below rebases the worker's spans with
+                        # it, and the offset + uncertainty land on the
+                        # trace (the attempt's task span)
+                        off, cerr = observe_clock(
+                            w, t_send, tracer._now(),
+                            float(clk["recv_ms"]),
+                            float(clk["send_ms"]))
+                        clock_offsets[i] = (off, cerr)
+                        if sp is not None:
+                            sp.attrs["clock_offset_ms"] = round(off, 3)
+                            sp.attrs["clock_err_ms"] = round(cerr, 3)
                     if sp is not None:
                         sp.dur_ms = (time.perf_counter() - t0) * 1000.0
                         sp.attrs["state"] = "finished"
@@ -399,15 +462,20 @@ class DqTaskRunner:
             if tracer is not None:
                 # worker-recorded spans join the tree under their
                 # attempt's task span (ids collide-free: span ids are
-                # pid-salted) — the assembled cross-worker profile
+                # pid-salted), rebased onto the ROUTER timebase by the
+                # worker's smoothed clock offset — the assembled
+                # cross-worker profile with honest overlap/gaps
                 for (i, resp, _e) in results:
                     spans = ((resp or {}).get("profile") or {}) \
                         .get("spans")
                     if spans:
                         sp = task_spans.get(i)
+                        off = clock_offsets.get(i)
                         tracer.ingest(
                             spans, parent_id=sp.span_id
-                            if sp is not None else None)
+                            if sp is not None else None,
+                            offset_ms=off[0] if off is not None
+                            else None)
             failed = [(i, e) for (i, _r, e) in results if e is not None]
             if not failed:
                 return results, tasks

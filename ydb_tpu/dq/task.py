@@ -35,6 +35,12 @@ def run_task(engine, sql: str, outputs: list, src: str, send,
     counters = counters or GLOBAL
     executor = engine.executor
     tracer = getattr(engine, "tracer", None)
+    # clock-alignment stamps (this worker's tracer clock at RPC receive
+    # and response build): the runner pairs them with its own send/recv
+    # timestamps to estimate this worker's clock offset (NTP-style
+    # midpoint) and rebase every ingested span onto the router timebase.
+    # Shipped UNCONDITIONALLY — unsampled traffic keeps the EWMA warm.
+    w_recv = tracer._now() if tracer is not None else None
     adopt = trace is not None and tracer is not None
     sampled = bool(adopt and trace.get("sampled"))
     if adopt:
@@ -58,6 +64,10 @@ def run_task(engine, sql: str, outputs: list, src: str, send,
             spans = tracer.end_trace()
     if sampled:
         resp["profile"]["spans"] = [s.to_dict() for s in spans]
+    if w_recv is not None:
+        resp.setdefault("profile", {})["clock"] = {
+            "recv_ms": round(w_recv, 3),
+            "send_ms": round(tracer._now(), 3)}
     return resp
 
 
@@ -93,7 +103,8 @@ def _run_task_body(engine, executor, sql, outputs, src, send, token,
             "dtypes": {c: str(df[c].dtype) for c in df.columns}}
     total_bytes = total_frames = 0
     t0 = time.perf_counter()
-    with span("output-flush", channels=len(outputs)):
+    with span("output-flush", channels=len(outputs),
+              channel_ids=",".join(str(o["channel"]) for o in outputs)):
         for out in outputs:
             kind = out["kind"]
             if kind in ("union_all", "merge"):

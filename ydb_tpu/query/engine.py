@@ -165,6 +165,12 @@ class QueryEngine:
         # ledger (utils/memledger.py; empty under YDB_TPU_MEMLEDGER=0)
         self.memory_stats = _deque(maxlen=int(
             os.environ.get("YDB_TPU_MEMORY_RING", "256")))
+        # per-statement critical-path rollups, last-N ring
+        # (`.sys/query_critical_path`): one row per extracted path —
+        # per-class milliseconds, coverage, the dominant span
+        # (utils/critpath.py; empty under YDB_TPU_CRITPATH=0)
+        self.critpath_stats = _deque(maxlen=int(
+            os.environ.get("YDB_TPU_CRITPATH_RING", "256")))
         # per-statement result metadata is THREAD-LOCAL: concurrent
         # sessions must each see their own stats/trace/rows-affected
         self._tls = threading.local()
@@ -448,7 +454,9 @@ class QueryEngine:
             # through a legacy (context-free) caller is still internal
             if outermost and self.last_trace \
                     and not self.executor.dq_stage_depth:
-                self._record_profile(sql, self.last_trace)
+                self._record_profile(sql, self.last_trace,
+                                     memory=led.summary()
+                                     if led is not None else None)
             if not _internal:
                 self._audit(sql, ok, kind_box[0] if kind_box else "")
 
@@ -500,7 +508,8 @@ class QueryEngine:
 
     def _record_profile(self, sql: str, spans: list,
                         stage_stats: list = None, total_ms: float = None,
-                        rows_out: int = None, kind: str = None) -> None:
+                        rows_out: int = None, kind: str = None,
+                        memory: dict = None) -> None:
         """Append one assembled profile to the last-N ring
         (`.sys/query_profiles`): the span tree plus its device-timeline
         rollup. `stage_stats`: the DQ runner's per-(stage, worker) rows
@@ -519,7 +528,7 @@ class QueryEngine:
         # exists to make reliable
         mine = st is not None and getattr(st, "sql", None) == sql
         finished = mine and getattr(st, "total_ms", 0.0) > 0.0
-        self.profiles.append({
+        rec = {
             "trace_id": spans[0].trace_id,
             "sql": sql,
             "kind": kind if kind is not None
@@ -533,7 +542,33 @@ class QueryEngine:
             "n_spans": len(spans),
             "spans": [s.to_dict() for s in spans],
             "stages": list(stage_stats or []),
-        })
+        }
+        # critical-path extraction (utils/critpath.py): which chain of
+        # segments actually bounded this query's wall — classified,
+        # counted (`crit/*`), ringed (`.sys/query_critical_path`), and
+        # stored on the profile for the `/trace/<id>` timeline export.
+        # Lever-gated: YDB_TPU_CRITPATH=0 freezes all of it.
+        from ydb_tpu.utils import critpath
+        if critpath.enabled():
+            try:
+                cp = critpath.extract(spans, memory=memory)
+                rec["critical_path"] = cp
+                critpath.record_counters(cp)
+                self.critpath_stats.append({
+                    "trace_id": rec["trace_id"], "sql": sql,
+                    "kind": rec["kind"], "wall_ms": cp["wall_ms"],
+                    "coverage": cp["coverage"],
+                    "connected": cp["connected"],
+                    "non_device_ms": cp["non_device_ms"],
+                    "dominant_span": cp["dominant_span"],
+                    "dominant_class": cp["dominant_class"],
+                    "dominant_ms": cp["dominant_ms"],
+                    **{f"{cls}_ms": cp["classes"].get(cls, 0.0)
+                       for cls in critpath.CLASSES},
+                })
+            except Exception:                # noqa: BLE001 — analysis
+                pass                         # must never fail a query
+        self.profiles.append(rec)
 
     def _audit(self, sql: str, ok: bool, kind: str) -> None:
         """Audit trail for mutating statements (the ydb/core/audit sink):
@@ -557,9 +592,15 @@ class QueryEngine:
 
     def trace_to_topic(self, topic_name: str) -> None:
         """Export finished traces into a topic (the OTLP uploader seat,
-        `wilson_uploader.cpp`): each trace is one message."""
+        `wilson_uploader.cpp`): each trace is one message, schema-
+        stamped. `v: 2` + `timebase: "router"` declare that every
+        span's start_ms is already rebased onto THIS engine's tracer
+        clock (cross-worker spans via the DqRunTask clock-offset
+        estimate) — v1 messages shipped raw worker-local clocks, which
+        downstream consumers could not compare across workers."""
         t = self.topic(topic_name)
-        self.tracer.sink = lambda spans: t.write({"spans": spans})
+        self.tracer.sink = lambda spans: t.write(
+            {"v": 2, "timebase": "router", "spans": spans})
 
     def _execute_traced(self, sql: str, session=None,
                         kind_box: Optional[list] = None) -> HostBlock:
@@ -783,7 +824,20 @@ class QueryEngine:
                 "queries dispatched-or-queued for longer than the "
                 "admission deadline")
         try:
+            import time as _time
+            t_adm = _time.perf_counter()
             with self.admission.admit(est):
+                wait_ms = (_time.perf_counter() - t_adm) * 1000.0
+                if wait_ms >= 1.0:
+                    # the statement QUEUED behind the byte budget:
+                    # record the wait as its own (already-elapsed) span
+                    # so critical-path extraction can class it
+                    # admission_wait instead of burying it in a gap
+                    sp = self.tracer.attach_span(
+                        "admission-wait", admitted_mb=est >> 20)
+                    if sp is not None:
+                        sp.start_ms = round(sp.start_ms - wait_ms, 3)
+                        sp.dur_ms = round(wait_ms, 3)
                 return self._dispatch_drain_admitted(plan, snap, est)
         finally:
             self._pipe_sem.release()
@@ -914,6 +968,32 @@ class QueryEngine:
         led = memledger.current()
         if led is not None:
             stats.memory = led.summary()
+        # per-statement critical path over the same span window (the
+        # EXPLAIN ANALYZE `-- critical path:` source, joined with the
+        # live ledger's bytes); the full-tree extraction with counters
+        # and the sysview ring happens once in _record_profile
+        from ydb_tpu.utils import critpath
+        if self.tracer.sampled and critpath.enabled():
+            window = self.tracer.spans[getattr(stats, "_span_mark", 0):]
+            # root the window under a CLOSED copy of the still-open
+            # statement span: un-spanned statement-interior time (binder
+            # work, dictionary predicate evaluation, CTE/derived-table
+            # materialization — the q13 host lane) then classifies as
+            # the statement's host_lane self-time instead of vanishing
+            # into a virtual-root scheduler gap
+            stk = self.tracer._stack
+            if stk:
+                import dataclasses as _dc
+                window = [_dc.replace(
+                    stk[-1],
+                    dur_ms=self.tracer._now() - stk[-1].start_ms)] \
+                    + window
+            if window:
+                try:
+                    stats.critical_path = critpath.summarize(
+                        critpath.extract(window, memory=stats.memory))
+                except Exception:            # noqa: BLE001 — analysis
+                    pass                     # must never fail a query
         # latency histograms count USER statements once: a nested
         # internal statement (EXPLAIN ANALYZE's re-entrant execute, the
         # DQ router-merge SELECT — its trace depth is >1) must not add a
@@ -1047,12 +1127,16 @@ class QueryEngine:
         temps: list = []
         try:
             rewritten = self._rewrite_sel(stmt, {}, temps, snap)
-            df = self._eval_setop_df(rewritten, snap)
-            try:
-                df = W.apply_order_limit(df, stmt.order_by, stmt.limit,
-                                         stmt.offset)
-            except ValueError as e:
-                raise QueryError(str(e)) from e
+            # combine/dedup is host pandas work: spanned so it ranks as
+            # host_lane on the critical path (arms' device spans nest
+            # inside and classify themselves)
+            with self.tracer.span("setop-host-lane"):
+                df = self._eval_setop_df(rewritten, snap)
+                try:
+                    df = W.apply_order_limit(df, stmt.order_by,
+                                             stmt.limit, stmt.offset)
+                except ValueError as e:
+                    raise QueryError(str(e)) from e
             return HostBlock.from_pandas(df)
         finally:
             for tn in temps:
@@ -1149,21 +1233,32 @@ class QueryEngine:
             # dominant window cost — PERF.md r5)
             fs = self._final_sort_spec(sel, outer)
             if fs is not None:
-                done = self._windows_on_device(inner_block, outer,
-                                               final_sort=fs,
-                                               limit=sel.limit,
-                                               offset=sel.offset or 0)
+                with self.tracer.span("window-device",
+                                      rows=inner_block.length):
+                    done = self._windows_on_device(inner_block, outer,
+                                                   final_sort=fs,
+                                                   limit=sel.limit,
+                                                   offset=sel.offset
+                                                   or 0)
                 if done is not None:
                     lo = sel.offset or 0
                     return HostBlock.from_pandas(
                         done.iloc[lo:lo + sel.limit]
                         .reset_index(drop=True))
         if device_ok:
-            df = self._windows_on_device(inner_block, outer)
+            with self.tracer.span("window-device",
+                                  rows=inner_block.length):
+                df = self._windows_on_device(inner_block, outer)
         if df is None:
             self._host_lane_guard(inner_block.length, "window")
             try:
-                df = W.compute_windows(inner_block.to_pandas(), outer)
+                # its own span so the single-core pandas lane ranks as
+                # host_lane on the critical path (the q13 class), not
+                # as unattributed statement self-time
+                with self.tracer.span("window-host-lane",
+                                      rows=inner_block.length):
+                    df = W.compute_windows(inner_block.to_pandas(),
+                                           outer)
             except ValueError as e:
                 raise QueryError(str(e)) from e
         if post is not None:

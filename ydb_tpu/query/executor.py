@@ -38,6 +38,21 @@ from ydb_tpu.storage.mvcc import MAX_SNAPSHOT, Snapshot
 DEFAULT_BLOCK_ROWS = 1 << 20
 
 
+def _xla_scope(name: str):
+    """`jax.profiler.TraceAnnotation`-compatible named scope around the
+    device phases of fused/batched dispatch, named IDENTICALLY to our
+    tracer spans — an on-chip XLA profile (Perfetto from
+    `jax.profiler.trace`) lines its slices up with the engine's own
+    span names. Effectively a no-op on CPU (and a nullcontext wherever
+    the profiler API is absent); never allowed to fail a query."""
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation(name)
+    except Exception:                    # noqa: BLE001 — observability
+        from contextlib import nullcontext
+        return nullcontext()
+
+
 class Executor:
     def __init__(self, catalog, block_rows: int = DEFAULT_BLOCK_ROWS,
                  device_cache=None, mesh=None):
@@ -348,7 +363,8 @@ class Executor:
         dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
                       for k, v in all_params.items()}
         build_inputs = [F.build_traced_inputs(bt) for bt in builds]
-        with self._span("device-dispatch", k=K, cap=CAP) as dsp:
+        with self._span("device-dispatch", k=K, cap=CAP) as dsp, \
+                _xla_scope("device-dispatch"):
             import time as _time
             t_disp = _time.perf_counter()
             data_stacks, valid_stack, length = fn(arrays, valids, lengths,
@@ -379,7 +395,8 @@ class Executor:
             # delta — the program is still running when the future is
             # consumed promptly) and the D2H transfer + host unpack, so
             # the trace attributes device time separately from link time
-            with self._span("device-execute"):
+            with self._span("device-execute"), \
+                    _xla_scope("device-execute"):
                 jax.block_until_ready((data_stacks, valid_stack, length))
             with self._span("readout-transfer"):
                 block = F.fetch_fused_result(data_stacks, valid_stack,
@@ -645,7 +662,8 @@ class Executor:
         build_inputs = [F.build_traced_inputs(bt) for bt in builds]
         try:
             with self._span("device-dispatch-batched", k=K, cap=CAP,
-                            b=Bb) as dsp:
+                            b=Bb) as dsp, \
+                    _xla_scope("device-dispatch-batched"):
                 import time as _time
                 t_disp = _time.perf_counter()
                 data_stacks, valid_stack, length = fn(
@@ -674,7 +692,7 @@ class Executor:
         out_dicts = {n2: d for n2, d in dicts.items() if out_schema.has(n2)}
         out_dicts.update({n2: d for n2, d in plan.result_dicts.items()
                           if out_schema.has(n2)})
-        with self._span("device-execute"):
+        with self._span("device-execute"), _xla_scope("device-execute"):
             jax.block_until_ready((data_stacks, valid_stack, length))
         with self._span("readout-transfer", b=len(members)):
             blocks = F.fetch_fused_batch(data_stacks, valid_stack, length,

@@ -20,7 +20,8 @@ PREFIX = ".sys/"
 
 VIEWS = ("tables", "partition_stats", "counters", "query_metrics",
          "top_queries_by_duration", "dq_stage_stats", "query_profiles",
-         "cluster_nodes", "query_memory", "device_transfers")
+         "cluster_nodes", "query_memory", "device_transfers",
+         "query_critical_path")
 
 
 def is_sysview(name: str) -> bool:
@@ -206,6 +207,44 @@ def sysview_block(engine, name: str) -> HostBlock:
                              ("to_pandas_in_plan", "int64"),
                              ("admission_est_bytes", "int64"),
                              ("est_error_pct", "float64")])
+    if view == "query_critical_path":
+        # per-statement critical-path rollups (engine.critpath_stats,
+        # utils/critpath.py): the blocking-chain class decomposition —
+        # which chain of spans bounded each query's wall, by class.
+        # Empty under YDB_TPU_CRITPATH=0.
+        rows = [{
+            "trace_id": int(r.get("trace_id", 0)),
+            "sql": r.get("sql", ""), "kind": r.get("kind", ""),
+            "wall_ms": float(r.get("wall_ms", 0.0)),
+            "coverage": float(r.get("coverage", 0.0)),
+            "connected": bool(r.get("connected", False)),
+            "non_device_ms": float(r.get("non_device_ms", 0.0)),
+            "device_execute_ms": float(r.get("device_execute_ms", 0.0)),
+            "compile_ms": float(r.get("compile_ms", 0.0)),
+            "host_transfer_ms": float(r.get("host_transfer_ms", 0.0)),
+            "host_lane_ms": float(r.get("host_lane_ms", 0.0)),
+            "channel_wait_ms": float(r.get("channel_wait_ms", 0.0)),
+            "admission_wait_ms": float(r.get("admission_wait_ms", 0.0)),
+            "scheduler_gap_ms": float(r.get("scheduler_gap_ms", 0.0)),
+            "dominant_span": r.get("dominant_span", ""),
+            "dominant_class": r.get("dominant_class", ""),
+            "dominant_ms": float(r.get("dominant_ms", 0.0)),
+        } for r in list(getattr(engine, "critpath_stats", []))]
+        return _block(rows, [("trace_id", "int64"), ("sql", str),
+                             ("kind", str), ("wall_ms", "float64"),
+                             ("coverage", "float64"),
+                             ("connected", "bool"),
+                             ("non_device_ms", "float64"),
+                             ("device_execute_ms", "float64"),
+                             ("compile_ms", "float64"),
+                             ("host_transfer_ms", "float64"),
+                             ("host_lane_ms", "float64"),
+                             ("channel_wait_ms", "float64"),
+                             ("admission_wait_ms", "float64"),
+                             ("scheduler_gap_ms", "float64"),
+                             ("dominant_span", str),
+                             ("dominant_class", str),
+                             ("dominant_ms", "float64")])
     if view == "device_transfers":
         # the host-transfer flight recorder's recent-transfer ring
         # (utils/memledger.py, process-wide): one row per recorded

@@ -11,6 +11,11 @@ same engine over plain HTTP so curl-class clients need no gRPC stack:
   GET  /metrics    → OpenMetrics text exposition (Prometheus scrape):
                    every counter with its COUNTER_REGISTRY # HELP doc,
                    histograms as cumulative buckets
+  GET  /trace/<id> → Chrome trace-event JSON of the profiled query with
+                   that trace_id (`.sys/query_profiles` is the index) —
+                   load it straight into Perfetto / chrome://tracing.
+                   404 when the id left the profile ring; 409 under
+                   YDB_TPU_CRITPATH=0 (export disabled)
   GET  /ready      → 200 "ok" (liveness probe)
 
 Bearer auth mirrors the gRPC front: `Authorization: Bearer <token>`
@@ -76,6 +81,37 @@ class HttpFront:
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                elif self.path.startswith("/trace/"):
+                    # timeline export (same auth as /counters): one
+                    # profiled query as Perfetto-loadable Chrome trace
+                    # events, keyed by trace_id
+                    resp = servicer.counters({"token": self._token()},
+                                             None)
+                    if "error" in resp:
+                        self._send(401, resp)
+                        return
+                    from ydb_tpu.utils import chrometrace, critpath
+                    if not critpath.enabled():
+                        self._send(409, {
+                            "error": "trace export disabled "
+                                     "(YDB_TPU_CRITPATH=0)"})
+                        return
+                    try:
+                        qid = int(self.path[len("/trace/"):])
+                    except ValueError:
+                        self._send(400, {"error": "trace id must be the "
+                                                  "integer trace_id"})
+                        return
+                    prof = next(
+                        (p for p in reversed(list(engine.profiles))
+                         if int(p.get("trace_id", 0)) == qid), None)
+                    if prof is None:
+                        self._send(404, {
+                            "error": f"no profile for trace_id {qid} "
+                                     "(ring holds the last "
+                                     f"{engine.profiles.maxlen})"})
+                        return
+                    self._send(200, chrometrace.render(prof))
                 else:
                     self._send(404, {"error": f"no route {self.path}"})
 

@@ -1,0 +1,200 @@
+"""Chrome trace-event export of assembled query profiles — scrub a
+query in Perfetto.
+
+Takes one `.sys/query_profiles` record (the span tree PR 7 assembles,
+clock-rebased into the router timebase by `Tracer.ingest(offset_ms=…)`)
+and renders the Chrome trace-event JSON Perfetto loads directly:
+
+  * one process, one *track per worker/device lane* (router + each DQ
+    worker, with a separate `…/device` thread for the device-timeline
+    spans) via `thread_name` metadata events;
+  * every span as a complete `X` event (`ts`/`dur` in µs, rebased
+    non-negative), its attrs and critical-path class in `args`;
+  * async *flow arrows* (`s`/`f` pairs) for every channel edge — the
+    producer's output-flush / ici-exchange span points at each
+    consumer's input-wait span, so cross-worker data movement is a
+    drawn arrow, not an inference;
+  * counter tracks from the PR 11 mem ledger (cumulative host-transfer
+    bytes; channel rows at each drain).
+
+Served as `GET /trace/<query_id>` (query_id = trace_id) on the HTTP
+front and written per-query by `bench.py --trace-dir`. `validate()` is
+the structural checker `scripts/critpath_gate.py` gates on: matched
+event pairs, monotone non-negative timestamps, at least the declared
+shape of every event kind.
+"""
+
+from __future__ import annotations
+
+from ydb_tpu.utils.tracing import span_from_dict
+
+_DEVICE_LANE = {"device-execute", "device-dispatch",
+                "device-dispatch-batched", "superblock-upload",
+                "readout-transfer"}
+
+
+def _lanes(spans) -> dict:
+    """span_id -> track name: `critpath.lane_of` (the one shared
+    lane-resolution rule) plus a '<lane>/device' sub-track for the
+    device-timeline spans."""
+    from ydb_tpu.utils.critpath import lane_of
+    by_id = {s.span_id: s for s in spans}
+    memo: dict = {}
+    out = {}
+    for s in spans:
+        lane = lane_of(s, by_id, memo)
+        if s.name in _DEVICE_LANE:
+            lane = f"{lane}/device"
+        out[s.span_id] = lane
+    return out
+
+
+def render(profile: dict) -> dict:
+    """One profile record → Chrome trace-event JSON (a dict ready for
+    json.dump; Perfetto-loadable)."""
+    spans = [span_from_dict(d) for d in (profile.get("spans") or [])]
+    events: list = []
+    if not spans:
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    t0 = min(s.start_ms for s in spans)
+    lanes = _lanes(spans)
+    lane_tid = {}
+    for lane in sorted(set(lanes.values())):
+        lane_tid.setdefault(lane, len(lane_tid) + 1)
+    pid = 1
+    events.append({"ph": "M", "name": "process_name", "pid": pid,
+                   "tid": 0, "args": {"name": f"query "
+                                      f"{profile.get('trace_id', 0)}"}})
+    for lane, tid in lane_tid.items():
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": tid, "args": {"name": lane}})
+
+    def us(ms: float) -> float:
+        return round(max(0.0, ms - t0) * 1000.0, 1)
+
+    seg_class = {s["span_id"]: s["class"]
+                 for s in (profile.get("critical_path") or {})
+                 .get("segments", [])}
+    for s in spans:
+        args = {k: v for k, v in s.attrs.items()}
+        args["span_id"] = s.span_id
+        if s.span_id in seg_class:
+            args["critical_path_class"] = seg_class[s.span_id]
+        events.append({
+            "ph": "X", "name": s.name, "cat": "span", "pid": pid,
+            "tid": lane_tid[lanes[s.span_id]],
+            "ts": us(s.start_ms), "dur": round(max(0.0, s.dur_ms)
+                                               * 1000.0, 1),
+            "args": args})
+
+    # flow arrows: producer flush span -> each consumer's input-wait,
+    # paired by channel id (output-flush carries `channel_ids`;
+    # ici-exchange carries `channel`)
+    producers: dict = {}
+    for s in spans:
+        if s.name == "output-flush" and s.attrs.get("channel_ids"):
+            for cid in str(s.attrs["channel_ids"]).split(","):
+                if cid:
+                    producers.setdefault(cid, []).append(s)
+        elif s.name == "ici-exchange" and s.attrs.get("channel"):
+            producers.setdefault(str(s.attrs["channel"]), []).append(s)
+    fid = 0
+    for s in spans:
+        if s.name != "input-wait" or not s.attrs.get("channel"):
+            continue
+        for prod in producers.get(str(s.attrs["channel"]), ()):
+            fid += 1
+            start_ts = us(prod.start_ms + prod.dur_ms)
+            end_ts = max(us(s.start_ms), start_ts)   # monotone per flow
+            events.append({
+                "ph": "s", "id": fid, "name": f"ch:{s.attrs['channel']}",
+                "cat": "channel", "pid": pid,
+                "tid": lane_tid[lanes[prod.span_id]], "ts": start_ts})
+            events.append({
+                "ph": "f", "bp": "e", "id": fid,
+                "name": f"ch:{s.attrs['channel']}", "cat": "channel",
+                "pid": pid, "tid": lane_tid[lanes[s.span_id]],
+                "ts": end_ts})
+
+    # counter tracks from the mem ledger: cumulative channel rows at
+    # each drain, and the statement's host-transfer bytes start→end
+    rows_acc = 0
+    for s in sorted(spans, key=lambda x: x.start_ms + x.dur_ms):
+        if s.name == "input-wait" and s.attrs.get("rows") is not None:
+            rows_acc += int(s.attrs["rows"])
+            events.append({"ph": "C", "name": "channel_rows",
+                           "pid": pid, "tid": 0,
+                           "ts": us(s.start_ms + s.dur_ms),
+                           "args": {"rows": rows_acc}})
+    mem = (profile.get("critical_path") or {}).get("memory") or {}
+    root_end = max(s.start_ms + s.dur_ms for s in spans)
+    events.append({"ph": "C", "name": "hostsync_bytes", "pid": pid,
+                   "tid": 0, "ts": 0.0, "args": {"bytes": 0}})
+    events.append({"ph": "C", "name": "hostsync_bytes", "pid": pid,
+                   "tid": 0, "ts": us(root_end),
+                   "args": {"bytes": int(mem.get("transfer_bytes", 0))}})
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"trace_id": profile.get("trace_id", 0),
+                          "sql": profile.get("sql", ""),
+                          "timebase": "router"}}
+
+
+def validate(trace: dict) -> list:
+    """Structural Perfetto-acceptability check; returns a list of
+    problems (empty = valid). Pinned: events list present; every X/B/E
+    event carries name/pid/tid and non-negative ts (X also a
+    non-negative dur); B/E nest matched per (pid, tid); every flow `s`
+    has a matching `f` with ts >= the start's."""
+    errs: list = []
+    events = trace.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return ["traceEvents missing or empty"]
+    stacks: dict = {}
+    flows: dict = {}
+    for i, e in enumerate(events):
+        ph = e.get("ph")
+        if ph in ("X", "B", "E", "s", "f", "C"):
+            if e.get("ts") is None or e["ts"] < 0:
+                errs.append(f"event {i} ({ph}): negative/missing ts")
+            if ph != "E" and not e.get("name"):
+                errs.append(f"event {i} ({ph}): missing name")
+            if e.get("pid") is None or e.get("tid") is None:
+                errs.append(f"event {i} ({ph}): missing pid/tid")
+        if ph == "X":
+            if e.get("dur") is None or e["dur"] < 0:
+                errs.append(f"event {i}: X without non-negative dur")
+        elif ph == "B":
+            stacks.setdefault((e.get("pid"), e.get("tid")),
+                              []).append(e.get("name"))
+        elif ph == "E":
+            st = stacks.setdefault((e.get("pid"), e.get("tid")), [])
+            if not st:
+                errs.append(f"event {i}: E without matching B")
+            else:
+                st.pop()
+        elif ph == "s":
+            flows.setdefault(e.get("id"), []).append(("s", e["ts"]))
+        elif ph == "f":
+            flows.setdefault(e.get("id"), []).append(("f", e["ts"]))
+    for (key, st) in stacks.items():
+        if st:
+            errs.append(f"unclosed B events on track {key}: {st}")
+    for fid, legs in flows.items():
+        kinds = [k for (k, _t) in legs]
+        if kinds.count("s") != 1 or kinds.count("f") != 1:
+            errs.append(f"flow {fid}: needs exactly one s and one f")
+            continue
+        ts = dict(legs)
+        if ts["f"] < ts["s"]:
+            errs.append(f"flow {fid}: finish before start")
+    return errs
+
+
+def flow_pairs(trace: dict) -> int:
+    """Matched s/f flow-arrow pairs in the trace (the gate requires at
+    least one for a DQ query's channel edges)."""
+    ids_s = {e.get("id") for e in trace.get("traceEvents", [])
+             if e.get("ph") == "s"}
+    ids_f = {e.get("id") for e in trace.get("traceEvents", [])
+             if e.get("ph") == "f"}
+    return len(ids_s & ids_f)

@@ -340,6 +340,19 @@ COUNTER_REGISTRY = {
     "device_cache/hits": "(derived) HBM column cache hits",
     "device_cache/misses": "(derived) HBM column cache misses",
     "device_cache/bytes": "(derived) HBM column cache residency",
+    # -- critical-path analysis (utils/critpath.py): the blocking-chain
+    # decomposition of query wall — crit/<class>_ms accumulate via the
+    # wildcard family below --------------------------------------------------
+    "crit/extractions": "[viz] critical paths extracted",
+    "crit/disconnected": "[viz] extractions whose chain had gaps",
+    "crit/non_device_ms":
+        "[viz] cumulative critical-path wall NOT spent executing on "
+        "device — the speed-gap ledger's raw material",
+    "crit/coverage_pct":
+        "[hist] critical-path coverage of the query wall (%)",
+    "crit/*": "critical-path milliseconds by segment class "
+              "(device_execute/compile/host_transfer/host_lane/"
+              "channel_wait/admission_wait/scheduler_gap)",
     # -- tracing / slow queries --------------------------------------------
     "trace/forced_slow": "[viz] statements force-sampled as offenders",
     "trace/sample_rate": "(derived) configured sample rate",
@@ -395,6 +408,10 @@ class QueryStats:
     # peak/alloc device bytes, padding live-vs-padded account, host
     # transfers, admission calibration — empty when YDB_TPU_MEMLEDGER=0
     memory: dict = field(default_factory=dict)
+    # critical-path rollup (`utils/critpath.summarize`): per-class ms +
+    # % of wall, coverage, the dominant span — the blocking chain, not
+    # another aggregate. Empty when unsampled or YDB_TPU_CRITPATH=0.
+    critical_path: dict = field(default_factory=dict)
 
     def render(self) -> str:
         path = ("mesh-distributed" if self.distributed
@@ -448,6 +465,11 @@ class QueryStats:
                 line += f", {m['to_pandas_in_plan']} to_pandas-in-plan"
             line += ")"
             out += line
+        if self.critical_path:
+            from ydb_tpu.utils.critpath import render_lines
+            lines = render_lines(self.critical_path)
+            if lines:
+                out += "\n" + "\n".join(lines)
         return out
 
 

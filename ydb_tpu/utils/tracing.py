@@ -195,13 +195,23 @@ class Tracer:
         s.spans.append(sp)
         return sp
 
-    def ingest(self, span_dicts, parent_id: int = None) -> list:
+    def ingest(self, span_dicts, parent_id: int = None,
+               offset_ms: float = None) -> list:
         """Merge REMOTE spans (worker `to_dict()` payloads shipped back
         in a task result) into the thread's open trace. Spans keep their
         ids and internal parent links; any whose parent is unknown in
         the combined batch re-roots under `parent_id` (default: the
         innermost open span), so a worker subtree hangs off the router's
-        task span even if the worker recorded against a stale root."""
+        task span even if the worker recorded against a stale root.
+
+        `offset_ms`: the measured LOCAL-minus-REMOTE clock offset for
+        the batch's source (the DQ runner's RPC-boundary estimate,
+        EWMA-smoothed per worker) — every ingested start_ms rebases by
+        it, so spans from N workers land on ONE timebase (this tracer's)
+        and cross-worker overlap/gaps are real. Without it the legacy
+        parent-alignment fallback shifts the batch so its earliest span
+        starts at the parent (honest ordering, no cross-worker
+        comparability)."""
         s = self._state()
         if s.depth == 0 or not s.sampled or not span_dicts:
             return []
@@ -209,18 +219,26 @@ class Tracer:
             parent_id = s.stack[-1].span_id if s.stack else s.root_parent
         known = {sp.span_id for sp in s.spans}
         batch = [span_from_dict(d) for d in span_dicts]
-        # rebase the batch's epoch: worker start_ms is relative to the
-        # WORKER tracer's process start — without shifting onto the
-        # local epoch, a child could "start" hours before its parent
-        # and timeline consumers of the profile would see nonsense
-        # (only dur_ms is cross-process comparable; relative offsets
-        # within the batch are preserved)
-        parent_sp = next((sp for sp in s.spans
-                          if sp.span_id == parent_id), None)
-        if parent_sp is not None and batch:
-            delta = parent_sp.start_ms - min(sp.start_ms for sp in batch)
+        if offset_ms is not None:
+            # clock-aligned rebase: worker timestamps carry their own
+            # tracer's epoch; adding the measured local-minus-remote
+            # offset moves every one of them onto THIS tracer's clock
             for sp in batch:
-                sp.start_ms = round(sp.start_ms + delta, 3)
+                sp.start_ms = round(sp.start_ms + offset_ms, 3)
+        else:
+            # rebase the batch's epoch: worker start_ms is relative to
+            # the WORKER tracer's process start — without shifting onto
+            # the local epoch, a child could "start" hours before its
+            # parent and timeline consumers of the profile would see
+            # nonsense (only dur_ms is cross-process comparable;
+            # relative offsets within the batch are preserved)
+            parent_sp = next((sp for sp in s.spans
+                              if sp.span_id == parent_id), None)
+            if parent_sp is not None and batch:
+                delta = parent_sp.start_ms - min(sp.start_ms
+                                                 for sp in batch)
+                for sp in batch:
+                    sp.start_ms = round(sp.start_ms + delta, 3)
         known |= {sp.span_id for sp in batch}
         for sp in batch:
             sp.trace_id = s.trace_id

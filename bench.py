@@ -250,6 +250,21 @@ def child_main(sf: float, progress_path: str, skip: list,
                     "dominant_span": cp.get("dominant_span", ""),
                     "dominant_class": cp.get("dominant_class", ""),
                 }
+            # compiled-program roofline stamp (utils/progstats.py): the
+            # dominant program's utilization + bound-class verdict, so
+            # device-dominated queries get a diagnosis instead of
+            # silence in the speed-gap ledger
+            pg = dict(getattr(eng.last_stats, "programs", {}) or {})
+            if pg.get("programs"):
+                dom = pg["programs"][0]
+                rec["programs"] = {
+                    "n": pg.get("n", 0),
+                    "device_ms": pg.get("device_ms", 0.0),
+                    "utilization_pct": dom.get("utilization_pct"),
+                    "bound_class": dom.get("bound_class", ""),
+                    "flops": dom.get("flops"),
+                    "bytes_accessed": dom.get("bytes_accessed"),
+                }
             # per-query Perfetto timeline (`bench.py --trace-dir DIR`):
             # one Chrome trace-event file per profiled query
             tdir = os.environ.get("BENCH_TRACE_DIR")
@@ -338,13 +353,26 @@ def _load_last_good() -> dict:
 
 
 def _save_last_good(suites: dict) -> None:
-    """Persist the most recent SUCCESSFUL per-query numbers per suite —
-    a later wedged run still reports them under `last_known_good`."""
+    """Persist the most recent GOOD per-query numbers per suite — a
+    later wedged run still reports them under `last_known_good`. Good =
+    successfully timed + oracle-clean (failed/hung queries never land
+    here) AND not geomean-regressed beyond the gate threshold: a run
+    >25% slower must NOT overwrite the comparison base, or the
+    trajectory gate (`scripts/bench_history.py --gate`) would always
+    compare the newest ledger entry against itself and never fire."""
     good = _load_last_good()
+    threshold = float(os.environ.get("BENCH_GATE_REGRESSION", "1.25"))
     for key, out in suites.items():
         if not out.get("per_query_ms"):
             continue
         prev = good.get(key, {})
+        prev_geo = float(prev.get("geomean_ms") or 0)
+        new_geo = float(out.get("geomean_ms") or 0)
+        if prev_geo and new_geo > threshold * prev_geo:
+            log(f"last-good NOT updated for {key}: geomean "
+                f"{new_geo:.1f}ms > {threshold}x previous "
+                f"{prev_geo:.1f}ms — the gate will flag this run")
+            continue
         merged = dict(prev.get("per_query_ms", {}))
         merged.update(out["per_query_ms"])
         good[key] = {
@@ -628,19 +656,36 @@ def run_suite(sf: float, suite_deadline: float,
         # the SPEED-GAP LEDGER (round-14): every query ranked by the
         # critical-path milliseconds NOT spent executing on device,
         # dominant span named — the machine-generated worklist for
-        # ROADMAP items 1–2 (where the 10× actually lives)
+        # ROADMAP items 1–2 (where the 10× actually lives). Round-15:
+        # rows carry the dominant program's roofline utilization +
+        # bound-class, so device-dominated queries get a verdict too
         "speed_gap": _speed_gap(results),
+        # the program-roofline floor (utils/progstats.py): per-query
+        # dominant-program verdicts + the suite utilization geomean
+        "per_query_programs": {q: r["programs"]
+                               for q, r in results.items()
+                               if r.get("programs")},
+        "utilization_geomean": (lambda us: round(geomean(us), 2)
+                                if us else None)(
+            [r["programs"]["utilization_pct"]
+             for r in results.values()
+             if r.get("programs")
+             and r["programs"].get("utilization_pct")]),
     }
 
 
 def _speed_gap(results: dict) -> list:
     """Rank queries by non-device critical-path ms (descending), each
-    with its dominant blocking span and per-class share of wall."""
+    with its dominant blocking span and per-class share of wall — plus
+    the dominant compiled program's roofline utilization + bound-class
+    (utils/progstats.py), so a device-dominated query carries a verdict
+    (2% of peak, memory_bound) instead of falling off the worklist."""
     rows = []
     for q, r in results.items():
         cp = r.get("critical_path")
         if not cp:
             continue
+        pg = r.get("programs") or {}
         rows.append({
             "query": q,
             "non_device_ms": round(cp.get("non_device_ms", 0.0), 1),
@@ -648,6 +693,8 @@ def _speed_gap(results: dict) -> list:
             "dominant_span": cp.get("dominant_span", ""),
             "dominant_class": cp.get("dominant_class", ""),
             "class_pct": {k: v for k, v in (cp.get("pct") or {}).items()},
+            "utilization_pct": pg.get("utilization_pct"),
+            "bound_class": pg.get("bound_class", ""),
         })
     return sorted(rows, key=lambda r: -r["non_device_ms"])
 
@@ -665,6 +712,27 @@ def _phase_geomean(phase_dicts: list) -> dict:
 
 
 _WEDGED = {"v": False}
+
+
+def _append_history(suites: dict) -> None:
+    """Append one bench-trajectory ledger line (BENCH_HISTORY.jsonl —
+    git sha, per-suite geomeans/walls/coverage, storm + multichip
+    summaries, utilization geomean) via scripts/bench_history.py; never
+    allowed to fail the run."""
+    if not suites:
+        return
+    try:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "scripts", "bench_history.py")
+        spec = importlib.util.spec_from_file_location("bench_history",
+                                                      path)
+        bh = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bh)
+        bh.append_run(suites)
+        log(f"bench history: appended to {bh.HISTORY_PATH}")
+    except Exception as e:               # noqa: BLE001 — ledger only
+        log(f"bench history append failed: {type(e).__name__}: {e}")
 
 
 def _emit(suites: dict) -> None:
@@ -1102,6 +1170,7 @@ def main() -> None:
         time.sleep(EMERGENCY_S)
         log(f"EMERGENCY deadline ({EMERGENCY_S:.0f}s) — emitting partial "
             "results and exiting")
+        _append_history(suites)
         _emit(suites)
         os._exit(0)
 
@@ -1162,6 +1231,10 @@ def main() -> None:
         # driver kills us, the LAST printed line already carries it
         _save_last_good({key: out})
         _emit(suites)
+    # one trajectory-ledger line per finished run (partial runs included
+    # — the ledger is the history, regressions and all; last-known-good
+    # stays the separate green-only gate input)
+    _append_history(suites)
     if not suites:
         _emit(suites)
 

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Bench-trajectory ledger + regression gate.
+
+Every `bench.py` run appends ONE line to `BENCH_HISTORY.jsonl` (via
+`append_run`, called from bench.py's main loop and its emergency
+handler): git sha, timestamp, per-suite geomean/per-query walls/
+coverage/utilization geomean, and the storm + multichip leg summaries.
+The ledger is the *trajectory* — regressions, wedged runs and all;
+`.bench_last_good.json` stays the separate green-only comparison base
+(bench.py merges only successfully-timed, oracle-clean per-query
+numbers into it — see README "Benchmarks").
+
+The `--gate` mode is the CI leg (`scripts/ci.sh`): it compares the
+NEWEST history entry against last-known-good and fails on a >25%
+geomean regression for any suite present in both, naming the offending
+queries (per-query wall >25% over its last-good number). A missing
+ledger fails loudly — the trajectory is a committed artifact, not an
+optional nicety. Runs with no comparable suites (e.g. a wedged run
+that completed nothing) pass with a stamped verdict: the platform
+honesty flags live in the artifact, not here.
+
+Modes:
+  bench_history.py --append ARTIFACT.json   append an entry from a bench
+                                            artifact (raw bench stdout
+                                            or the driver {parsed: ...}
+                                            wrapper)
+  bench_history.py --seed-last-good         append an entry derived from
+                                            .bench_last_good.json
+  bench_history.py --gate                   newest entry vs last-good
+                                            (rc 1 on >25% regression)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HISTORY_PATH = os.path.join(REPO, "BENCH_HISTORY.jsonl")
+LAST_GOOD_PATH = os.path.join(REPO, ".bench_last_good.json")
+MULTICHIP_PATH = os.path.join(REPO, "MULTICHIP_r06.json")
+REGRESSION = float(os.environ.get("BENCH_GATE_REGRESSION", "1.25"))
+_PROC_T0 = time.time()
+
+
+def _git_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:                   # noqa: BLE001 — ledger only
+        return "unknown"
+
+
+def entry_from_suites(suites: dict, source: str = "bench.py") -> dict:
+    """One ledger line from a bench `suites` payload (the artifact's
+    `suites` value): tpch/tpcds/clickbench suites keep geomeans +
+    per-query walls + coverage + utilization geomean; the storm leg
+    keeps its speedup/amortization; the multichip leg is read from its
+    own artifact when present."""
+    e = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "git_sha": _git_sha(),
+        "source": source,
+        "suites": {},
+    }
+    for key, s in (suites or {}).items():
+        if not isinstance(s, dict):
+            continue
+        if key == "storm":
+            e["storm"] = {
+                "speedup": s.get("value"),
+                "dispatch_amortization": s.get("dispatch_amortization"),
+                "byte_equal": s.get("byte_equal"),
+                "qps_batched": s.get("qps_batched"),
+                "storm_compiles": s.get("storm_compiles"),
+            }
+            continue
+        if "geomean_ms" not in s:
+            continue
+        e["suites"][key] = {
+            "geomean_ms": s.get("geomean_ms"),
+            "geomean_penalized_ms": s.get("geomean_penalized_ms"),
+            "coverage": s.get("coverage"),
+            "per_query_ms": dict(s.get("per_query_ms") or {}),
+            "fallbacks": list(s.get("fallbacks") or []),
+            "utilization_geomean": s.get("utilization_geomean"),
+        }
+    try:
+        # only a multichip artifact written by THIS run (the leg runs
+        # in the same process tree) rides the entry — a stale on-disk
+        # file from an earlier commit must not be re-stamped under
+        # every new sha as if freshly measured
+        if os.path.getmtime(MULTICHIP_PATH) >= _PROC_T0 - 1:
+            with open(MULTICHIP_PATH) as f:
+                mc = json.load(f)
+            e["multichip"] = {
+                "speedup_vs_host": mc.get("speedup_vs_host"),
+                "byte_equal": mc.get("byte_equal"),
+                "padded_over_live":
+                    (mc.get("wire_padding") or {}).get("padded_over_live"),
+                "virtual_mesh": mc.get("virtual_mesh"),
+            }
+    except (OSError, json.JSONDecodeError):
+        pass
+    return e
+
+
+def append_run(suites: dict, path: str = HISTORY_PATH,
+               source: str = "bench.py") -> dict:
+    entry = entry_from_suites(suites, source=source)
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return entry
+
+
+def _load_history(path: str = HISTORY_PATH) -> list:
+    try:
+        with open(path) as f:
+            out = []
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+            return out
+    except FileNotFoundError:
+        return []
+
+
+def _load_last_good() -> dict:
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+
+
+def gate() -> int:
+    """Newest ledger entry vs `.bench_last_good.json`: rc 1 when any
+    suite's geomean regressed >25% (offending queries named), or when
+    the ledger itself is missing/empty."""
+    out = {"ok": True, "threshold": REGRESSION, "suites": {}}
+    hist = _load_history()
+    if not hist:
+        print(json.dumps({"ok": False,
+                          "error": f"no entries in {HISTORY_PATH} — "
+                                   "the bench trajectory ledger is a "
+                                   "committed artifact"}))
+        return 1
+    cand = hist[-1]
+    good = _load_last_good()
+    out["candidate_ts"] = cand.get("ts")
+    out["candidate_sha"] = cand.get("git_sha")
+    compared = 0
+    for key, cs in (cand.get("suites") or {}).items():
+        lg = good.get(key)
+        if not lg or not lg.get("geomean_ms"):
+            continue
+        c_geo = cs.get("geomean_ms") or 0.0
+        if c_geo <= 0 or not cs.get("per_query_ms"):
+            # a run that completed nothing for this suite (wedged
+            # platform) is stamped in the artifact, not re-judged here
+            out["suites"][key] = {"verdict": "no-data"}
+            continue
+        compared += 1
+        lg_geo = float(lg["geomean_ms"])
+        ratio = c_geo / lg_geo if lg_geo else 0.0
+        offenders = []
+        lg_pq = lg.get("per_query_ms") or {}
+        for q, ms in (cs.get("per_query_ms") or {}).items():
+            base = lg_pq.get(q)
+            if base and ms > REGRESSION * base:
+                offenders.append({"query": q, "ms": round(ms, 1),
+                                  "last_good_ms": round(base, 1),
+                                  "ratio": round(ms / base, 2)})
+        offenders.sort(key=lambda o: -o["ratio"])
+        regressed = ratio > REGRESSION
+        out["suites"][key] = {
+            "geomean_ms": round(c_geo, 1),
+            "last_good_geomean_ms": round(lg_geo, 1),
+            "ratio": round(ratio, 3),
+            "offenders": offenders[:10],
+            "verdict": "REGRESSED" if regressed else "ok",
+        }
+        if regressed:
+            out["ok"] = False
+    out["compared_suites"] = compared
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+def _seed_last_good() -> int:
+    """One ledger entry derived from `.bench_last_good.json` — the
+    bootstrap for repos whose history predates the ledger."""
+    good = _load_last_good()
+    if not good:
+        print(json.dumps({"ok": False, "error": "no .bench_last_good"}))
+        return 1
+    suites = {k: {"geomean_ms": v.get("geomean_ms"),
+                  "coverage": v.get("coverage"),
+                  "per_query_ms": dict(v.get("per_query_ms") or {})}
+              for k, v in good.items() if isinstance(v, dict)}
+    entry = append_run(suites, source="seed:.bench_last_good.json")
+    print(json.dumps({"ok": True, "appended": entry["ts"],
+                      "suites": sorted(entry["suites"])}))
+    return 0
+
+
+def _append_artifact(path: str) -> int:
+    with open(path) as f:
+        d = json.load(f)
+    # driver wrapper {parsed: {...}} or raw bench stdout {suites: {...}}
+    if isinstance(d.get("parsed"), dict):
+        d = d["parsed"]
+    suites = d.get("suites") or {}
+    entry = append_run(suites, source=os.path.basename(path))
+    print(json.dumps({"ok": True, "appended": entry["ts"],
+                      "suites": sorted(entry["suites"])}))
+    return 0
+
+
+def main(argv) -> int:
+    if "--gate" in argv:
+        return gate()
+    if "--seed-last-good" in argv:
+        return _seed_last_good()
+    if "--append" in argv:
+        i = argv.index("--append")
+        if i + 1 >= len(argv):
+            print("--append needs an artifact path", file=sys.stderr)
+            return 2
+        return _append_artifact(argv[i + 1])
+    print(__doc__, file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -135,6 +135,31 @@ if [ "$mrc" -ne 0 ]; then
     exit "$mrc"
 fi
 
+echo "== compiled-program observatory gate (roofline rows, EXPLAIN block, lever-off byte-equal) =="
+# the program-roofline floor: the fused bench join must land a
+# .sys/compiled_programs row with NONZERO compiler-sourced flops+bytes
+# (or an explicit cost='unavailable' stamp — never silent zeros), a
+# measured utilization % + bound-class, EXPLAIN ANALYZE must print the
+# `-- programs:` block, inventory hit counts must match the ProgramCache
+# counters, and YDB_TPU_PROGSTATS=0 must be byte-equal with prog/* frozen
+JAX_PLATFORMS=cpu python scripts/prog_gate.py
+prc=$?
+if [ "$prc" -ne 0 ]; then
+    echo "compiled-program observatory gate FAILED (rc=$prc)" >&2
+    exit "$prc"
+fi
+
+echo "== bench trajectory regression gate (history vs last-known-good) =="
+# the newest BENCH_HISTORY.jsonl entry must not regress any suite's
+# geomean >25% vs .bench_last_good.json (offending queries named); a
+# missing ledger fails — the trajectory is a committed artifact
+python scripts/bench_history.py --gate
+hrc=$?
+if [ "$hrc" -ne 0 ]; then
+    echo "bench trajectory gate FAILED (rc=$hrc)" >&2
+    exit "$hrc"
+fi
+
 echo "== DQ two-worker smoke (scan→join→agg over hash-shuffle edges) =="
 # two real OS worker processes; gates on result correctness AND the
 # dq/* counters being non-zero on router + workers (a refactor that

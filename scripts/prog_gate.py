@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""CI gate for the compiled-program observatory (`utils/progstats.py`).
+
+Deterministic floor on the CPU runner (single subprocess so the
+process-global inventory starts clean):
+
+  1. an SF1-shaped fused bench join (fact×dim group-by) lands a
+     `.sys/compiled_programs` row for its fused program with NONZERO
+     compiler-sourced flops+bytes — or an explicit `cost='unavailable'`
+     stamp where the backend withholds analysis, never silent zeros —
+     plus a measured utilization %, a bound-class, and hit counts that
+     grow on the second (cache-hit) run;
+  2. EXPLAIN ANALYZE prints the `-- programs:` block with a roofline
+     bound-class on it;
+  3. the per-stage ProgramCache's inventory hit counts match the
+     cache's own counters (kind='program' rows vs `_GLOBAL_CACHE.hits`
+     — exercised through the portioned path, enable_fused off);
+  4. `YDB_TPU_PROGSTATS=0` re-runs the join byte-equal with every
+     `prog/*` counter frozen and the sysview empty.
+
+Prints one JSON line; exit 0 = green.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+ROWS = 4000
+NKEYS = 31
+JOIN_SQL = ("select k, count(*) as n, sum(v) as s, sum(x) as sx "
+            "from t, u where k = uid group by k order by k")
+
+BOUNDS = ("memory_bound", "compute_bound", "launch_bound")
+
+
+def mk_engine():
+    import numpy as np
+    import pandas as pd
+
+    from ydb_tpu.query import QueryEngine
+
+    eng = QueryEngine(block_rows=1 << 13)
+    eng.execute("create table t (id Int64 not null, k Int64 not null, "
+                "v Double not null, primary key (id)) "
+                "with (store = column)")
+    ids = np.arange(ROWS, dtype=np.int64)
+    df = pd.DataFrame({"id": ids, "k": ids % NKEYS, "v": ids * 0.5})
+    t = eng.catalog.table("t")
+    t.bulk_upsert(df, eng._next_version())
+    t.indexate()
+    eng.execute("create table u (uid Int64 not null, x Double not null, "
+                "primary key (uid))")
+    uids = np.arange(NKEYS, dtype=np.int64)
+    du = pd.DataFrame({"uid": uids, "x": 10.0 + uids * 0.25})
+    u = eng.catalog.table("u")
+    u.bulk_upsert(du, eng._next_version())
+    u.indexate()
+    eng.prewarm()
+    return eng
+
+
+def child() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from ydb_tpu.ops.xla_exec import _GLOBAL_CACHE
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    out = {"ok": False}
+    eng = mk_engine()
+
+    # -- 1: fused program inventory row with honest cost ----------------
+    res_on = eng.query(JOIN_SQL)             # fresh compile + execute
+    eng.query(JOIN_SQL)                      # cache hit + execute
+    inv = eng.query("select program, kind, state, hits, misses, cost, "
+                    "flops, bytes_accessed, utilization_pct, "
+                    "bound_class, device_ms, compile_ms "
+                    "from `.sys/compiled_programs` "
+                    "where kind = 'fused'")
+    out["fused_rows"] = len(inv)
+    fused_ok = False
+    if len(inv):
+        r = inv.iloc[inv["device_ms"].to_numpy().argmax()]
+        out["fused_program"] = {
+            "program": r["program"], "cost": r["cost"],
+            "flops": float(r["flops"]),
+            "bytes_accessed": float(r["bytes_accessed"]),
+            "utilization_pct": float(r["utilization_pct"]),
+            "bound_class": r["bound_class"], "hits": int(r["hits"]),
+            "compile_ms": float(r["compile_ms"]),
+        }
+        if r["cost"] == "ok":
+            # compiler-sourced flops AND bytes must be nonzero — a
+            # cost='ok' row with zeros is exactly the fabrication the
+            # ISSUE forbids
+            fused_ok = (float(r["flops"]) > 0
+                        and float(r["bytes_accessed"]) > 0
+                        and r["bound_class"] in BOUNDS
+                        and float(r["utilization_pct"]) > 0
+                        and int(r["hits"]) >= 1)
+        else:
+            # explicit backend-unavailable stamp is the honest degrade
+            fused_ok = (r["cost"] == "unavailable"
+                        and r["bound_class"] == "unavailable")
+
+    # -- 2: EXPLAIN ANALYZE prints the programs block --------------------
+    plan = eng.query(f"explain analyze {JOIN_SQL}")
+    text = "\n".join(str(x) for x in plan["plan"])
+    out["explain_has_block"] = "-- programs:" in text
+    out["explain_has_bound"] = any(b in text for b in BOUNDS) \
+        or "unavailable" in text
+    explain_ok = out["explain_has_block"] and out["explain_has_bound"]
+
+    # -- 3: ProgramCache counters vs inventory hit counts ----------------
+    eng.executor.enable_fused = False
+    try:
+        eng.query("select k, sum(v) as s from t group by k order by k")
+        eng.query("select k, sum(v) as s from t group by k order by k")
+    finally:
+        eng.executor.enable_fused = True
+    pc = eng.query("select hits from `.sys/compiled_programs` "
+                   "where kind = 'program'")
+    inv_hits = int(pc["hits"].sum()) if len(pc) else 0
+    out["program_cache"] = {"cache_hits": int(_GLOBAL_CACHE.hits),
+                           "inventory_hits": inv_hits,
+                           "rows": len(pc)}
+    cache_ok = len(pc) > 0 and inv_hits == _GLOBAL_CACHE.hits > 0
+
+    # -- 4: lever off — byte-equal, counters frozen, sysview empty -------
+    prog_keys = ("prog/registered", "prog/executions", "prog/device_ms",
+                 "prog/compile_ms", "prog/evicted", "prog/recompiled",
+                 "prog/cost_unavailable", "prog/aot_errors",
+                 "prog/aot_fallbacks")
+    os.environ["YDB_TPU_PROGSTATS"] = "0"
+    try:
+        before = {k: GLOBAL.get(k) for k in prog_keys}
+        res_off = eng.query(JOIN_SQL)
+        frozen = all(GLOBAL.get(k) == v for k, v in before.items())
+        empty = len(eng.query(
+            "select program from `.sys/compiled_programs`")) == 0
+        byte_equal = list(res_on.columns) == list(res_off.columns) \
+            and len(res_on) == len(res_off) \
+            and all(np.array_equal(res_on[c].to_numpy(),
+                                   res_off[c].to_numpy())
+                    for c in res_on.columns)
+    finally:
+        os.environ.pop("YDB_TPU_PROGSTATS", None)
+    out["lever_off_frozen"] = bool(frozen)
+    out["lever_off_sysview_empty"] = bool(empty)
+    out["lever_off_byte_equal"] = bool(byte_equal)
+
+    out["ok"] = bool(fused_ok and explain_ok and cache_ok and frozen
+                     and empty and byte_equal)
+    for name, v in (("fused_ok", fused_ok), ("explain_ok", explain_ok),
+                    ("cache_ok", cache_ok)):
+        out[name] = bool(v)
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 1
+
+
+def main() -> int:
+    if os.environ.get("PROG_GATE_CHILD") == "1":
+        return child()
+    env = dict(os.environ)
+    env["PROG_GATE_CHILD"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("YDB_TPU_PROGSTATS", None)
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, timeout=900)
+    return r.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,6 +115,61 @@ def test_eviction_releases_executables():
     assert inner1.cleared == 1 and inner2.cleared == 1
 
 
+def test_evicted_program_recompile_is_miss_not_hit(monkeypatch):
+    """The eviction-accounting companion of the PR-4 spurious-evict fix
+    (overwrite-in-place must NOT evict — pinned above in
+    test_eviction_releases_executables): a real LRU eviction must
+    surface in the program inventory (`prog/evicted`, the entry
+    persisting marked `evicted`), and re-running the evicted shape must
+    count a ProgramCache MISS that re-records compile_ms — never a
+    hit against a released executable."""
+    from ydb_tpu.ops.exec_cache import GLOBAL_BUDGET
+    from ydb_tpu.ops.xla_exec import _GLOBAL_CACHE
+    from ydb_tpu.query import QueryEngine
+    from ydb_tpu.utils import progstats
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    monkeypatch.setenv("YDB_TPU_PARAM_LIFT", "0")
+    eng = QueryEngine(block_rows=1 << 12)
+    eng.execute("create table ev (k Int64 not null, a Int64, b Double, "
+                "primary key (k))")
+    eng.execute("insert into ev (k, a, b) values "
+                + ", ".join(f"({i}, {i % 5}, {i * 0.5})"
+                            for i in range(120)))
+    # portioned path → per-stage ProgramCache programs
+    eng.executor.enable_fused = False
+    old_max = GLOBAL_BUDGET.max_entries
+    GLOBAL_BUDGET.max_entries = 4
+    try:
+        base = "select count(*) as n from ev where a = 0"
+        assert int(eng.query(base).n[0]) == 24
+        ev0 = GLOBAL.get("prog/evicted")
+        # flood with distinct literal shapes (lift off → distinct
+        # programs) until the base query's programs are LRU victims
+        for i in range(1, 9):
+            eng.query(f"select count(*) as n from ev where a = {i % 5} "
+                      f"and k >= {i * 7}")
+        assert GLOBAL.get("prog/evicted") > ev0, \
+            "LRU evictions must emit prog/evicted"
+        evicted = [r for r in progstats.inventory_rows()
+                   if r["kind"] == "program" and r["state"] == "evicted"]
+        assert evicted, "evicted entries must persist in the inventory"
+        h0, m0 = _GLOBAL_CACHE.hits, _GLOBAL_CACHE.misses
+        assert int(eng.query(base).n[0]) == 24
+        assert _GLOBAL_CACHE.misses > m0, \
+            "re-running an evicted shape must MISS and recompile"
+        # at least one program re-registered: compiles grew past 1 with
+        # its eviction history kept
+        recompiled = [r for r in progstats.inventory_rows()
+                      if r["kind"] == "program" and r["compiles"] >= 2
+                      and r["evictions"] >= 1]
+        assert recompiled, "recompile must re-record in the inventory"
+        assert all(r["state"] == "live" for r in recompiled)
+    finally:
+        GLOBAL_BUDGET.max_entries = old_max
+        eng.executor.enable_fused = True
+
+
 def test_literal_storm_compiles_one_program():
     """THE param-lifting regression pin (the PR-6 tentpole vs the Weak #3
     executable-accumulation class): a 64-query literal-varying

@@ -44,10 +44,13 @@ MODULES = ("ydb_tpu/ops/", "ydb_tpu/dq/", "ydb_tpu/parallel/")
 # observability data (span trees, profile records) with NO device code
 # reachable — they never need transfer pragmas even if they land inside
 # a scanned prefix someday. `utils/critpath.py` walks span dicts;
-# `utils/chrometrace.py` renders them to JSON.
+# `utils/chrometrace.py` renders them to JSON; `utils/progstats.py`
+# reads compiler-side cost/memory analysis at compile time (plus a
+# one-shot peak micro-probe) — never in a per-row hot loop.
 ANALYSIS_SIDE = frozenset((
     "ydb_tpu/utils/critpath.py",
     "ydb_tpu/utils/chrometrace.py",
+    "ydb_tpu/utils/progstats.py",
 ))
 _CASTS = ("float", "int", "bool")
 _TRANSFER_OK_RE = re.compile(r"lint:\s*transfer-ok\(([^)]*)\)")

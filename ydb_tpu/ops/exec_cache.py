@@ -77,10 +77,12 @@ class _Budget:
         every other thread's compile-cache insert."""
         with self._mu:
             dropped = self._evict_to_fit_locked(incoming)
-        for v in dropped:
-            release_executable(v)
+        _release_dropped(dropped)
 
     def _evict_to_fit_locked(self, incoming: int) -> list:
+        """Returns [(cache, key, value)] victims for the caller to
+        release (and to report to the cache's eviction hook) outside
+        the locks."""
         dropped = []
         caches = self._live()
         while sum(len(c) for c in caches) + incoming \
@@ -93,10 +95,25 @@ class _Budget:
                     oldest, victim = t, c
             if victim is None:
                 break
-            v = victim._pop_oldest()
-            if v is not _MISSING:
-                dropped.append(v)
+            kv = victim._pop_oldest()
+            if kv is not _MISSING:
+                dropped.append((victim, kv[0], kv[1]))
         return dropped
+
+
+def _release_dropped(dropped: list) -> None:
+    """Release evicted executables and fire each owning cache's
+    `on_evict(key)` hook (outside every lock — the hook feeds the
+    program inventory, `utils/progstats.mark_evicted`, and
+    observability must neither deadlock nor fail an insert)."""
+    for (cache, key, v) in dropped:
+        release_executable(v)
+        hook = cache.on_evict
+        if hook is not None:
+            try:
+                hook(key)
+            except Exception:            # noqa: BLE001 — observability
+                pass
 
 
 GLOBAL_BUDGET = _Budget(int(os.environ.get(
@@ -171,6 +188,10 @@ class ExecCache:
         self.misses = 0
         self.evictions = 0
         self.released = 0
+        # optional eviction hook `fn(key)`, fired AFTER the victim's
+        # executable is released, outside every lock — the program
+        # inventory (`utils/progstats`) marks the entry `evicted` here
+        self.on_evict = None
         self._budget.register(self)
 
     def __len__(self) -> int:
@@ -218,8 +239,7 @@ class ExecCache:
                 self._entries.move_to_end(key)
                 if old is not None and old[0] is not value:
                     self.released += 1
-        for v in dropped:
-            release_executable(v)
+        _release_dropped(dropped)
         if old is not None and old[0] is not value:
             # an overwritten entry's executable must release like an
             # evicted one — a recompile for the same key otherwise leaks
@@ -244,15 +264,16 @@ class ExecCache:
             return first[1]
 
     def _pop_oldest(self):
-        """Pop the LRU entry, returning its value for the budget to
-        release outside the locks (or _MISSING when empty)."""
+        """Pop the LRU entry, returning its (key, value) for the budget
+        to release — and report to `on_evict` — outside the locks
+        (or _MISSING when empty)."""
         with self._mu:
             if not self._entries:
                 return _MISSING
-            _k, (victim, _t) = self._entries.popitem(last=False)
+            k, (victim, _t) = self._entries.popitem(last=False)
             self.evictions += 1
             self.released += 1
-            return victim
+            return (k, victim)
 
 
 class _Missing:

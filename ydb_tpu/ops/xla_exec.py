@@ -933,7 +933,13 @@ class ProgramCache:
 
     def __init__(self):
         from ydb_tpu.ops.exec_cache import ExecCache
+        from ydb_tpu.utils import progstats
         self._cache = ExecCache("program")
+        # eviction surfaces in the program inventory: the entry persists
+        # in `.sys/compiled_programs` marked `evicted`, and a re-compile
+        # of the key counts a MISS that re-records compile_ms
+        self._cache.on_evict = \
+            lambda key: progstats.mark_evicted("program", key)
         self.hits = 0
         self.misses = 0
 
@@ -943,48 +949,75 @@ class ProgramCache:
         # env knobs in-process)
         key = (program.fingerprint(), sig, cap, param_names,
                groupby_tuning())
+        # observability levers cannot stale a program: they choose how
+        # the identical trace is dispatched/recorded, not what it computes
+        # lint: allow-cache-key(progstats/memledger/critpath observe only)
         fn = self._cache.get(key)
         if fn is None:
             self.misses += 1
             fn = self._timed_fill(key, self._build(program, sig, cap))
         else:
             self.hits += 1
+            from ydb_tpu.utils import progstats
+            progstats.record_hit(getattr(fn, "key_id", None))
         return fn
 
     def _timed_fill(self, key, built):
         """Cache-fill wrapper: jax.jit compiles lazily on the FIRST
         invocation, so the fill stores a thin shim that times that call
         (trace + XLA compile + first run) and records it as this
-        program's compile_ms; later calls pay one flag check. The shim
-        delegates `clear_cache` to the jitted fn so ExecCache eviction
-        releases the real executable (a bare closure would silently
+        program's compile_ms; later calls pay one flag check. With the
+        program observatory on (`utils/progstats`, the default), the
+        first call compiles via the explicit AOT path instead —
+        lower().compile(), ONE trace + ONE compile like the lazy path —
+        capturing the executable's cost/memory analysis, and steady-
+        state calls dispatch through the AOT handle. The shim delegates
+        `clear_cache` to whichever target holds the executable so
+        ExecCache eviction releases it (a bare closure would silently
         defeat the release-on-evict lifecycle), and it never overwrites
         the cache entry — an overwrite would spuriously release."""
         import threading as _threading
         import time as _time
+
+        from ydb_tpu.utils import progstats
         timed = [False]
+        target = [built]               # swapped to the AOT handle once
         mu = _threading.Lock()
 
-        def fn(*a, **kw):
+        def shim(*a, **kw):
             if timed[0]:
-                return built(*a, **kw)
+                return target[0](*a, **kw)
             with mu:
                 first = not timed[0]
                 timed[0] = True
             if not first:
                 # lost the first-call race: don't double-count compiles
-                return built(*a, **kw)
+                return target[0](*a, **kw)
             from ydb_tpu.utils.metrics import GLOBAL
             t0 = _time.perf_counter()
-            out = built(*a, **kw)
+            if progstats.enabled():
+                target[0] = progstats.capture("program", key, built, a)
+            out = target[0](*a, **kw)
             ms = (_time.perf_counter() - t0) * 1000.0
             GLOBAL.inc("program_cache/compiles")
             GLOBAL.inc("program_cache/compile_ms", ms)
             return out
 
-        fn.clear_cache = built.clear_cache
-        self._cache[key] = fn
-        return fn
+        def _clear():
+            t = target[0]
+            cc = getattr(t, "clear_cache", None)
+            if callable(cc):
+                cc()                   # the handle clears built too
+            if t is not built:
+                built.clear_cache()
+
+        shim.clear_cache = _clear
+        # the inventory id rides the shim so a later cache HIT can be
+        # attributed without re-hashing the key
+        shim.key_id = progstats.key_id("program", key) \
+            if progstats.enabled() else None
+        self._cache[key] = shim
+        return shim
 
     @staticmethod
     def _build(program: ir.Program, sig, cap):

@@ -437,14 +437,20 @@ class QueryEngine:
         # resource ledger (utils/memledger.py): one per OUTERMOST
         # statement on this thread — a nested execute (EXPLAIN ANALYZE,
         # DQ router merge) contributes to the enclosing ledger
-        from ydb_tpu.utils import memledger
+        from ydb_tpu.utils import memledger, progstats
         led = memledger.open_statement()
+        # program-execution accumulator (utils/progstats.py): same
+        # outermost-statement discipline — feeds QueryStats.programs and
+        # the EXPLAIN ANALYZE `-- programs:` block
+        pst = progstats.open_statement()
         try:
             with ctx, self.tracer.span("statement", sql=sql[:60]):
                 block = self._execute_traced(sql, session, kind_box)
             ok = True
             return block
         finally:
+            if pst is not None:
+                progstats.close_statement(pst)
             if led is not None:
                 memledger.close_statement(led)
                 self._record_memory(sql, kind_box[0] if kind_box else "",
@@ -968,6 +974,13 @@ class QueryEngine:
         led = memledger.current()
         if led is not None:
             stats.memory = led.summary()
+        # program roofline rollup (utils/progstats.py): which compiled
+        # programs this statement executed, their measured device ms
+        # joined to the compiler's cost model — the `-- programs:` block
+        from ydb_tpu.utils import progstats
+        ps = progstats.current()
+        if ps is not None:
+            stats.programs = ps.summary()
         # per-statement critical path over the same span window (the
         # EXPLAIN ANALYZE `-- critical path:` source, joined with the
         # live ledger's bytes); the full-tree extraction with counters

@@ -34,6 +34,7 @@ from ydb_tpu.ops.xla_exec import (
 )
 from ydb_tpu.query.plan import JoinStep, Pipeline, QueryPlan, SortKey
 from ydb_tpu.storage.mvcc import MAX_SNAPSHOT, Snapshot
+from ydb_tpu.utils import progstats
 
 DEFAULT_BLOCK_ROWS = 1 << 20
 
@@ -53,6 +54,16 @@ def _xla_scope(name: str):
         return nullcontext()
 
 
+def _fused_evict_hook(key) -> None:
+    """Map a fused-cache eviction back to its program-inventory kind:
+    batched-lane entries key on a ("batched", ...) tuple, everything
+    else captured from this cache is a fused program (tile entries are
+    not inventoried — mark_evicted on an unknown key is a no-op)."""
+    kind = "batched" if isinstance(key, tuple) and key \
+        and key[0] == "batched" else "fused"
+    progstats.mark_evicted(kind, key)
+
+
 class Executor:
     def __init__(self, catalog, block_rows: int = DEFAULT_BLOCK_ROWS,
                  device_cache=None, mesh=None):
@@ -67,6 +78,11 @@ class Executor:
         # wedged (r4 cleared them manually between queries)
         self._finalize_cache = ExecCache("finalize")
         self._fused_cache = ExecCache("fused")
+        # LRU evictions of fused/batched programs surface in the
+        # program inventory (`.sys/compiled_programs`, state=evicted) —
+        # the cache keys carry a "batched" head for lane entries, so
+        # the kind is recovered from the key itself
+        self._fused_cache.on_evict = _fused_evict_hook
         # device mesh for distributed execution (None / size-1 mesh →
         # single-device). The analog of the KQP task graph + DQ hash-shuffle
         # channels (`dq_tasks_graph.h:43`): scans are row-partitioned across
@@ -356,9 +372,10 @@ class Executor:
             keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
             out_cols = [c for c in schema.columns if c.name in keep] \
                 or list(schema.columns)
-            entry = (fn, layout_box, Schema(out_cols))
-            self._fused_cache[key] = entry
-        fn, layout_box, out_schema = entry
+            out_schema = Schema(out_cols)
+        else:
+            fn, layout_box, out_schema = entry
+            progstats.record_hit(getattr(fn, "key_id", None))
 
         dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
                       for k, v in all_params.items()}
@@ -367,12 +384,25 @@ class Executor:
                 _xla_scope("device-dispatch"):
             import time as _time
             t_disp = _time.perf_counter()
+            if fresh_compile:
+                # fresh shapes compile INSIDE the dispatch span (the
+                # compile stays at the span front for the critical-path
+                # split and the phase breakdown): the program
+                # observatory's AOT capture (`utils/progstats.capture` —
+                # lower().compile(), ONE trace + ONE compile, cost and
+                # memory analysis recorded) under YDB_TPU_PROGSTATS=1,
+                # the legacy lazy-jit first call otherwise
+                fn = progstats.capture(
+                    "fused", key, fn,
+                    (arrays, valids, lengths, build_inputs, dev_params))
+                self._fused_cache[key] = (fn, layout_box, out_schema)
             data_stacks, valid_stack, length = fn(arrays, valids, lengths,
                                                   build_inputs, dev_params)
             if fresh_compile:
                 # jit compiles synchronously inside the first call of a
-                # fresh shape; steady-state dispatch is ~async enqueue —
-                # the delta IS this program's trace+compile cost
+                # fresh shape (AOT: in capture above); steady-state
+                # dispatch is ~async enqueue — the delta IS this
+                # program's trace+compile cost
                 dsp.attrs["compile_ms"] = round(
                     (_time.perf_counter() - t_disp) * 1000.0, 3)
         # result buffers live in HBM until the future drains them
@@ -390,6 +420,8 @@ class Executor:
         lo = plan.offset or 0
         limit = plan.limit
 
+        prog_kid = getattr(fn, "key_id", None)
+
         def fetch() -> HostBlock:
             # split the readout into on-device execute (block_until_ready
             # delta — the program is still running when the future is
@@ -397,7 +429,13 @@ class Executor:
             # the trace attributes device time separately from link time
             with self._span("device-execute"), \
                     _xla_scope("device-execute"):
+                import time as _time
+                t_exec = _time.perf_counter()
                 jax.block_until_ready((data_stacks, valid_stack, length))
+                exec_ms = (_time.perf_counter() - t_exec) * 1000.0
+            # roofline join: the measured device-execute delta against
+            # this program's compiler-reported flops/bytes
+            progstats.record_exec(prog_kid, exec_ms, fresh=fresh_compile)
             with self._span("readout-transfer"):
                 block = F.fetch_fused_result(data_stacks, valid_stack,
                                              length, layout_box,
@@ -645,6 +683,9 @@ class Executor:
                                      lim_key=lim_key)
         key = ("batched", base_key, Bb, mapped)
         keep = tuple(dict.fromkeys(n for (n, _lbl) in plan.output))
+        # observability levers cannot stale a program: they choose how
+        # the identical trace is dispatched/recorded, not what it computes
+        # lint: allow-cache-key(progstats/memledger/critpath observe only)
         cached = self._fused_cache.get(key)
         if cached is None:
             fn, layout_box = F.build_fused_batched_fn(
@@ -656,6 +697,7 @@ class Executor:
             out_schema = Schema(out_cols)
         else:
             fn, layout_box, out_schema = cached
+            progstats.record_hit(getattr(fn, "key_id", None))
 
         dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray)
                           else v) for k, v in stacked.items()}
@@ -666,6 +708,15 @@ class Executor:
                     _xla_scope("device-dispatch-batched"):
                 import time as _time
                 t_disp = _time.perf_counter()
+                if cached is None:
+                    # AOT capture for the stacked program too (compile
+                    # inside the dispatch span; a trace error re-raises
+                    # at the call below and the lane falls back
+                    # per-member exactly as before)
+                    fn = progstats.capture(
+                        "batched", key, fn,
+                        (arrays, valids, lengths, build_inputs,
+                         dev_params))
                 data_stacks, valid_stack, length = fn(
                     arrays, valids, lengths, build_inputs, dev_params)
                 if cached is None:
@@ -693,7 +744,12 @@ class Executor:
         out_dicts.update({n2: d for n2, d in plan.result_dicts.items()
                           if out_schema.has(n2)})
         with self._span("device-execute"), _xla_scope("device-execute"):
+            import time as _time
+            t_exec = _time.perf_counter()
             jax.block_until_ready((data_stacks, valid_stack, length))
+            exec_ms = (_time.perf_counter() - t_exec) * 1000.0
+        progstats.record_exec(getattr(fn, "key_id", None), exec_ms,
+                              fresh=cached is None)
         with self._span("readout-transfer", b=len(members)):
             blocks = F.fetch_fused_batch(data_stacks, valid_stack, length,
                                          layout_box, out_schema, out_dicts,

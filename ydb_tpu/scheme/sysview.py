@@ -21,7 +21,7 @@ PREFIX = ".sys/"
 VIEWS = ("tables", "partition_stats", "counters", "query_metrics",
          "top_queries_by_duration", "dq_stage_stats", "query_profiles",
          "cluster_nodes", "query_memory", "device_transfers",
-         "query_critical_path")
+         "query_critical_path", "compiled_programs")
 
 
 def is_sysview(name: str) -> bool:
@@ -245,6 +245,65 @@ def sysview_block(engine, name: str) -> HostBlock:
                              ("dominant_span", str),
                              ("dominant_class", str),
                              ("dominant_ms", "float64")])
+    if view == "compiled_programs":
+        # the compiled-program inventory (utils/progstats.py, process-
+        # wide like device_transfers): one row per captured executable —
+        # cache hit/miss/eviction counts, compile wall, the XLA cost +
+        # memory analysis, cumulative measured device ms and the
+        # roofline verdict. Evicted entries persist marked `evicted`;
+        # `cost` is an explicit 'unavailable' where the backend
+        # withholds analysis (never fabricated zeros). Empty under
+        # YDB_TPU_PROGSTATS=0.
+        from ydb_tpu.utils.progstats import inventory_rows
+        rows = [{
+            "program": r["program"], "kind": r["kind"],
+            "state": r["state"], "hits": int(r["hits"]),
+            "misses": int(r["misses"]),
+            "evictions": int(r["evictions"]),
+            "compiles": int(r["compiles"]),
+            "compile_ms": float(r["compile_ms"]),
+            "cost": r["cost"],
+            "flops": float(r["flops"]),
+            "transcendentals": float(r["transcendentals"]),
+            "bytes_accessed": float(r["bytes_accessed"]),
+            "output_bytes": float(r["output_bytes"]),
+            "hlo_ops": int(r["hlo_ops"]),
+            "arg_bytes": int(r["arg_bytes"]),
+            "out_bytes": int(r["out_bytes"]),
+            "temp_bytes": int(r["temp_bytes"]),
+            "code_bytes": int(r["code_bytes"]),
+            "execs": int(r["execs"]),
+            "device_ms": float(r["device_ms"]),
+            "device_ms_max": float(r["device_ms_max"]),
+            "achieved_gflops": float(r["achieved_gflops"]),
+            "achieved_gbps": float(r["achieved_gbps"]),
+            "intensity": float(r["intensity"]),
+            "utilization_pct": float(r["utilization_pct"]),
+            "bound_class": r["bound_class"],
+        } for r in inventory_rows()]
+        return _block(rows, [("program", str), ("kind", str),
+                             ("state", str), ("hits", "int64"),
+                             ("misses", "int64"),
+                             ("evictions", "int64"),
+                             ("compiles", "int64"),
+                             ("compile_ms", "float64"), ("cost", str),
+                             ("flops", "float64"),
+                             ("transcendentals", "float64"),
+                             ("bytes_accessed", "float64"),
+                             ("output_bytes", "float64"),
+                             ("hlo_ops", "int64"),
+                             ("arg_bytes", "int64"),
+                             ("out_bytes", "int64"),
+                             ("temp_bytes", "int64"),
+                             ("code_bytes", "int64"),
+                             ("execs", "int64"),
+                             ("device_ms", "float64"),
+                             ("device_ms_max", "float64"),
+                             ("achieved_gflops", "float64"),
+                             ("achieved_gbps", "float64"),
+                             ("intensity", "float64"),
+                             ("utilization_pct", "float64"),
+                             ("bound_class", str)])
     if view == "device_transfers":
         # the host-transfer flight recorder's recent-transfer ring
         # (utils/memledger.py, process-wide): one row per recorded

@@ -337,6 +337,25 @@ COUNTER_REGISTRY = {
     "program_cache/compile_ms": "[viz] cumulative compile wall",
     "program_cache/hits": "(derived) ProgramCache hits",
     "program_cache/misses": "(derived) ProgramCache misses",
+    # -- compiled-program observatory (utils/progstats.py): XLA cost-model
+    # roofline accounting per compiled executable ---------------------------
+    "prog/registered":
+        "[viz] programs captured with compile-time cost/memory analysis",
+    "prog/compile_ms": "[viz] cumulative AOT lower+compile wall",
+    "prog/executions":
+        "[viz] measured device executions joined to a program",
+    "prog/device_ms": "[viz] cumulative measured device-execute wall",
+    "prog/evicted": "[viz] inventory entries marked evicted (LRU)",
+    "prog/recompiled":
+        "[viz] evicted keys compiled again (a MISS, never a hit)",
+    "prog/cost_unavailable":
+        "[viz] programs whose backend withheld cost analysis",
+    "prog/aot_errors":
+        "[viz] AOT captures that failed (the legacy jit path ran)",
+    "prog/aot_fallbacks":
+        "[viz] AOT calls re-dispatched via jit (aval/device drift)",
+    "prog/utilization_pct":
+        "[hist] per-execution roofline utilization (% of peak)",
     "device_cache/hits": "(derived) HBM column cache hits",
     "device_cache/misses": "(derived) HBM column cache misses",
     "device_cache/bytes": "(derived) HBM column cache residency",
@@ -412,6 +431,12 @@ class QueryStats:
     # % of wall, coverage, the dominant span — the blocking chain, not
     # another aggregate. Empty when unsampled or YDB_TPU_CRITPATH=0.
     critical_path: dict = field(default_factory=dict)
+    # compiled-program roofline rollup (`utils/progstats.py`): the
+    # programs this statement executed with their measured device ms
+    # joined to the XLA cost model — {n, device_ms, utilization_pct,
+    # bound_class, programs: [...]}. Empty when no instrumented program
+    # ran or YDB_TPU_PROGSTATS=0.
+    programs: dict = field(default_factory=dict)
 
     def render(self) -> str:
         path = ("mesh-distributed" if self.distributed
@@ -465,6 +490,37 @@ class QueryStats:
                 line += f", {m['to_pandas_in_plan']} to_pandas-in-plan"
             line += ")"
             out += line
+        if self.programs and self.programs.get("programs"):
+            p = self.programs
+            head = (f"\n-- programs: {p['n']} | "
+                    f"device {p['device_ms']:.2f}ms")
+            if p.get("utilization_pct") is not None:
+                head += f" | utilization {p['utilization_pct']:.1f}%"
+            if p.get("bound_class"):
+                head += f" | {p['bound_class']}"
+            out += head
+            for pr in p["programs"][:6]:
+                line = (f"\n--   {pr['key']}"
+                        f"{' [fresh]' if pr.get('fresh') else ''}: ")
+                if pr.get("bound_class") == "unavailable" \
+                        or pr.get("flops") is None:
+                    line += ("cost unavailable (backend withheld "
+                             "analysis)")
+                else:
+                    line += (f"flops {pr['flops']:.4g} "
+                             f"bytes {pr['bytes_accessed']:.4g}")
+                    if pr.get("intensity") is not None:
+                        line += f" (intensity {pr['intensity']:.2f})"
+                line += f" | device {pr['device_ms']:.2f}ms"
+                if pr.get("achieved_gflops") is not None:
+                    line += (f" -> {pr['achieved_gflops']:.2f} GFLOP/s, "
+                             f"{pr['achieved_gbps']:.2f} GB/s")
+                if pr.get("utilization_pct") is not None:
+                    line += f" | {pr['utilization_pct']:.1f}% of peak"
+                if pr.get("bound_class") \
+                        and pr["bound_class"] != "unavailable":
+                    line += f" | {pr['bound_class']}"
+                out += line
         if self.critical_path:
             from ydb_tpu.utils.critpath import render_lines
             lines = render_lines(self.critical_path)

@@ -6,10 +6,11 @@ programs) over generated TPC-H data — the measured analog of the
 reference's `ydb workload tpch run` (no published numbers exist in-repo;
 see BASELINE.md). Suites at each scale factor in BENCH_SUITE_SFS
 (default "1,10"): best-of-N per query, geomean reported; at SF ≤ 1 every
-query is oracle-gated, above that a fast subset gates. Queries whose
-FUSED compile is known to wedge the platform (q8/q10/q18) get one timed
-run through the portioned fallback, stamped `fallback: true`, so TPC-H
-coverage reports 22/22 honestly. The ClickBench leg
+query is oracle-gated, above that a fast subset gates. All 22 TPC-H
+queries run the fused path in the main pass (the historic q8/q10/q18
+fallback class is retired by the bounds lattice); the
+BENCH_FALLBACK_QUERIES escape hatch can portioned-rescue a NEW wedge
+class, stamped `fallback: true`. The ClickBench leg
 (BENCH_CLICKBENCH_ROWS, default 1M rows; 0 disables) runs all 43
 queries over the generated hits table under the same watchdog /
 blacklist / last_known_good machinery.
@@ -64,14 +65,15 @@ SUITE_REPEATS = int(os.environ.get("BENCH_SUITE_REPEATS", "2"))
 # explanation — BENCH_r05's bare zero)
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
 GATE_BIG = ("q1", "q6", "q12", "q14")
-# TPC-H queries whose FUSED compile historically wedges/crashes the
-# remote service (q8 7-join SIGSEGV, q10/q18 compile wedge): after the
-# main pass they get ONE timed run through the capped portioned path
-# (enable_fused off — many small per-portion programs, no giant fused
-# shape) so the suite can report 22/22 with honest `fallback: true`
-# numbers instead of a permanent coverage hole
+# capped-portioned fallback ESCAPE HATCH (default: none). The historic
+# q8/q10/q18 class — fused compiles that wedged/crashed the remote
+# service — is retired: the bounds lattice (`query/bounds.py`, PR 15)
+# carries proven cardinality through those plans (carry-key sort
+# reduction, eager-aggregated LEFT JOIN builds), so they run the fused
+# path and time honestly in the main pass. The env lever remains for
+# triaging a NEW wedge class without losing coverage.
 FALLBACK_QUERIES = [q for q in os.environ.get(
-    "BENCH_FALLBACK_QUERIES", "q8,q10,q18").split(",") if q]
+    "BENCH_FALLBACK_QUERIES", "").split(",") if q]
 # ClickBench leg: the 43-query suite (tests/clickbench_util.py) over a
 # generated hits table at this row count — the UDF/LUT string engine's
 # on-chip numbers. Pandas-oracle-gated up to CLICKBENCH_ORACLE_ROWS;

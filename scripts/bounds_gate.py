@@ -1,0 +1,200 @@
+"""CI gate: the bounds lattice is live and the fallback class stays
+retired (CPU runner).
+
+Four deterministic legs over the canonical bench-join shape
+(fact⋈dim grouped by the probe key + build payloads — the q3/q10 shape
+whose sorted group-by dominates the SF1 tail):
+
+  * carry rewrite live — the executor demotes the functionally
+    determined payload keys out of the sort identity
+    (`bounds/carry_rewrites` delta ≥ 1, ≥ 2 carried keys traced) and
+    the per-statement trace reports NONZERO tightening (proven rows
+    strictly under the capacity rows the same trace retired);
+  * eager aggregation live — a q13-shaped LEFT JOIN consumed only
+    through count() pre-aggregates its build and runs the FUSED path
+    (`bounds/eager_agg_rewrites` delta ≥ 1), pandas-verified;
+  * the lever — YDB_TPU_BOUNDS=0 must replan + recompile to
+    capacity-sized execution and return byte-equal rows (the lever
+    rides the plan fingerprint and `groupby_tuning`, so in-process
+    flips cannot reuse bound-shaped artifacts);
+  * EXPLAIN carries the `-- bounds:` line for the bench join.
+
+Plus the LEDGER pin: the newest BENCH_HISTORY.jsonl entry carrying an
+sf1 suite must report 22/22 coverage with an EMPTY `fallbacks` list and
+q8/q10/q18 timed in `per_query_ms` — a change that reintroduces the
+`fallback: true` stamping path (or loses one of the three retired
+queries) fails CI even if every unit test stays green. The geomean
+trajectory itself is `scripts/bench_history.py --gate`'s job, which
+ci.sh runs right after this gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("YDB_TPU_BOUNDS", None)   # default-on lattice
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+HISTORY_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "BENCH_HISTORY.jsonl")
+RETIRED = ("q8", "q10", "q18")
+
+FACT_ROWS = 20_000
+DIM_ROWS = 5_000
+
+SQL = ("select li.okey as okey, odate, oprio, sum(val) as rev, "
+       "count(*) as c from li join ord on li.okey = ord.okey "
+       "group by li.okey, odate, oprio order by okey")
+
+SQL13 = ("select ord.okey as okey, count(li.lid) as c "
+         "from ord left join li on ord.okey = li.okey "
+         "group by ord.okey order by okey")
+
+
+def build_engine():
+    from ydb_tpu.query import QueryEngine
+    eng = QueryEngine(block_rows=1 << 20)
+    eng.execute("create table li (lid Int64 not null, okey Int64 not null, "
+                "val Double not null, primary key (lid)) "
+                "with (store = column)")
+    eng.execute("create table ord (okey Int64 not null, "
+                "odate Int64 not null, oprio Int64 not null, "
+                "primary key (okey)) with (store = column)")
+    rng = np.random.default_rng(20260804)
+    li = pd.DataFrame({
+        "lid": np.arange(FACT_ROWS, dtype=np.int64),
+        "okey": rng.integers(0, DIM_ROWS, FACT_ROWS),
+        "val": rng.normal(size=FACT_ROWS) * 100,
+    })
+    od = pd.DataFrame({
+        "okey": np.arange(DIM_ROWS, dtype=np.int64),
+        "odate": rng.integers(8000, 11000, DIM_ROWS),
+        "oprio": rng.integers(0, 5, DIM_ROWS),
+    })
+    ver = eng._next_version()
+    for name, df in (("li", li), ("ord", od)):
+        t = eng.catalog.table(name)
+        t.bulk_upsert(df, ver)
+        t.indexate()
+    return eng, li, od
+
+
+def byte_equal(a: pd.DataFrame, b: pd.DataFrame) -> bool:
+    if list(a.columns) != list(b.columns) or len(a) != len(b):
+        return False
+    for col in a.columns:
+        xa, xb = a[col].to_numpy(), b[col].to_numpy()
+        na, nb = pd.isna(xa), pd.isna(xb)
+        if not (na == nb).all() or not (xa[~na] == xb[~nb]).all():
+            return False
+    return True
+
+
+def ledger_pin() -> list:
+    errs = []
+    newest = None
+    try:
+        with open(HISTORY_PATH) as f:
+            for line in f:
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "sf1" in (e.get("suites") or {}):
+                    newest = e
+    except FileNotFoundError:
+        return [f"{HISTORY_PATH} missing — the trajectory is a committed "
+                "artifact"]
+    if newest is None:
+        return ["no BENCH_HISTORY.jsonl entry carries an sf1 suite"]
+    s = newest["suites"]["sf1"]
+    if s.get("coverage") != "22/22":
+        errs.append(f"newest sf1 coverage {s.get('coverage')!r} != 22/22")
+    if s.get("fallbacks"):
+        errs.append(f"newest sf1 entry stamps fallbacks {s['fallbacks']} — "
+                    "the retired class is back")
+    per_q = s.get("per_query_ms") or {}
+    for q in RETIRED:
+        if not per_q.get(q):
+            errs.append(f"{q} missing from the newest sf1 per_query_ms — "
+                        "the retired class lost coverage")
+    return errs
+
+
+def main() -> int:
+    from ydb_tpu.utils.metrics import GLOBAL
+    eng, li, od = build_engine()
+
+    names = ("bounds/carry_rewrites", "bounds/eager_agg_rewrites",
+             "bounds/fd_checks")
+    before = {n: GLOBAL.get(n) for n in names}
+    on_df = eng.query(SQL)
+    delta = {n: GLOBAL.get(n) - before[n] for n in names}
+    tr = dict(eng.last_stats.bounds or {})
+
+    explain_txt = "\n".join(
+        eng.query("explain " + SQL).iloc[:, 0].astype(str))
+
+    got13 = eng.query(SQL13)
+    delta["bounds/eager_agg_rewrites"] = (
+        GLOBAL.get("bounds/eager_agg_rewrites")
+        - before["bounds/eager_agg_rewrites"])
+    path13 = eng.executor.last_path
+
+    os.environ["YDB_TPU_BOUNDS"] = "0"
+    try:
+        off_df = eng.query(SQL)
+        off13 = eng.query(SQL13)
+    finally:
+        os.environ.pop("YDB_TPU_BOUNDS", None)
+
+    report = {"carry": delta, "trace": tr, "path13": path13,
+              "ledger": os.path.basename(HISTORY_PATH)}
+    print(json.dumps(report), flush=True)
+
+    errs = []
+    if delta["bounds/carry_rewrites"] < 1:
+        errs.append("no carry rewrite fired on the bench join")
+    if tr.get("carried_keys", 0) < 2:
+        errs.append(f"carried_keys {tr.get('carried_keys', 0)} < 2 — "
+                    "odate/oprio stayed in the sort identity")
+    proven, cap = tr.get("proven_rows", 0), tr.get("capacity_rows", 0)
+    if not proven or not cap or proven >= cap:
+        errs.append(f"no bounds tightening traced (proven {proven} vs "
+                    f"capacity {cap})")
+    if "-- bounds:" not in explain_txt:
+        errs.append("EXPLAIN lost the `-- bounds:` line")
+    if delta["bounds/eager_agg_rewrites"] < 1:
+        errs.append("eager aggregation did not fire on the q13 shape")
+    if path13 != "fused":
+        errs.append(f"q13 shape ran {path13!r}, not fused — the expanding "
+                    "probe is back")
+    j = od.merge(li, on="okey", how="left")
+    want13 = (j.groupby("okey").lid.count().reset_index(name="c")
+              .sort_values("okey").reset_index(drop=True))
+    if not (got13["c"].to_numpy().astype(np.int64)
+            == want13["c"].to_numpy().astype(np.int64)).all():
+        errs.append("q13-shape counts mismatch pandas")
+    if not byte_equal(on_df, off_df):
+        errs.append("YDB_TPU_BOUNDS=0 is not byte-equal on the bench join")
+    if not byte_equal(got13, off13):
+        errs.append("YDB_TPU_BOUNDS=0 is not byte-equal on the q13 shape")
+    errs += ledger_pin()
+
+    if errs:
+        for e in errs:
+            print(f"bounds gate FAILED: {e}", file=sys.stderr)
+        return 1
+    print("bounds gate ok", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

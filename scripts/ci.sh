@@ -149,6 +149,20 @@ if [ "$prc" -ne 0 ]; then
     exit "$prc"
 fi
 
+echo "== bounds-lattice gate (carry rewrite, eager agg, lever byte-equal, fallback class stays retired) =="
+# the bounds floor: the bench join must trace a carry rewrite with
+# nonzero proven-vs-capacity tightening and keep its `-- bounds:`
+# EXPLAIN line, the q13 LEFT JOIN shape must eager-aggregate onto the
+# fused path, YDB_TPU_BOUNDS=0 must be byte-equal, and the newest
+# BENCH_HISTORY.jsonl sf1 entry must report 22/22 with NO fallbacks
+# (q8/q10/q18 timed fused — the retired class cannot quietly return)
+JAX_PLATFORMS=cpu python scripts/bounds_gate.py
+borc=$?
+if [ "$borc" -ne 0 ]; then
+    echo "bounds-lattice gate FAILED (rc=$borc)" >&2
+    exit "$borc"
+fi
+
 echo "== bench trajectory regression gate (history vs last-known-good) =="
 # the newest BENCH_HISTORY.jsonl entry must not regress any suite's
 # geomean >25% vs .bench_last_good.json (offending queries named); a

@@ -74,6 +74,14 @@ class Channel:
     # columns proven aggregation-tolerant by the lowering — the ONLY
     # candidates for block quantization on the ICI plane
     quant_cols: list = field(default_factory=list)
+    # bounds lattice: proven upper bound on rows any ONE producer ships
+    # over this channel (0 = unknown). Stamped by the lowering (LIMIT
+    # pushdown today). This is the declared STATIC input for planned
+    # redistribution (ROADMAP item 1): sizing segments before any frame
+    # materializes. The current ICI exchange routes materialized frames,
+    # so its measured row counts always beat a static bound — it does
+    # not consult this field.
+    out_bound: int = 0
 
     @property
     def router_bound(self) -> bool:

@@ -361,6 +361,12 @@ def exchange(ch, dfs: list, key_kind: str = None,
         seg = min(cap, bucket_capacity(
             max(1, (2 * max_rows + ndev - 1) // ndev),
             minimum=QUANT_BLOCK))
+        # (Channel.out_bound is NOT consulted here: `cap` above is
+        # already sized from the producers' MEASURED rows — this
+        # exchange routes materialized frames, so a static bound can
+        # never be tighter. The bound is the static input for planned
+        # redistribution — ROADMAP item 1 — which must size segments
+        # BEFORE materializing.)
         while True:
             sig = ("shuffle", ndev, cap, seg, dt_sig,
                    tuple(quant_names))

@@ -610,6 +610,10 @@ def _lower_scan(b: _Builder, sel: ast.Select, inputs: list) -> None:
     for cid in inputs:
         b.channels[cid].dst_stage = s.id
     ch = b.channel(MERGE if sel.order_by else UNION_ALL, src=s.id)
+    # bounds lattice: the pushed-down LIMIT bounds every producer's
+    # output rows on this channel
+    if lim is not None:
+        ch.out_bound = int(lim)
     s.outputs = [ch.id]
     b.stages.append(s)
     b.stages.append(Stage(

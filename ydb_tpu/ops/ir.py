@@ -115,6 +115,15 @@ class GroupBy:
     # every key is the probe key or a unique build's payload). Part of
     # the structural fingerprint.
     out_bound: int = 0
+    # CARRIED keys: grouping columns PROVEN functionally determined by
+    # `keys` (the executor's bounds rewrite: a unique-keyed build's
+    # payload is a function of its join key; dataset-verified
+    # determinants within one build's payload). They do not participate
+    # in the sort / bucket identity — their per-group value materializes
+    # from the group leader row, exactly like key late-materialization.
+    # A FALSE dependency silently merges groups, so only runtime-verified
+    # sources may populate this. Part of the structural fingerprint.
+    carry_keys: tuple = ()
 
 
 @dataclass(frozen=True)
@@ -138,9 +147,11 @@ class Program:
         return self
 
     def group_by(self, keys: list[str], aggs: list[Agg],
-                 key_domains: tuple = (), out_bound: int = 0) -> "Program":
+                 key_domains: tuple = (), out_bound: int = 0,
+                 carry_keys: tuple = ()) -> "Program":
         self.commands.append(GroupBy(tuple(keys), tuple(aggs),
-                                     tuple(key_domains), out_bound))
+                                     tuple(key_domains), out_bound,
+                                     tuple(carry_keys)))
         return self
 
     def project(self, names: list[str]) -> "Program":
@@ -205,6 +216,7 @@ def infer_schema(program: Program, schema: Schema) -> Schema:
                 raise TypeError(f"filter predicate must be bool, got {dt}")
         elif isinstance(cmd, GroupBy):
             cols = [cur.col(k) for k in cmd.keys]
+            cols += [cur.col(k) for k in cmd.carry_keys]
             for a in cmd.aggs:
                 if a.func not in AGG_FUNCS:
                     raise ValueError(f"unknown aggregate {a.func}")

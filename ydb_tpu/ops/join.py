@@ -51,6 +51,7 @@ def _host_key(block: HostBlock, name: str) -> tuple[np.ndarray, Optional[np.ndar
 
 
 _LUT_SPAN_BUDGET = 1 << 26         # max direct-address entries (256MB int32)
+_FD_BUDGET = 1 << 28               # max host bytes retained for FD checks
 
 
 @dataclass
@@ -76,9 +77,16 @@ class BuildTable:
     # never TRUE for any x (NULL or FALSE), so a not_in anti probe must
     # select nothing. Set by the executor's anti-null check.
     anti_has_null: bool = False
+    # bounds lattice: the HOST build block (post null-key drop), retained
+    # so the executor's carry rewrite can VERIFY functional dependencies
+    # between payload columns by measured distinct counts
+    # (`Executor._fd_determinant`). None above the retention budget.
+    fd_block: object = None
+    fd_memo: object = None         # {cols tuple: distinct count} cache
 
 
-def build(block: HostBlock, key: str, payload_names: list[str]) -> BuildTable:
+def build(block: HostBlock, key: str, payload_names: list[str],
+          keep_fd: bool = False) -> BuildTable:
     enc, valid = _host_key(block, key)
     if valid is not None:
         # null build keys never match; drop them
@@ -123,9 +131,23 @@ def build(block: HostBlock, key: str, payload_names: list[str]) -> BuildTable:
             payload_valid[name] = jnp.asarray(v)
         if cd.dictionary is not None:
             dicts[name] = cd.dictionary
+    # retain the host block for measured functional-dependency checks
+    # (the carry rewrite's dataset verification) — host RAM is the cheap
+    # side of this platform, but only the consumer's exact shape pins it:
+    # the caller passes keep_fd when the consuming pipeline carries a
+    # multi-key group-by (`Executor._prepare_builds`), and the FD lane
+    # only ever reads unique-keyed builds with the lattice ON; anything
+    # else (and any build past the budget) just skips the FD lane and
+    # keeps every key in the sort identity
+    from ydb_tpu.query.bounds import bounds_enabled
+    fd_block = block if keep_fd and unique and bounds_enabled() \
+        and block.length \
+        and sum(cd.data.nbytes
+                for cd in block.columns.values()) <= _FD_BUDGET \
+        else None
     return BuildTable(jnp.asarray(keys_pad), len(enc), payload, payload_valid,
                       block.schema.select(payload_names), dicts, unique,
-                      lut, lut_base)
+                      lut, lut_base, fd_block=fd_block)
 
 
 def place(table: BuildTable, device) -> BuildTable:

@@ -53,6 +53,26 @@ def _eval(expr, cols: dict, schema: Schema, params: dict, n: int):
     raise TypeError(f"bad expr {expr!r}")
 
 
+def canonical_key_pair(d, v):
+    """Canonical (physical int64, validity int64) encoding of ONE
+    group-key column — the grouping equality itself: all NULLs form one
+    value, -0.0 == 0.0, all NaNs equal. Shared by the group-by oracle
+    below and the bounds lattice's functional-dependency verification
+    (`query/bounds.dataset_distinct`), which must count distinct tuples
+    under exactly the equality grouping uses — a drift between the two
+    would let a "verified" dependency silently merge groups."""
+    if v is not None:  # SQL: all NULL keys form one group
+        d = np.where(v, d, np.zeros((), d.dtype))
+    if np.issubdtype(d.dtype, np.floating):
+        d = np.where(d == 0, np.zeros((), d.dtype), d)
+        d = np.where(np.isnan(d), np.full((), np.nan, d.dtype), d)
+        phys = d.astype(np.float64).view(np.uint64)
+    else:
+        phys = d
+    valid = (v if v is not None else np.ones(len(d), bool)).astype(np.int64)
+    return np.ascontiguousarray(phys.astype(np.int64)), valid
+
+
 def _group_by(cmd: ir.GroupBy, cols: dict, schema: Schema, sel):
     n = None
     for d, _ in cols.values():
@@ -65,20 +85,10 @@ def _group_by(cmd: ir.GroupBy, cols: dict, schema: Schema, sel):
         mats = []
         for kname in cmd.keys:
             d, v = cols[kname]
-            dk = d[idx]
-            vk = v[idx] if v is not None else None
-            if vk is not None:  # SQL: all NULL keys form one group
-                dk = np.where(vk, dk, np.zeros((), dk.dtype))
-            if np.issubdtype(dk.dtype, np.floating):
-                # canonicalize so grouping matches device semantics:
-                # -0.0 == 0.0 (one group), all NaNs one group
-                dk = np.where(dk == 0, np.zeros((), dk.dtype), dk)
-                dk = np.where(np.isnan(dk), np.full((), np.nan, dk.dtype), dk)
-                physical = dk.astype(np.float64).view(np.uint64)
-            else:
-                physical = dk
-            mats.append(np.ascontiguousarray(physical.astype(np.int64)))
-            mats.append((vk if vk is not None else np.ones(len(idx), bool)).astype(np.int64))
+            phys, valid = canonical_key_pair(
+                d[idx], v[idx] if v is not None else None)
+            mats.append(phys)
+            mats.append(valid)
         mat = np.stack(mats, axis=1) if mats else np.zeros((len(idx), 0), np.int64)
         uniq, inverse = np.unique(mat, axis=0, return_inverse=True)
         inverse = np.asarray(inverse).reshape(-1)
@@ -91,7 +101,9 @@ def _group_by(cmd: ir.GroupBy, cols: dict, schema: Schema, sel):
         first = np.zeros(1, dtype=np.int64)
 
     out_cols: dict[str, tuple] = {}
-    for kname in cmd.keys:
+    # carried keys (functionally determined by `keys`) take the group
+    # leader's value, exactly like the device lowerings
+    for kname in list(cmd.keys) + list(cmd.carry_keys):
         d, v = cols[kname]
         dk, vk = d[idx], (v[idx] if v is not None else None)
         out_cols[kname] = (dk[first], vk[first] if vk is not None else None)

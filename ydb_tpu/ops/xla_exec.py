@@ -118,7 +118,7 @@ _SCATTER_MAX_BUCKETS = 1 << 16    # medium-domain single-scatter path bound
 
 
 def groupby_tuning() -> tuple:  # lint: tuning-provider
-    """(tile_rows, batch_cap, legacy) resolved from the environment.
+    """(tile_rows, batch_cap, legacy, bounds) resolved from the environment.
 
     * YDB_TPU_GROUPBY_TILE_ROWS — value-column gathers inside the sorted
       group-by split into tiles of at most this many rows (default 4M:
@@ -133,9 +133,16 @@ def groupby_tuning() -> tuple:  # lint: tuning-provider
       to the pre-round-8 early-materializing lowering (A/B lever for the
       CI gather-budget gate).
 
+    * YDB_TPU_BOUNDS — the bounds-lattice lever (`query/bounds.py`):
+      plans carry structurally different GroupBys (carry keys,
+      out_bounds) per setting, and the lever riding here puts it in
+      every compiled-program cache key by construction.
+
     The tuple is a component of every compiled-program cache key
     (ProgramCache, fused/tile/finalize/dist-agg keys), so flipping a knob
     recompiles instead of serving a trace built under other settings."""
+    from ydb_tpu.query.bounds import bounds_enabled
+
     def _int(name: str, default: int) -> int:
         try:
             return int(os.environ.get(name, "") or default)
@@ -144,7 +151,7 @@ def groupby_tuning() -> tuple:  # lint: tuning-provider
     tile_rows = max(_int("YDB_TPU_GROUPBY_TILE_ROWS", 1 << 22), 8)
     batch_cap = max(_int("YDB_TPU_GATHER_BATCH_CAP", 1 << 22), 0)
     legacy = os.environ.get("YDB_TPU_GROUPBY_LEGACY", "") not in ("", "0")
-    return (tile_rows, batch_cap, legacy)
+    return (tile_rows, batch_cap, legacy, bounds_enabled())
 
 
 class _TraceStats(threading.local):
@@ -209,6 +216,17 @@ def _t_max(name: str, value: int, ns: str = "groupby") -> None:
         _TRACE.stats[name] = value
     # lint: allow-counters(groupby/* + sort/* trace names, all registered)
     GLOBAL.set_max(f"{ns}/{name}", value)
+
+
+def _b_inc(name: str, by: int = 1) -> None:
+    """Bounds-lattice trace counter: lands on /counters under bounds/*
+    and in the per-statement trace window under a `bounds_` prefix (the
+    engine splits the delta into stats.groupby vs stats.bounds)."""
+    from ydb_tpu.utils.metrics import GLOBAL
+    key = "bounds_" + name
+    _TRACE.stats[key] = _TRACE.stats.get(key, 0) + by
+    # lint: allow-counters(bounds/* trace names, all registered)
+    GLOBAL.inc(f"bounds/{name}", by)
 
 
 def _count_gather(rows: int, tile_budget: int, value: bool = False,
@@ -308,11 +326,15 @@ def _groupby_small_domain(cmd: ir.GroupBy, env, schema: Schema, sel,
     chunks: dict[str, list] = {a.out: [] for a in cmd.aggs}
     valid_chunks: dict[str, list] = {}
     present_chunks = []
+    first_chunks = []                  # leader row per bucket (carry keys)
     for c0 in range(0, nbuckets, _CHUNK_W):
         w = min(_CHUNK_W, nbuckets - c0)
         ids = c0 + jnp.arange(w, dtype=jnp.int32)
         oh = (kid[:, None] == ids[None, :]) & active[:, None]
         present_chunks.append(jnp.any(oh, axis=0))
+        if cmd.carry_keys:
+            first_chunks.append(
+                jnp.min(jnp.where(oh, iota[:, None], cap), axis=0))
         for a in cmd.aggs:
             if a.func == "count_all":
                 chunks[a.out].append(jnp.sum(oh.astype(jnp.uint64), axis=0))
@@ -345,16 +367,24 @@ def _groupby_small_domain(cmd: ir.GroupBy, env, schema: Schema, sel,
         v = valid_chunks.get(a.out)
         new_env[a.out] = (data, jnp.concatenate(v) if v is not None else None)
     present = jnp.concatenate(present_chunks)
+    firstpos = jnp.concatenate(first_chunks) if first_chunks else None
     return _emit_bucket_groups(cmd, env, schema, new_env, present, nbuckets,
-                               strides)
+                               strides, cap, firstpos)
 
 
 def _emit_bucket_groups(cmd: ir.GroupBy, env, schema: Schema, new_env,
-                        present, nbuckets, strides):
+                        present, nbuckets, strides, cap, firstpos=None):
     """Shared bounded-domain epilogue: rebuild key columns from bucket ids,
     then compact non-empty buckets to the front of a SMALL capacity bucket
     (compress sorts; doing it over the scan capacity would cost a full
-    cap-sized argsort for a handful of groups)."""
+    cap-sized argsort for a handful of groups). `firstpos`: leader row id
+    per bucket, required when the command carries functionally-determined
+    keys (their per-group value gathers from the leader row)."""
+    _b_inc("proven_rows", bucket_capacity(nbuckets, minimum=128))
+    _b_inc("capacity_rows", cap)
+    _b_inc("bounded_groupbys")
+    if cmd.carry_keys:
+        _b_inc("carried_keys", len(cmd.carry_keys))
     bucket_ids = jnp.arange(nbuckets, dtype=jnp.int32)
     for kname, dom, st in zip(cmd.keys, cmd.key_domains, strides):
         code = (bucket_ids // st) % (dom + 1) - 1
@@ -363,6 +393,17 @@ def _emit_bucket_groups(cmd: ir.GroupBy, env, schema: Schema, new_env,
         kv = code >= 0
         dt = schema.dtype(kname)
         new_env[kname] = (kd, kv if dt.nullable else None)
+    for kname in cmd.carry_keys:
+        d, v = env[kname]
+        safe = jnp.clip(firstpos, 0, cap - 1)
+        kd = d[safe]
+        dt = schema.dtype(kname)
+        if dt.nullable:
+            kv = (v[safe] if v is not None
+                  else jnp.ones((nbuckets,), jnp.bool_))
+            new_env[kname] = (kd, kv & present)
+        else:
+            new_env[kname] = (kd, None)
 
     out_cap = bucket_capacity(nbuckets, minimum=128)
     pad = out_cap - nbuckets
@@ -424,8 +465,12 @@ def _groupby_medium_domain(cmd: ir.GroupBy, env, schema: Schema, sel,
 
     present = jax.ops.segment_sum(active.astype(jnp.int32), seg_safe,
                                   nseg)[:nbuckets] > 0
+    firstpos = None
+    if cmd.carry_keys:
+        pos = jnp.where(active, iota, cap)
+        firstpos = jax.ops.segment_min(pos, seg_safe, nseg)[:nbuckets]
     return _emit_bucket_groups(cmd, env, schema, new_env, present, nbuckets,
-                               strides)
+                               strides, cap, firstpos)
 
 
 def _gather_sorted(cols: dict, perm, cap: int, tiles: int, tile_budget: int,
@@ -541,7 +586,7 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
     value would silently drop groups, so only guaranteed sources may set
     it. Precision of csum diffs is unchanged from the legacy path (see
     `_trace_group_by_sorted_legacy`)."""
-    tile_budget, batch_cap, legacy = groupby_tuning()
+    tile_budget, batch_cap, legacy, _bounds = groupby_tuning()
     if legacy:
         return _trace_group_by_sorted_legacy(cmd, env, schema, sel, length,
                                              cap)
@@ -552,7 +597,6 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
     _t_inc("traces")
     _t_inc("tiles", tiles)
     _t_max("sort_rows_max", cap)
-    record_sort(cap, 2 * len(cmd.keys) + 2)
 
     iota = jnp.arange(cap, dtype=jnp.int32)
     row_mask = iota < length
@@ -564,11 +608,14 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
         d, v = env[kname]
         enc = _sort_operand(d)
         if v is not None:
+            # nullable keys carry a validity operand so NULLs form one
+            # group; non-nullable keys contribute only their encoding —
+            # a constant all-ones operand sorts nothing, and each
+            # operand at scan capacity is real wall time (PERF round-16)
             enc = jnp.where(v, enc, _zero_like_operand(enc))
             sort_keys.append(v.astype(jnp.int32))
-        else:
-            sort_keys.append(jnp.ones((cap,), jnp.int32))
         sort_keys.append(enc)
+    record_sort(cap, len(sort_keys) + 1)
     # iota as the last key → deterministic total order, and the sort output
     # IS the permutation (no carried operands)
     out = jax.lax.sort(sort_keys + [iota], num_keys=len(sort_keys) + 1)
@@ -612,12 +659,24 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
     live = gi < ngroups
 
     # group-leader original row ids: ONE oc-sized gather shared by every
-    # late-materialized column (keys, `some` values)
+    # late-materialized column (keys, CARRIED keys, `some` values)
     lead = perm[jnp.clip(starts, 0, cap - 1)]
     _count_gather(oc, tile_budget)
 
+    # bounds-lattice gauges: per-group allocation (oc) vs the scan
+    # capacity it replaced, and how many grouping columns the carry
+    # rewrite kept OUT of the sort identity
+    _b_inc("proven_rows", oc)
+    _b_inc("capacity_rows", cap)
+    if cmd.out_bound:
+        _b_inc("bounded_groupbys")
+    if cmd.carry_keys:
+        _b_inc("carried_keys", len(cmd.carry_keys))
+
     new_env = {}
-    for kname in cmd.keys:
+    # carried keys materialize EXACTLY like keys — value at the group
+    # leader row — their per-group constancy is the carry contract
+    for kname in list(cmd.keys) + list(cmd.carry_keys):
         d, v = env[kname]
         kd = d[lead]
         _count_gather(oc, tile_budget)
@@ -723,7 +782,7 @@ def _trace_group_by_sorted_legacy(cmd: ir.GroupBy, env, schema: Schema, sel,
     for a tiny group inside a huge total the cancellation costs ~(total /
     group_sum)·1e-16 relative error — acceptable for SQL doubles and the
     test oracles' 1e-6 tolerances."""
-    tile_budget, _batch_cap, _legacy = groupby_tuning()
+    tile_budget, _batch_cap, _legacy, _bounds = groupby_tuning()
     _t_inc("traces")
     _t_inc("tiles", 1)
     _t_max("sort_rows_max", cap)
@@ -788,7 +847,7 @@ def _trace_group_by_sorted_legacy(cmd: ir.GroupBy, env, schema: Schema, sel,
     live = gi < ngroups
 
     new_env = {}
-    for kname in cmd.keys:
+    for kname in list(cmd.keys) + list(cmd.carry_keys):
         d, v = sorted_col(kname)
         kd = d[starts]
         _count_gather(cap, tile_budget)

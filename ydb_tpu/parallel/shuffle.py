@@ -329,10 +329,14 @@ class DistributedAgg:
         dev_params = {k: jnp.asarray(v) for k, v in params.items()}
         out_d, out_v, flens, overflow = fn(arrays, valids, lengths,
                                            dev_params)
-        # seg_rows=0 (full capacity) is the only mode used here — overflow
-        # is impossible, but keep the invariant checked (batched
-        # device_get, not a per-flag np.asarray sync)
-        assert not jax.device_get(overflow).any()
+        # seg_rows here is 0 (full capacity) or a PROVEN merge-GroupBy
+        # bound (each producer's partial holds ≤ out_bound groups, so a
+        # bound-bucket segment cannot overflow) — either way overflow is
+        # impossible; keep the invariant checked LOUDLY (an understated
+        # bound must crash, never silently clamp rows). Batched
+        # device_get, not a per-flag np.asarray sync.
+        assert not jax.device_get(overflow).any(), \
+            "proven segment bound overflowed — bound source is wrong"
         # NO pad record here: the partials' live row counts are
         # device-resident scalars, and the ledger must never force a
         # sync to measure — the host-input `run` path carries the gauge

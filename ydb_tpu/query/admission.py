@@ -96,10 +96,26 @@ def estimate_plan_bytes(catalog, plan, snapshot) -> int:
     at the table's row count, plus each join build's scan (one level deep
     — build subplans estimate their own driving scan).
 
-    Deliberately stats-only (row counts × column widths): the executor
-    enumerates and prunes the actual scan sources right after admission —
-    doing it here too would walk every shard twice per query."""
+    Deliberately stats-only (row counts × column widths; the bounds
+    lattice adds portion-STATS prune previews and build output bounds,
+    never block data): the executor enumerates the actual scan sources
+    right after admission — re-walking blocks here would do it twice.
+
+    Bounds-lattice tightening (`query/bounds.py`, YDB_TPU_BOUNDS):
+      * the driving scan honors the plan's prune predicates against
+        portion min/max stats — the q12/q20 prune-blind outlier class
+        (a scan pruned to one month estimated at the full table);
+      * a join build reserves min(scan, proven output bound × width) —
+        builds MATERIALIZE at output cardinality, so a grouped/limited/
+        bounded-multiplicity build stops double-charging its driving
+        scan (the q21 class)."""
     import numpy as np
+
+    from ydb_tpu.query.bounds import (bounds_enabled, build_bytes_bound,
+                                      scan_rows_bound)
+    from ydb_tpu.utils.metrics import GLOBAL
+    lattice = bounds_enabled()
+    memo: dict = {}                    # one stats walk per plan node
 
     def pipe_bytes(pipe) -> int:
         try:
@@ -109,6 +125,9 @@ def estimate_plan_bytes(catalog, plan, snapshot) -> int:
         rows = getattr(table, "num_rows", 0)
         if not rows:
             return 0
+        if lattice and pipe.scan.prune:
+            rows = min(rows, scan_rows_bound(catalog, pipe.scan, snapshot)
+                       or rows)
         per_row = 0
         for (s, _i) in pipe.scan.columns:
             if not table.schema.has(s):
@@ -123,6 +142,14 @@ def estimate_plan_bytes(catalog, plan, snapshot) -> int:
             continue
         build = step.build
         bp = getattr(build, "pipeline", build)   # QueryPlan | Pipeline
-        if hasattr(bp, "scan"):
-            total += pipe_bytes(bp)
+        if not hasattr(bp, "scan"):
+            continue
+        scan_est = pipe_bytes(bp)
+        if lattice:
+            bb = build_bytes_bound(catalog, step, snapshot, memo)
+            if bb and bb < scan_est:
+                GLOBAL.inc("bounds/admission_capped_bytes",
+                           scan_est - bb)
+                scan_est = bb
+        total += scan_est
     return total

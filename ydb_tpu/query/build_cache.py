@@ -155,6 +155,12 @@ def _entry_bytes(bt) -> int:
         total += int(a.nbytes)
     if bt.lut is not None:
         total += int(bt.lut.nbytes)
+    fdb = getattr(bt, "fd_block", None)
+    if fdb is not None:
+        # the retained host block for FD verification pins host RAM for
+        # the cache lifetime — it must ride the budget like everything
+        # else or a dimension-heavy workload grows RSS past it unseen
+        total += sum(int(cd.data.nbytes) for cd in fdb.columns.values())
     return total
 
 

@@ -760,7 +760,11 @@ class QueryEngine:
             block = self._execute_materialized(stmt, snap)
             self._finish_stats(stats, t, block)
             return block
-        fp = self._table_fingerprint(stmt, stats.tables)
+        from ydb_tpu.query.bounds import bounds_enabled
+        # the bounds lever changes plan STRUCTURE (carry keys, stamped
+        # bounds) — it must invalidate cached plans like a schema change
+        fp = (self._table_fingerprint(stmt, stats.tables),
+              bounds_enabled())
         cached = self._plan_cache.get(sql) \
             if self.config.flag("enable_plan_cache") else None
         if cached is not None and cached[0] == fp:
@@ -963,7 +967,13 @@ class QueryEngine:
         stats.rows_out = block.length
         stats.fused = self.executor.last_path == "fused"
         stats.distributed = self.executor.last_path == "distributed"
-        stats.groupby = groupby_trace_delta(getattr(stats, "_gb_mark", {}))
+        delta = groupby_trace_delta(getattr(stats, "_gb_mark", {}))
+        # the bounds-lattice gauges ride the same trace window under a
+        # `bounds_` prefix — split them into their own stats surface
+        stats.bounds = {k[len("bounds_"):]: v for k, v in delta.items()
+                        if k.startswith("bounds_")}
+        stats.groupby = {k: v for k, v in delta.items()
+                         if not k.startswith("bounds_")}
         if self.tracer.sampled:
             stats.phases = phase_breakdown(
                 self.tracer.spans[getattr(stats, "_span_mark", 0):])

@@ -762,14 +762,28 @@ class Executor:
 
     def _bounded_groupby_rewrite(self, plan: QueryPlan, builds: list,
                                  join_metas: list):
-        """Stamp a PROVEN `out_bound` on the partial (and matching merge)
-        GroupBy when join structure bounds the group count: after an
-        INNER probe against a unique-keyed build, surviving probe keys
-        are a subset of the build's keys, so a group-by whose keys are
-        all drawn from {probe key} ∪ build payload has ngroups ≤ build
-        rows (semi joins bound the probe key the same way without
-        payloads). The bound is bucket-quantized so data growth
-        recompiles at capacity-bucket granularity, like everything else.
+        """The executor half of the bounds lattice — two rewrites of the
+        partial (and matching merge) GroupBy, both from RUNTIME-VERIFIED
+        join structure (a false bound drops groups, a false dependency
+        merges them — only guaranteed sources qualify):
+
+        * PROVEN `out_bound`: after an INNER probe against a unique-keyed
+          build, surviving probe keys are a subset of the build's keys,
+          so a group-by whose keys are all drawn from {probe key} ∪ build
+          payload has ngroups ≤ build rows (semi joins bound the probe
+          key the same way without payloads). Bucket-quantized so data
+          growth recompiles at capacity-bucket granularity.
+
+        * CARRY keys (`YDB_TPU_BOUNDS`): grouping columns functionally
+          determined by a smaller determinant stop participating in the
+          group-by sort identity — q10's 7-key (16-sort-operand) group-by
+          collapses to its 1-key determinant, the keys materializing from
+          group leaders like everything else late-materialized. The
+          dependency is verified, never assumed: the determinant is the
+          join's own key (unique ⇒ determines every payload column), or
+          a payload column whose distinct count MEASURED on the
+          materialized build equals the full key tuple's (`fd_block`
+          retained by `ops/join.build` for exactly this check).
 
         Names reassigned AFTER the bounding join (later program Assigns,
         later join payloads/mark columns, partial-program Assigns) void
@@ -777,6 +791,9 @@ class Executor:
         (possibly rewritten) plan and its pipeline; the rewrite copies —
         cached plans are never mutated."""
         import dataclasses as _dc
+
+        from ydb_tpu.query.bounds import bounds_enabled
+        from ydb_tpu.utils.metrics import GLOBAL
         pipe = plan.pipeline
         if pipe.partial is None or not pipe.partial.commands:
             return plan, pipe
@@ -787,6 +804,7 @@ class Executor:
         partial_assigned = {c.name for c in pipe.partial.commands[:-1]
                             if isinstance(c, ir.Assign)}
         best = None
+        cands = []     # (step, bt, allowed, has_payload)
         bi = 0
         for si, (kind, step) in enumerate(pipe.steps):
             if kind != "join":
@@ -798,8 +816,10 @@ class Executor:
                 continue
             if step.kind == "inner" and getattr(bt, "unique", False):
                 allowed = {step.probe_key} | set(meta["payload_names"])
+                has_payload = True
             elif step.kind == "left_semi":
                 allowed = {step.probe_key}
+                has_payload = False
             else:
                 continue
             # names invalidated downstream of THIS join
@@ -815,40 +835,133 @@ class Executor:
                 else:
                     later |= {c.name for c in s2.commands
                               if isinstance(c, ir.Assign)}
-            if keys <= (allowed - later):
+            allowed -= later
+            cands.append((step, bt, allowed, has_payload))
+            if keys <= allowed:
                 n = max(int(bt.n), 1)
                 best = n if best is None else min(best, n)
-        if best is None:
+
+        # -- carry reduction: per bounding join, find one determinant for
+        # the keys it contributes and demote the rest to carried keys
+        carry: list = []
+        claimed: set = set()
+        if bounds_enabled():
+            for (step, bt, allowed, has_payload) in cands:
+                if not has_payload:
+                    continue
+                gj = [k for k in gb.keys
+                      if k in allowed and k not in claimed
+                      and k not in carry]
+                if len(gj) < 2:
+                    continue
+                det, measured = self._fd_determinant(step, bt, gj)
+                if det is None:
+                    continue
+                claimed.add(det)
+                for k in gj:
+                    if k != det:
+                        carry.append(k)
+                if measured is not None and keys <= allowed:
+                    # the measured distinct count of the FULL key tuple
+                    # is an exact ngroups bound for this execution —
+                    # tighter than build rows
+                    best = measured if best is None \
+                        else min(best, measured)
+
+        bound = gb.out_bound
+        if best is not None:
+            cand = bucket_capacity(max(best, 1), minimum=128)
+            rows = max(int(getattr(self.catalog.table(pipe.scan.table),
+                                   "num_rows", 0)), 1)
+            if cand < bucket_capacity(rows) \
+                    and (not bound or int(bound) > cand):
+                # a planner domain-product bound may be far looser than
+                # the join bound (10^9-key-product vs an 8k-row build) —
+                # keep the tighter of the two
+                bound = cand
+        if bound == gb.out_bound and not carry:
             return plan, pipe
-        if gb.out_bound:
-            # a planner domain-product bound may be far looser than the
-            # join bound (10^9-key-product vs an 8k-row build) — keep the
-            # tighter of the two, and skip only when the planner's is
-            # already at least as tight
-            if int(gb.out_bound) <= best:
-                return plan, pipe
-        bound = bucket_capacity(best, minimum=128)
-        rows = max(int(getattr(self.catalog.table(pipe.scan.table),
-                               "num_rows", 0)), 1)
-        if bound >= bucket_capacity(rows):
-            return plan, pipe          # no smaller than the scan anyway
-        gb2 = _dc.replace(gb, out_bound=bound)
+
+        kept = tuple(k for k in gb.keys if k not in carry)
+        domains = gb.key_domains
+        if carry and domains and len(domains) == len(gb.keys):
+            domains = tuple(d for k, d in zip(gb.keys, domains)
+                            if k not in carry)
+        elif carry:
+            domains = ()
+        new_carry = tuple(gb.carry_keys) + tuple(carry)
+        gb2 = _dc.replace(gb, keys=kept, key_domains=domains,
+                          out_bound=bound, carry_keys=new_carry)
         pipe = _dc.replace(pipe, partial=ir.Program(
             list(pipe.partial.commands[:-1]) + [gb2]))
         fp = plan.final_program
         if fp is not None and fp.commands \
                 and isinstance(fp.commands[0], ir.GroupBy) \
-                and fp.commands[0].keys == gb.keys \
-                and (not fp.commands[0].out_bound
-                     or int(fp.commands[0].out_bound) > bound):
+                and fp.commands[0].keys == gb.keys:
             # the merge GroupBy sees the union of partials over the SAME
-            # keys — the bound carries over
-            fgb = _dc.replace(fp.commands[0], out_bound=bound)
+            # keys — the bound and the carry set transfer verbatim
+            fgb0 = fp.commands[0]
+            fgb = _dc.replace(
+                fgb0, keys=kept, key_domains=domains,
+                carry_keys=tuple(fgb0.carry_keys) + tuple(carry),
+                out_bound=bound if (not fgb0.out_bound
+                                    or (bound and int(fgb0.out_bound)
+                                        > int(bound)))
+                else fgb0.out_bound)
             fp = ir.Program([fgb] + list(fp.commands[1:]))
         plan = _dc.replace(plan, pipeline=pipe, final_program=fp)
-        from ydb_tpu.utils.metrics import GLOBAL
         GLOBAL.inc("groupby/join_bounded_plans")
+        if carry:
+            GLOBAL.inc("bounds/carry_rewrites")
         return plan, pipe
+
+    def _fd_determinant(self, step: JoinStep, bt, gj: list):
+        """One grouping column that provably determines all of `gj`
+        (keys drawn from this unique-keyed build's probe/payload).
+        Returns (determinant | None, measured distinct count | None).
+
+        Trivial case: the join key itself is among the keys — a unique
+        build key determines every payload column by construction
+        (probe == build key on surviving inner rows). Otherwise each
+        candidate is VERIFIED on the materialized build block: det → gj
+        holds on this dataset iff distinct(det) == distinct(gj-tuple)
+        (det ⊆ gj, so equality forces a bijection)."""
+        from ydb_tpu.query.bounds import dataset_distinct
+        from ydb_tpu.utils.metrics import GLOBAL
+        if step.probe_key in gj:
+            return step.probe_key, None
+        if step.build_key in gj:
+            return step.build_key, None
+        fdb = getattr(bt, "fd_block", None)
+        if fdb is None:
+            return None, None
+        # map probe-side key names onto build-block columns (the probe
+        # key reads the build key's values on surviving inner rows)
+        mcols = [step.build_key if k == step.probe_key else k for k in gj]
+        if any(c not in fdb.columns for c in mcols):
+            return None, None
+        memo = getattr(bt, "fd_memo", None)
+        if memo is None:
+            memo = bt.fd_memo = {}
+
+        def distinct(cols: tuple) -> int:
+            got = memo.get(cols)
+            if got is None:
+                got = memo[cols] = dataset_distinct(fdb, list(cols))
+            return got
+
+        GLOBAL.inc("bounds/fd_checks")
+        total = distinct(tuple(sorted(mcols)))
+        # candidates ordered smallest-encoding-first: a narrow int key
+        # beats a wide string code as the surviving sort operand
+        order = sorted(zip(gj, mcols),
+                       key=lambda km: (fdb.columns[km[1]].data.itemsize,
+                                       km[0]))
+        for (k, m) in order:
+            if distinct((m,)) == total:
+                GLOBAL.inc("bounds/fd_verified")
+                return k, total
+        return None, None
 
     # -- tiled fused path (scan > HBM budget) ------------------------------
 
@@ -1372,13 +1485,24 @@ class Executor:
         gb = plan.final_program.commands[0]
         merge_prog = ir.Program([gb])
         in_schema = per_dev[0][0].schema
+        # bounds lattice: a PROVEN merge group-count bound sizes the
+        # shuffle's per-target segments — each producer's partial holds
+        # ≤ out_bound groups, so a bound-bucket segment cannot overflow
+        # (replacing the full-capacity pad; the 2112.01075 stance)
+        seg_rows = 0
+        if gb.out_bound:
+            from ydb_tpu.utils.metrics import GLOBAL
+            seg_rows = bucket_capacity(max(int(gb.out_bound), 1),
+                                       minimum=128)
+            GLOBAL.inc("bounds/seg_bounded_shuffles")
         key = (merge_prog.fingerprint(),
                tuple((c.name, c.dtype.kind.value, c.dtype.nullable)
-                     for c in in_schema.columns), ndev, groupby_tuning())
+                     for c in in_schema.columns), ndev, seg_rows,
+               groupby_tuning())
         dag = self._dist_aggs.get(key)
         if dag is None:
             dag = DistributedAgg(merge_prog, merge_prog, in_schema,
-                                 self.mesh)
+                                 self.mesh, seg_rows=seg_rows)
             self._dist_aggs[key] = dag
         merged = dag.run_device_blocks(per_dev, params)
         rest = list(plan.final_program.commands[1:])
@@ -1491,6 +1615,12 @@ class Executor:
         for (storage, internal) in pipe.scan.columns:
             if storage in probe_dicts:
                 probe_dicts[internal] = probe_dicts[storage]
+        # FD-verification blocks are only ever read when the consuming
+        # pipeline ends in a multi-key group-by (the carry rewrite's
+        # measured lane) — don't pin host copies for any other shape
+        keep_fd = (pipe.partial is not None and pipe.partial.commands
+                   and isinstance(pipe.partial.commands[-1], ir.GroupBy)
+                   and len(pipe.partial.commands[-1].keys) >= 2)
         builds = []
         for si, (kind, step) in enumerate(pipe.steps):
             if kind != "join" or (until is not None and si >= until):
@@ -1498,7 +1628,8 @@ class Executor:
             bt = self._prepare_join(step, params, snapshot,
                                     probe_dict=probe_dicts.get(
                                         step.probe_key),
-                                    prebuilt_block=(prebuilt or {}).get(si))
+                                    prebuilt_block=(prebuilt or {}).get(si),
+                                    keep_fd=keep_fd)
             builds.append(bt)
             # payload columns join the probe namespace for later steps
             probe_dicts.update(getattr(bt, "dictionaries", None) or {})
@@ -1506,31 +1637,34 @@ class Executor:
 
     def _prepare_join(self, step: JoinStep, params: dict,
                       snapshot: Snapshot, probe_dict=None,
-                      prebuilt_block: Optional[HostBlock] = None
-                      ) -> J.BuildTable:
+                      prebuilt_block: Optional[HostBlock] = None,
+                      keep_fd: bool = False) -> J.BuildTable:
         from ydb_tpu.query.build_cache import build_plan_fingerprint
         cache_key = None
         if prebuilt_block is None:
             single_dev = self.mesh is None or self.mesh.devices.size <= 1
             # knobs that steer the PartitionedBuild-vs-BuildTable choice
-            # are part of the key (tests flip grace_budget_bytes)
+            # are part of the key (tests flip grace_budget_bytes); keep_fd
+            # rides it so a group-by consumer never cache-hits a lean
+            # entry whose FD block was skipped for a join-only shape
             cache_key = build_plan_fingerprint(
                 step, params, snapshot, self.catalog,
-                extra=(single_dev, self.grace_budget_bytes))
+                extra=(single_dev, self.grace_budget_bytes, keep_fd))
             if cache_key is not None:
                 hit = self.build_cache.lookup(cache_key, probe_dict)
                 if hit is not None:
                     return hit
         bt = self._prepare_join_uncached(step, params, snapshot,
-                                         probe_dict, prebuilt_block)
+                                         probe_dict, prebuilt_block,
+                                         keep_fd=keep_fd)
         if cache_key is not None:
             self.build_cache.insert(cache_key, bt, probe_dict)
         return bt
 
     def _prepare_join_uncached(self, step: JoinStep, params: dict,
                                snapshot: Snapshot, probe_dict=None,
-                               prebuilt_block: Optional[HostBlock] = None
-                               ) -> J.BuildTable:
+                               prebuilt_block: Optional[HostBlock] = None,
+                               keep_fd: bool = False) -> J.BuildTable:
         if prebuilt_block is not None:
             built = prebuilt_block
         elif isinstance(step.build, QueryPlan):
@@ -1588,7 +1722,8 @@ class Executor:
                 return J.build_partitioned(built, step.build_key,
                                            list(step.payload),
                                            self.grace_budget_bytes)
-        bt = J.build(built, step.build_key, list(step.payload))
+        bt = J.build(built, step.build_key, list(step.payload),
+                     keep_fd=keep_fd)
         bt.anti_has_null = anti_has_null
         return bt
 

@@ -53,6 +53,11 @@ class Pipeline:
     steps: list = field(default_factory=list)     # [("join", JoinStep) | ("program", ir.Program)]
     partial: Optional[ir.Program] = None          # ends in partial GroupBy / projection
     out_names: list = field(default_factory=list)  # pipeline output columns
+    # bounds lattice (query/bounds.py): proven-at-plan-time row upper
+    # bound of this pipeline's output; 0 = unknown (capacity sizing).
+    # Sizing-quality (admission, segment sizing, EXPLAIN) — the
+    # correctness-bearing bounds live on ir.GroupBy.
+    out_bound: int = 0
 
 
 @dataclass
@@ -91,6 +96,9 @@ class QueryPlan:
     # ineligible).
     lift_names: tuple = ()
     lift_sig: Optional[tuple] = None
+    # bounds lattice (query/bounds.py): proven row upper bound of the
+    # final result (post sort/limit); 0 = unknown
+    out_bound: int = 0
 
 
 def explain(plan: QueryPlan, indent: int = 0) -> str:
@@ -104,6 +112,9 @@ def explain(plan: QueryPlan, indent: int = 0) -> str:
                      + (f" est_rows={p.scan.est_rows:g}"
                         if p.scan.est_rows >= 0 else "")
                      + (f" prune={p.scan.prune}" if p.scan.prune else ""))
+        if p.out_bound:
+            lines.append(f"{pp}  -- bounds: pipeline ≤ {p.out_bound} rows"
+                         + _gb_bounds(p.partial))
         if p.pre_program:
             lines.append(f"{pp}  pre: {_prog(p.pre_program)}")
         for kind, step in p.steps:
@@ -123,12 +134,29 @@ def explain(plan: QueryPlan, indent: int = 0) -> str:
     pipe(plan.pipeline, indent)
     if plan.final_program:
         lines.append(f"{pad}final: {_prog(plan.final_program)}")
+    if plan.out_bound:
+        lines.append(f"{pad}-- bounds: result ≤ {plan.out_bound} rows")
     if plan.sort:
         lines.append(f"{pad}sort: {[(s.name, 'asc' if s.ascending else 'desc') for s in plan.sort]}")
     if plan.limit is not None:
         lines.append(f"{pad}limit: {plan.limit}")
     lines.append(f"{pad}output: {[lbl for _, lbl in plan.output]}")
     return "\n".join(lines)
+
+
+def _gb_bounds(prog) -> str:
+    """Group-by bound annotation for the `-- bounds:` line: each stage's
+    proven bound next to what capacity sizing would have allocated."""
+    if prog is None:
+        return ""
+    parts = []
+    for cmd in prog.commands:
+        if isinstance(cmd, ir.GroupBy) and cmd.out_bound:
+            s = f"groupby ≤ {cmd.out_bound} groups"
+            if cmd.carry_keys:
+                s += f" ({len(cmd.carry_keys)} carried)"
+            parts.append(s)
+    return (" | " + ", ".join(parts)) if parts else ""
 
 
 def _prog(p: ir.Program) -> str:

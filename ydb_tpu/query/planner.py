@@ -186,9 +186,18 @@ class Planner:
         # literal lifting runs AFTER planning (paramlift.py): pruning,
         # selectivity, and dictionary folding all saw concrete values;
         # only the compiled artifact becomes value-free
+        from ydb_tpu.query.bounds import annotate_plan
         from ydb_tpu.query.paramlift import lift_plan
         with self._mu:
-            return lift_plan(self._plan_select_locked(sel))
+            plan = lift_plan(self._plan_select_locked(sel))
+            try:
+                # bounds lattice (query/bounds.py): stamp every
+                # pipeline's proven row bound — sizing only, must never
+                # fail a query
+                annotate_plan(plan, self.catalog)
+            except Exception:          # noqa: BLE001 — sizing, not law
+                pass
+            return plan
 
     def plan_dq(self, sel: ast.Select, topology):
         """Lower a SELECT to a DQ stage graph (`ydb_tpu/dq/graph.py`) —
@@ -272,6 +281,11 @@ class Planner:
                 raise PlanError("LEFT JOIN needs at least one equi-join "
                                 "condition")
             spec["pairs"], spec["local"] = pairs, local
+
+        # eager aggregation: a LEFT JOIN consumed only through aggregates
+        # pre-aggregates its build by the join key — the expanding
+        # duplicate-key probe (portioned-path cliff) stops existing
+        sel = self._eager_agg_rewrite(sel, rels, scope)
 
         # classify predicates ((a∧x)∨(a∧y) → a∧(x∨y) first: surfaces
         # join conditions buried in OR branches, e.g. TPC-H Q19)
@@ -697,6 +711,184 @@ class Planner:
 
     # -- left outer joins --------------------------------------------------
 
+    # -- eager aggregation (LEFT JOIN build pre-aggregation) ---------------
+
+    _EAGER_FNS = ("count", "sum", "min", "max")
+
+    def _eager_agg_rewrite(self, sel: ast.Select, rels, scope):
+        """Pre-aggregate a LEFT JOIN build below the join (classic eager
+        aggregation) when the joined relation is consumed ONLY through
+        count/sum/min/max aggregates over its columns:
+
+            c LEFT JOIN o ON c.k = o.k [AND o-local] ... count(o.x)
+        →   build' = SELECT o.k, count(o.x) FROM o [WHERE local] GROUP BY o.k
+            c LEFT JOIN build' ON c.k = o.k ... sum(coalesce(o.cnt, 0))
+
+        The payoff is the bounds lattice's: the pre-aggregated build is
+        UNIQUE-keyed (grouped by the join key), so the expanding
+        duplicate-key probe — the shape that declines whole-plan fusion
+        and runs the portioned host lane (q13's measured 89.5% wall) —
+        stops existing, and the join becomes row-preserving with a
+        key-domain-bounded build. Exact per SQL semantics: per-key
+        partial counts SUM over the probe stream (an unmatched probe row
+        contributes coalesce(NULL, 0) = 0); sum/min/max merge with
+        themselves, and their all-NULL-group result stays NULL through
+        the null-extended payload. The rewrite only fires when every
+        reference to the alias outside the ON clause sits in a
+        qualifying aggregate — any other use (group key, scalar context,
+        subquery, string min/max, DISTINCT) keeps the expanding join."""
+        import dataclasses as _dc
+
+        from ydb_tpu.query.bounds import bounds_enabled
+        if not sel.group_by or not self._left_specs:
+            return sel
+        if not bounds_enabled():       # lever off: capacity-shaped plans
+            return sel
+        if any(isinstance(it.expr, ast.Star) for it in sel.items):
+            return sel
+
+        def alias_of(parts) -> Optional[str]:
+            b = scope.try_resolve(parts)
+            return b.internal.split(".", 1)[0] if b is not None else None
+
+        def scan(e, alias, calls) -> bool:
+            """True iff every reference to `alias` under `e` is the sole
+            Name argument of a qualifying aggregate (collected into
+            `calls`). Conservative: unknown node kinds fail."""
+            if e is None or isinstance(e, (ast.Literal, ast.BoundParam)):
+                return True
+            if isinstance(e, ast.Name):
+                return alias_of(e.parts) != alias
+            if isinstance(e, ast.FuncCall):
+                if e.name in B.AGG_NAMES:
+                    refs: set = set()
+                    walk_names(e, refs)
+                    if not any(alias_of(p) == alias for p in refs):
+                        # a probe-side aggregate sees k copies of each
+                        # matched probe row in the EXPANDING join; the
+                        # rewrite makes the probe row-preserving, so only
+                        # multiplicity-insensitive aggregates (min/max,
+                        # DISTINCT) keep their value — count(*)/sum/avg
+                        # over the probe stream disqualify the spec
+                        return bool(e.distinct) or e.name in ("min", "max")
+                    if (e.name not in self._EAGER_FNS or e.distinct
+                            or e.star or len(e.args) != 1
+                            or not isinstance(e.args[0], ast.Name)):
+                        return False
+                    b = scope.try_resolve(e.args[0].parts)
+                    if b is None or (b.dtype.is_string
+                                     and e.name in ("min", "max")):
+                        return False
+                    calls.append(e)
+                    return True
+                return all(scan(a, alias, calls) for a in e.args)
+            if isinstance(e, ast.BinOp):
+                return scan(e.left, alias, calls) \
+                    and scan(e.right, alias, calls)
+            if isinstance(e, ast.UnaryOp):
+                return scan(e.arg, alias, calls)
+            if isinstance(e, ast.Case):
+                parts = ([e.operand] if e.operand is not None else []) \
+                    + [x for (c, r) in e.whens for x in (c, r)] \
+                    + ([e.default] if e.default is not None else [])
+                return all(scan(x, alias, calls) for x in parts)
+            if isinstance(e, ast.Cast):
+                return scan(e.arg, alias, calls)
+            if isinstance(e, ast.Between):
+                return all(scan(x, alias, calls)
+                           for x in (e.arg, e.lo, e.hi))
+            if isinstance(e, ast.InList):
+                return all(scan(x, alias, calls)
+                           for x in (e.arg,) + tuple(e.items))
+            if isinstance(e, (ast.Like, ast.IsNull)):
+                return scan(e.arg, alias, calls)
+            return False               # subqueries / unknown nodes
+
+        def agg_sig(e: ast.FuncCall):
+            return (e.name, repr(e.args[0]))
+
+        rewritten = False
+        for spec in self._left_specs:
+            if len(spec["pairs"]) != 1:
+                continue
+            alias = spec["alias"]
+            # keys / filters must not touch the alias at all (WHERE over
+            # the null-extended side restricts post-join — incompatible)
+            no_ref: list = []
+            if not all(scan(e, alias, no_ref) and not no_ref
+                       for e in list(sel.group_by) + [sel.where]):
+                continue
+            calls: list = []
+            agg_exprs = [it.expr for it in sel.items] \
+                + [o.expr for o in sel.order_by] \
+                + ([sel.having] if sel.having is not None else [])
+            if not all(scan(e, alias, calls) for e in agg_exprs):
+                continue
+            if not calls:
+                continue
+            # one synthetic payload per distinct (fn, arg)
+            insts: dict = {}           # sig -> (payload_col, sub_item_expr)
+            repl: dict = {}            # sig -> outer replacement FuncCall
+            for c in calls:
+                sig = agg_sig(c)
+                if sig in insts:
+                    continue
+                pname = f"__ea{len(insts)}"
+                ref = ast.Name((alias, pname))
+                if c.name == "count":
+                    # int64-cast partial counts: coalesce/sum over a
+                    # uint64 payload and an int literal would promote;
+                    # the outer cast restores count's uint64 result type
+                    # so the lever cannot flip the output schema
+                    sub_e = ast.Cast(c, "int64")
+                    out_dt = dt.DType(dt.Kind.INT64, True)
+                    repl[sig] = ast.Cast(ast.FuncCall("sum", (ast.FuncCall(
+                        "coalesce", (ref, ast.Literal(0))),)), "uint64")
+                else:
+                    sub_e = c
+                    arg_dt = scope.resolve(c.args[0].parts).dtype
+                    from ydb_tpu.ops.ir import agg_result_dtype
+                    out_dt = agg_result_dtype(
+                        c.name if c.name == "sum" else "some",
+                        arg_dt).with_nullable(True)
+                    repl[sig] = ast.FuncCall(c.name, (ref,))
+                insts[sig] = (pname, sub_e)
+                scope.add(alias, pname,
+                          B.ColumnBinding(f"{alias}.{pname}", out_dt, None))
+            spec["eager"] = list(insts.values())
+
+            def walk(e):
+                if isinstance(e, ast.FuncCall) and e.name in B.AGG_NAMES \
+                        and not e.distinct and not e.star and e.args:
+                    r = repl.get(agg_sig(e))
+                    if r is not None:
+                        return r
+
+                def rw(v):
+                    if isinstance(v, tuple):
+                        return tuple(rw(x) for x in v)
+                    if hasattr(v, "__dataclass_fields__"):
+                        return walk(v)
+                    return v
+
+                kw = {f: rw(getattr(e, f))
+                      for f in e.__dataclass_fields__}
+                return _dc.replace(e, **kw)
+
+            sel = ast.Select(**{**sel.__dict__})
+            sel.items = [ast.SelectItem(walk(it.expr), it.alias)
+                         for it in sel.items]
+            sel.order_by = [ast.OrderItem(walk(o.expr), o.ascending,
+                                          o.nulls_first)
+                            for o in sel.order_by]
+            if sel.having is not None:
+                sel.having = walk(sel.having)
+            rewritten = True
+        if rewritten:
+            from ydb_tpu.utils.metrics import GLOBAL
+            GLOBAL.inc("bounds/eager_agg_rewrites")
+        return sel
+
     def _attach_left_joins(self, pipeline, binder: B.ExprBinder,
                            needed: set) -> None:
         """Append a null-extending build fragment per LEFT JOIN: the right
@@ -708,14 +900,32 @@ class Planner:
             alias = spec["alias"]
             pairs = spec["pairs"]
             build_cols = [bn.parts[-1] for (_p, bn) in pairs]
-            right_cols = sorted({n.split(".", 1)[1] for n in needed
-                                 if n.startswith(alias + ".")}
-                                | set(build_cols))
-            items = [ast.SelectItem(ast.Name((alias, col)), f"{alias}.{col}")
-                     for col in right_cols]
-            sub = ast.Select(items=items,
-                             relation=ast.TableRef(spec["tref"].name, alias),
-                             where=_and_fold(spec["local"]))
+            if spec.get("eager"):
+                # eager aggregation (`_eager_agg_rewrite`): the build
+                # GROUPS by its join key — unique-keyed by construction,
+                # so the probe is row-preserving and fusion survives
+                bk = build_cols[0]
+                items = [ast.SelectItem(ast.Name((alias, bk)),
+                                        f"{alias}.{bk}")]
+                items += [ast.SelectItem(sub_e, f"{alias}.{pname}")
+                          for (pname, sub_e) in spec["eager"]]
+                sub = ast.Select(items=items,
+                                 relation=ast.TableRef(spec["tref"].name,
+                                                       alias),
+                                 where=_and_fold(spec["local"]),
+                                 group_by=[ast.Name((alias, bk))])
+                right_cols = [bk] + [p for (p, _e) in spec["eager"]]
+            else:
+                right_cols = sorted({n.split(".", 1)[1] for n in needed
+                                     if n.startswith(alias + ".")}
+                                    | set(build_cols))
+                items = [ast.SelectItem(ast.Name((alias, col)),
+                                        f"{alias}.{col}")
+                         for col in right_cols]
+                sub = ast.Select(items=items,
+                                 relation=ast.TableRef(spec["tref"].name,
+                                                       alias),
+                                 where=_and_fold(spec["local"]))
             jplan = self._plan_inner(sub)
             payload = [f"{alias}.{c}" for c in right_cols]
 

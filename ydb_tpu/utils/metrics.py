@@ -328,6 +328,26 @@ COUNTER_REGISTRY = {
     "groupby/sort_rows_max": "[viz] (dynamic) group-by sort row watermark",
     "groupby/value_gather_rows_max":
         "[viz] (dynamic) value-column gather row watermark",
+    # -- bounds lattice (query/bounds.py, YDB_TPU_BOUNDS) ------------------
+    "bounds/plans": "[viz] plans annotated by the bounds lattice",
+    "bounds/finite_plans": "[viz] plans whose result bound is finite",
+    "bounds/proven_rows":
+        "[viz] (dynamic) per-group rows allocated at the proven bound",
+    "bounds/capacity_rows":
+        "[viz] (dynamic) rows capacity sizing would have allocated",
+    "bounds/bounded_groupbys":
+        "[viz] (dynamic) group-by traces with a finite group bound",
+    "bounds/carried_keys":
+        "[viz] (dynamic) grouping columns carried out of sort identity",
+    "bounds/carry_rewrites": "[viz] executor carry-key plan rewrites",
+    "bounds/eager_agg_rewrites":
+        "[viz] LEFT JOIN builds pre-aggregated below the join",
+    "bounds/fd_checks": "functional-dependency verifications attempted",
+    "bounds/fd_verified": "functional-dependency verifications proven",
+    "bounds/admission_capped_bytes":
+        "admission estimate bytes removed by proven build bounds",
+    "bounds/seg_bounded_shuffles":
+        "mesh shuffle merges with bound-sized segments",
     "groupby/join_bounded_plans":
         "[viz] plans whose group count a join build side bounded",
     "sort/rows_max": "[viz] (dynamic) lax.sort row watermark",
@@ -413,6 +433,10 @@ class QueryStats:
     # `xla_exec.groupby_trace_delta` window for this statement) —
     # non-empty only when it compiled a fresh group-by shape
     groupby: dict = field(default_factory=dict)
+    # bounds-lattice trace breakdown (`query/bounds.py`): proven vs
+    # capacity per-group rows this statement's fresh group-by shapes
+    # allocated, carried-key counts — the `-- bounds:` line's source
+    bounds: dict = field(default_factory=dict)
     # batched dispatch lane (`query/batch_lane.py`): how this statement
     # rode a coalesced batch — {"coalesced": B, "leader": bool,
     # "batched": bool} (batched=False → the lane fell back to per-member
@@ -456,6 +480,20 @@ class QueryStats:
                     f"sort rows max {g.get('sort_rows_max', 0)} | "
                     f"value gather rows max "
                     f"{g.get('value_gather_rows_max', 0)}")
+        if self.bounds:
+            bd = self.bounds
+            proven = bd.get("proven_rows", 0)
+            cap = bd.get("capacity_rows", 0)
+            line = (f"\n-- bounds: proven {proven} rows vs capacity "
+                    f"{cap}")
+            if cap:
+                line += f" ({proven / cap:.3f}x tightening)"
+            if bd.get("carried_keys"):
+                line += f" | {bd['carried_keys']} carried key(s)"
+            if bd.get("bounded_groupbys"):
+                line += (f" | {bd['bounded_groupbys']} bounded "
+                         "group-by(s)")
+            out += line
         if self.batching:
             b = self.batching
             out += (f"\n-- batching: coalesced {b.get('coalesced', 0)} "

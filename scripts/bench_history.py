@@ -4,7 +4,8 @@
 Every `bench.py` run appends ONE line to `BENCH_HISTORY.jsonl` (via
 `append_run`, called from bench.py's main loop and its emergency
 handler): git sha, timestamp, per-suite geomean/per-query walls/
-coverage/utilization geomean, and the storm + multichip leg summaries.
+coverage/utilization geomean, and the storm + cold-start + multichip
+leg summaries.
 The ledger is the *trajectory* — regressions, wedged runs and all;
 `.bench_last_good.json` stays the separate green-only comparison base
 (bench.py merges only successfully-timed, oracle-clean per-query
@@ -60,8 +61,9 @@ def entry_from_suites(suites: dict, source: str = "bench.py") -> dict:
     """One ledger line from a bench `suites` payload (the artifact's
     `suites` value): tpch/tpcds/clickbench suites keep geomeans +
     per-query walls + coverage + utilization geomean; the storm leg
-    keeps its speedup/amortization; the multichip leg is read from its
-    own artifact when present."""
+    keeps its speedup/amortization; the cold-start leg keeps its
+    restart-vs-warm p99s; the multichip leg is read from its own
+    artifact when present."""
     e = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "git_sha": _git_sha(),
@@ -78,6 +80,16 @@ def entry_from_suites(suites: dict, source: str = "bench.py") -> dict:
                 "byte_equal": s.get("byte_equal"),
                 "qps_batched": s.get("qps_batched"),
                 "storm_compiles": s.get("storm_compiles"),
+            }
+            continue
+        if key == "cold_start":
+            e["cold_start"] = {
+                "warm_p99_ms": s.get("warm_p99_ms"),
+                "cold_restart_p99_ms": s.get("cold_restart_p99_ms"),
+                "true_cold_p99_ms": s.get("true_cold_p99_ms"),
+                "cold_over_warm_p99": s.get("cold_over_warm_p99"),
+                "byte_equal": s.get("byte_equal"),
+                "zero_compile_restart": s.get("zero_compile_restart"),
             }
             continue
         if "geomean_ms" not in s:

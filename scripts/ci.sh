@@ -149,6 +149,20 @@ if [ "$prc" -ne 0 ]; then
     exit "$prc"
 fi
 
+echo "== zero-compile serving gate (store warm, kill -9, restart with compile_ms=0, lever-off no files) =="
+# the persistent-store floor: a warm run serializes every fused shape
+# and dies by SIGKILL (no clean shutdown); the restart against the same
+# store dir dispatches every shape from disk (prog/store_hits == warmed
+# shapes, prog/compile_ms EXACTLY 0, every inventory row source='store',
+# digests byte-equal); YDB_TPU_PROGSTORE=0 runs byte-equal touching no
+# store files and no store counters
+JAX_PLATFORMS=cpu python scripts/progstore_gate.py
+psrc=$?
+if [ "$psrc" -ne 0 ]; then
+    echo "zero-compile serving gate FAILED (rc=$psrc)" >&2
+    exit "$psrc"
+fi
+
 echo "== bounds-lattice gate (carry rewrite, eager agg, lever byte-equal, fallback class stays retired) =="
 # the bounds floor: the bench join must trace a carry rewrite with
 # nonzero proven-vs-capacity tightening and keep its `-- bounds:`

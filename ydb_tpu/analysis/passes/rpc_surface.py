@@ -35,6 +35,9 @@ NAME_MAP = {
     "execute_query": ("execute", "execute"),
     "exchange_put": ("put", "_land"),
     "close_session": ("close", None),
+    # program-store stats is a node-local monitoring poll, like
+    # Counters — the in-process cluster reads `.sys/progstore` directly
+    "prog_store_stats": ("prog_store_stats", None),
     "tx_prepare": ("tx_prepare", None),
     "tx_decide": ("tx_decide", None),
     "tx_resolve": ("tx_resolve", None),

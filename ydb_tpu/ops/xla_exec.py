@@ -187,6 +187,23 @@ def groupby_trace_mark() -> dict:
     return dict(_TRACE.stats)
 
 
+def groupby_trace_fold(delta: dict) -> None:
+    """Fold a trace delta captured on ANOTHER thread into this thread's
+    window. The compile-ahead lane builds (traces) fused programs on a
+    background worker, so the build-time gauges land in that thread's
+    accumulator; the statement that consumes the warmed entry folds the
+    parked delta here so its EXPLAIN ANALYZE / QueryStats window reports
+    the build it triggered — without this, a warmed statement looks like
+    it traced nothing."""
+    st = _TRACE.stats
+    for k, v in delta.items():
+        if k.endswith("_max"):
+            if v > st.get(k, -1):
+                st[k] = v
+        else:
+            st[k] = st.get(k, 0) + v
+
+
 def groupby_trace_delta(mark: dict) -> dict:
     """Trace activity since `mark`: counters subtract; `*_max` high
     watermarks report their current value only if raised inside the

@@ -1,0 +1,130 @@
+"""The compile-ahead lane: overlap fresh compiles with the admission
+wait, and never compile the same shape twice concurrently.
+
+The engine knows the physical plan (and therefore the fused program
+key) BEFORE the statement sits down in the memory-admission queue; a
+novel (key, bucket) pair can start its AOT compile on a background
+thread during that wait instead of serializing behind it. The store
+lane compounds: a compile-ahead of a shape that is already on disk is
+a deserialize, near-free.
+
+`SingleFlight` is the dedup primitive for BOTH lanes: the synchronous
+dispatch path and the background lane route every fused/batched
+compile through `run(key, thunk)`, so a 64-client storm on a fresh
+shape compiles exactly once — 1 leader compiles, 63 followers block on
+the leader's future and share the result (`prog/compile_ahead_dedup`).
+A leader's exception propagates to every waiter and clears the slot,
+so the next request retries fresh rather than caching a poisoned
+future.
+
+`YDB_TPU_COMPILE_AHEAD=0` disables the background lane (compiles run
+strictly synchronously, byte-equal); single-flight dedup stays on —
+it has no observable result effect, only fewer duplicate compiles.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import threading
+
+from ydb_tpu.utils.metrics import GLOBAL
+
+_MU = threading.Lock()
+_POOL = None                           # guarded-by: _MU — lazy worker pool
+
+
+def enabled() -> bool:
+    """`YDB_TPU_COMPILE_AHEAD` lever: 0 = no background lane."""
+    return os.environ.get("YDB_TPU_COMPILE_AHEAD", "1").strip() != "0"
+
+
+def _workers() -> int:
+    return max(1, int(os.environ.get("YDB_TPU_COMPILE_AHEAD_THREADS",
+                                     "2")))
+
+
+def _pool():
+    global _POOL
+    with _MU:
+        if _POOL is None:
+            _POOL = cf.ThreadPoolExecutor(
+                max_workers=_workers(),
+                thread_name_prefix="ydb-tpu-compile-ahead")
+        return _POOL
+
+
+class SingleFlight:
+    """Per-key concurrent dedup. The first caller of `run(key, thunk)`
+    becomes the leader and executes; concurrent callers with the same
+    key block on the leader's future and share its result (or its
+    exception). The slot clears when the leader finishes — a failed
+    compile is retried by the NEXT request, never cached."""
+
+    __slots__ = ("_mu", "_inflight")
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._inflight: dict = {}
+
+    def run(self, key, thunk):
+        with self._mu:
+            fut = self._inflight.get(key)
+            if fut is None:
+                fut = self._inflight[key] = cf.Future()
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            GLOBAL.inc("prog/compile_ahead_dedup")
+            return fut.result()
+        try:
+            res = thunk()
+        except BaseException as exc:
+            fut.set_exception(exc)
+            with self._mu:
+                self._inflight.pop(key, None)
+            raise
+        fut.set_result(res)
+        with self._mu:
+            self._inflight.pop(key, None)
+        return res
+
+    def launch(self, key, thunk) -> bool:
+        """Kick `thunk` for `key` on the background pool unless that
+        key is already in flight (then the eventual synchronous caller
+        will dedup onto it anyway). Fire-and-forget: errors are counted
+        (`prog/compile_ahead_errors`) and swallowed — the synchronous
+        path will hit the real error with full context."""
+        if not enabled():
+            return False
+        with self._mu:
+            if key in self._inflight:
+                return False
+        GLOBAL.inc("prog/compile_ahead_launches")
+
+        def _bg():
+            try:
+                self.run(key, thunk)
+            except BaseException:      # noqa: BLE001 — sync path re-raises
+                GLOBAL.inc("prog/compile_ahead_errors")
+
+        try:
+            _pool().submit(_bg)
+        except RuntimeError:           # interpreter shutdown
+            return False
+        return True
+
+    def inflight(self) -> int:
+        with self._mu:
+            return len(self._inflight)
+
+
+def reset_for_tests() -> None:
+    """Drain the background pool so a test's compile-ahead work cannot
+    leak into the next test's counters."""
+    global _POOL
+    with _MU:
+        pool, _POOL = _POOL, None
+    if pool is not None:
+        pool.shutdown(wait=True)

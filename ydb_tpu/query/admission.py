@@ -73,6 +73,16 @@ class MemoryAdmission:
                 GLOBAL.set("admission/active_queries", self.active)
                 self._cv.notify_all()
 
+    def backlog(self) -> dict:
+        """Queue snapshot for the compile-ahead observability surfaces
+        (`.sys/progstore`, ProgStoreStats): active reservations,
+        reserved bytes, free bytes — the wait a background compile
+        overlaps with."""
+        with self._cv:
+            return {"active": self.active,
+                    "in_flight_bytes": self.in_flight,
+                    "free_bytes": max(0, self.budget - self.in_flight)}
+
 
 def batch_reservation_bytes(est_bytes: int, n_members: int,
                             member_floor: int = 1 << 20) -> int:

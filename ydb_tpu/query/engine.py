@@ -791,6 +791,12 @@ class QueryEngine:
         # the measured peak at close (`admission/est_error_pct`)
         from ydb_tpu.utils import memledger
         memledger.note_admission(est)
+        # compile-ahead lane (ydb_tpu/progstore): a novel plan shape
+        # starts its fused program fill on the background pool NOW —
+        # store deserialize or fresh AOT compile, single-flight deduped
+        # with the dispatch below — overlapped with the window/admission
+        # wait it would otherwise serialize behind
+        self.executor.compile_ahead(plan, plan.params, snap)
         try:
             block = None
             if self._batch_lane is not None:

@@ -32,6 +32,8 @@ from ydb_tpu.ops.sort import sort_env
 from ydb_tpu.ops.xla_exec import (
     _trace_program, compress, compress_block, groupby_tuning, run_on_device,
 )
+from ydb_tpu.progstore import buckets as shape_buckets
+from ydb_tpu.progstore import compile_ahead as ca_lane
 from ydb_tpu.query.plan import JoinStep, Pipeline, QueryPlan, SortKey
 from ydb_tpu.storage.mvcc import MAX_SNAPSHOT, Snapshot
 from ydb_tpu.utils import progstats
@@ -134,6 +136,29 @@ class Executor:
         self.build_cache = BuildCache(int(
             _os.environ.get("YDB_TPU_BUILD_CACHE_BUDGET", 2 << 30)),
             device_cache=self.device_cache)
+        # single-flight dedup for fused/batched program fills: a client
+        # storm on a fresh shape compiles ONCE (one leader traces and
+        # compiles, followers block on its future and share the handle)
+        # — the compile-ahead lane launches through the same flight so a
+        # background warm and a synchronous dispatch never double-compile
+        self._sflight = ca_lane.SingleFlight()
+        # (table, data_version, lift_sig) triples the compile-ahead lane
+        # has already warmed — a repeated statement must not re-walk plan
+        # setup on the background pool every time it runs
+        self._warm_seen: set = set()
+        self._warm_mu = _threading.Lock()
+        # build-time trace deltas parked by the compile-ahead worker,
+        # keyed by (kind, cache key): the thread-local groupby/bounds
+        # gauges a background build records would otherwise vanish —
+        # the FIRST foreground statement to consume the warmed entry
+        # folds them into its own window (guarded-by: _warm_mu)
+        self._trace_debt: dict = {}
+        # trace+compile wall-ms of warm-lane builds, parked the same
+        # way: the statement that consumes the warmed entry reports the
+        # build it triggered in its `compile_ms` phase — byte-equal
+        # with the lane off, where the same statement compiles inline
+        # (guarded-by: _warm_mu)
+        self._compile_debt: dict = {}
     # DQ task-graph runtime (`ydb_tpu/dq/`): >0 while THIS THREAD is
     # running a statement as a stage program of a distributed task — the
     # worker's share of a multi-process graph, or the 1-worker degenerate
@@ -189,11 +214,12 @@ class Executor:
                 continue
             if not sources:
                 continue
-            est = estimate_scan_bytes(sources, storage_names)
+            Kb = shape_buckets.bucket_sources(len(sources))
+            est = estimate_scan_bytes(sources, storage_names, pad_to=Kb)
             if est > self.fused_scan_budget_bytes:
                 continue
             self.device_cache.superblock(table, storage_names, {}, snapshot,
-                                         None, sources, _ids)
+                                         None, sources, _ids, pad_to=Kb)
         return self.device_cache.bytes
 
     # -- entry -------------------------------------------------------------
@@ -323,7 +349,12 @@ class Executor:
         )
         sources, src_ids = enumerate_scan_sources(table, snapshot,
                                                   pipe.scan.prune or None)
-        if sources and estimate_scan_bytes(sources, storage_names) \
+        # shape buckets: quantize the source count so a growing table
+        # reuses the bucket's program (zero-length pad rows, masked out
+        # by the per-row length vector exactly like short real sources)
+        Kb = shape_buckets.bucket_sources(len(sources))
+        if sources and estimate_scan_bytes(sources, storage_names,
+                                           pad_to=Kb) \
                 > self.fused_scan_budget_bytes:
             return self._execute_fused_tiled(
                 plan, params, pipe, sources, scan_cols, builds, join_metas,
@@ -333,7 +364,7 @@ class Executor:
             sb = self.device_cache.superblock(table, storage_names, rename,
                                               snapshot,
                                               pipe.scan.prune or None,
-                                              sources, src_ids)
+                                              sources, src_ids, pad_to=Kb)
         if sb is None:
             return builds or None          # empty scan → portioned path
         arrays, valids, lengths, K, CAP, sb_dicts = sb
@@ -361,9 +392,8 @@ class Executor:
         key = F.fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names,
                                 builds_sig, sort_spec, rank_assigns,
                                 tuple(sorted(all_params)), lim_key=lim_key)
-        entry = self._fused_cache.get(key)
-        fresh_compile = entry is None
-        if entry is None:
+
+        def _builder():
             fn, layout_box = F.build_fused_fn(
                 pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
                 join_metas, rank_assigns, sort_spec, plan.limit, plan.offset,
@@ -372,10 +402,16 @@ class Executor:
             keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
             out_cols = [c for c in schema.columns if c.name in keep] \
                 or list(schema.columns)
-            out_schema = Schema(out_cols)
-        else:
+            return fn, layout_box, Schema(out_cols)
+
+        entry = self._fused_cache.get(key)
+        fresh_compile = entry is None
+        if entry is not None:
             fn, layout_box, out_schema = entry
             progstats.record_hit(getattr(fn, "key_id", None))
+            self._consume_trace_debt("fused", key)
+        else:
+            fn = layout_box = out_schema = None
 
         dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray) else v)
                       for k, v in all_params.items()}
@@ -384,18 +420,24 @@ class Executor:
                 _xla_scope("device-dispatch"):
             import time as _time
             t_disp = _time.perf_counter()
-            if fresh_compile:
-                # fresh shapes compile INSIDE the dispatch span (the
+            fill_wait_ms = 0.0
+            if fn is None:
+                # fresh shapes fill INSIDE the dispatch span (the
                 # compile stays at the span front for the critical-path
-                # split and the phase breakdown): the program
-                # observatory's AOT capture (`utils/progstats.capture` —
-                # lower().compile(), ONE trace + ONE compile, cost and
-                # memory analysis recorded) under YDB_TPU_PROGSTATS=1,
-                # the legacy lazy-jit first call otherwise
-                fn = progstats.capture(
-                    "fused", key, fn,
-                    (arrays, valids, lengths, build_inputs, dev_params))
-                self._fused_cache[key] = (fn, layout_box, out_schema)
+                # split and the phase breakdown): exec cache → the
+                # persistent program store (a deserialize, compile_ms
+                # ~= 0) → the program observatory's AOT capture
+                # (`utils/progstats.capture` — lower().compile(), ONE
+                # trace + ONE compile, cost and memory analysis
+                # recorded, the executable serialized back to the
+                # store); all under single-flight so a storm on this
+                # shape compiles once
+                (fn, layout_box, out_schema), fresh_compile = \
+                    self._fused_fill(
+                        "fused", key, _builder,
+                        (arrays, valids, lengths, build_inputs,
+                         dev_params))
+                fill_wait_ms = (_time.perf_counter() - t_disp) * 1000.0
             data_stacks, valid_stack, length = fn(arrays, valids, lengths,
                                                   build_inputs, dev_params)
             if fresh_compile:
@@ -405,6 +447,21 @@ class Executor:
                 # program's trace+compile cost
                 dsp.attrs["compile_ms"] = round(
                     (_time.perf_counter() - t_disp) * 1000.0, 3)
+            else:
+                # compile-ahead consumer: the build ran on the lane's
+                # worker thread, triggered by THIS statement's own
+                # planning — report the parked trace+compile cost here,
+                # once, exactly as the lane-off inline compile would.
+                # `compile_wait_ms` is the slice of that build the
+                # dispatch actually blocked on (the rest overlapped
+                # planning): the phase roll-up subtracts the wait, not
+                # the whole off-thread build, from dispatch_ms
+                with self._warm_mu:
+                    warm_ms = self._compile_debt.pop(("fused", key), None)
+                if warm_ms is not None:
+                    dsp.attrs["compile_ms"] = warm_ms
+                    dsp.attrs["compile_wait_ms"] = round(
+                        min(fill_wait_ms, warm_ms), 3)
         # result buffers live in HBM until the future drains them
         memledger.record_alloc(
             "result_buffers",
@@ -444,6 +501,217 @@ class Executor:
 
         fut = DeviceResultFuture(fetch)
         return fut if defer else fut.result()
+
+    def _fused_fill(self, kind: str, key, builder, capture_args,
+                    source: str = "fresh", cache: bool = True,
+                    warm_lane: bool = False):
+        """Single-flight fused/batched program fill. The miss ladder:
+        exec cache (a concurrent filler won) → persistent program store
+        (deserialize, `compile_ms ~= 0`, the trace-time `layout_box`/
+        `out_schema` replayed from the stored extra) → `builder()` +
+        AOT capture (the fresh executable — and its layout extra — is
+        serialized back into the store inside `capture`).
+
+        Concurrent fillers of the same (kind, key) dedup on one leader:
+        the storm case compiles once and every follower shares the
+        leader's `(handle, layout_box, out_schema)` triple. Returns
+        `(triple, compiled_here)` — `compiled_here` False on every path
+        that skipped the trace+compile (cache, store, follower).
+
+        `cache=False`: return without parking the entry (the batched
+        lane caches only after its first successful dispatch, so a
+        trace-failing shape never wedges a dead entry in the budget).
+
+        `warm_lane=True` (the compile-ahead worker): a fresh build's
+        trace-time gauges land in the WORKER's thread-local window, so
+        the leader parks its trace delta in `_trace_debt`; the first
+        foreground fill of the same key (warm_lane=False) pops it and
+        folds it into the consuming statement's window — EXPLAIN
+        ANALYZE / `last_stats.bounds` report the build the statement
+        triggered, whichever thread ran it."""
+        import threading as _threading
+        import time as _time
+
+        from ydb_tpu.ops.xla_exec import (groupby_trace_delta,
+                                          groupby_trace_mark)
+
+        def _fill():
+            ent = self._fused_cache.get(key)
+            if ent is not None:
+                progstats.record_hit(getattr(ent[0], "key_id", None))
+                return ent, False, 0
+            loaded = progstats.store_load(kind, key,
+                                          lambda: builder()[0])
+            if loaded is not None:
+                handle, extra = loaded
+                ent = (handle, extra["layout_box"], extra["out_schema"])
+                if cache:
+                    self._fused_cache[key] = ent
+                return ent, False, 0
+            mark = groupby_trace_mark() if warm_lane else None
+            t_build = _time.perf_counter() if warm_lane else 0.0
+            fn, layout_box, out_schema = builder()
+            handle = progstats.capture(
+                kind, key, fn, capture_args, consult_store=False,
+                store_extra={"layout_box": layout_box,
+                             "out_schema": out_schema}, source=source)
+            ent = (handle, layout_box, out_schema)
+            if cache:
+                self._fused_cache[key] = ent
+            if warm_lane:
+                debt = groupby_trace_delta(mark)
+                ms = round((_time.perf_counter() - t_build) * 1000.0, 3)
+                with self._warm_mu:
+                    if debt:
+                        self._trace_debt[(kind, key)] = debt
+                    self._compile_debt[(kind, key)] = ms
+            return ent, True, _threading.get_ident()
+
+        ent, compiled_here, leader_tid = \
+            self._sflight.run((kind, key), _fill)
+        if not warm_lane:
+            self._consume_trace_debt(kind, key)
+        # a follower that deduped onto another thread's compile did not
+        # itself compile — its dispatch span and exec record stay lean
+        return ent, compiled_here and \
+            leader_tid == _threading.get_ident()
+
+    def _consume_trace_debt(self, kind: str, key) -> None:
+        """Fold a compile-ahead build's parked trace delta into the
+        CURRENT thread's window — called from every foreground path
+        that can consume a warm-lane-filled entry (the direct cache
+        hit and the single-flight fill)."""
+        if not self._trace_debt:
+            return
+        with self._warm_mu:
+            debt = self._trace_debt.pop((kind, key), None)
+        if debt:
+            from ydb_tpu.ops.xla_exec import groupby_trace_fold
+            groupby_trace_fold(debt)
+
+    # -- compile-ahead lane ------------------------------------------------
+
+    def compile_ahead(self, plan: QueryPlan, params: dict,
+                      snapshot: Snapshot) -> bool:
+        """Kick a background fill for this plan's fused program while
+        the statement waits in the admission queue (`query/engine.py`
+        calls this between planning and `admission.admit`). The warm
+        thunk mirrors the synchronous fused setup up to the program key
+        and then runs the SAME single-flight fill the dispatch path
+        uses — store consult first (a warmed shape deserializes,
+        near-free), fresh AOT compile otherwise — so when the statement
+        clears admission the executable is ready, or in flight with the
+        dispatch deduping onto it.
+
+        Plan-level dedup keeps the lane cheap under repeat traffic: one
+        launch per (table, data_version, lift_sig); non-lifted plans
+        (no value-free identity) and mesh-distributed plans skip the
+        lane. Returns True when a background fill was launched."""
+        if not (self.enable_fused and ca_lane.enabled()
+                and progstats.enabled()):
+            return False
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            return False
+        sig = getattr(plan, "lift_sig", None)
+        if sig is None:
+            return False
+        if getattr(plan, "init_subplans", None):
+            # scalar-subquery params are computed at dispatch time; the
+            # warm thunk would key on an incomplete param set
+            return False
+        pipe = plan.pipeline
+        try:
+            table = self.catalog.table(pipe.scan.table)
+        except Exception:              # noqa: BLE001 — lane, not law
+            return False
+        warm_key = (pipe.scan.table, table.data_version, sig)
+        with self._warm_mu:
+            if warm_key in self._warm_seen:
+                return False
+            self._warm_seen.add(warm_key)
+        params = dict(params)
+        return self._sflight.launch(
+            ("warm",) + warm_key,
+            lambda: self._fused_warm(plan, params, snapshot))
+
+    def _fused_warm(self, plan: QueryPlan, params: dict,
+                    snapshot: Snapshot) -> bool:
+        """Background half of the compile-ahead lane: the fused-path
+        setup (builds, plan walk, superblock, key derivation) WITHOUT
+        dispatch, landing in the same `_fused_fill` the synchronous
+        path uses. Declines exactly where that path declines to fuse —
+        a plan the dispatch would stream portioned/tiled must not burn
+        background compile on a program nobody will run."""
+        from ydb_tpu.ops import fused as F
+        from ydb_tpu.storage.device_cache import (
+            enumerate_scan_sources, estimate_scan_bytes,
+        )
+        from ydb_tpu.utils.metrics import GLOBAL
+
+        pipe = plan.pipeline
+        table = self.catalog.table(pipe.scan.table)
+        join_steps = [step for kind, step in pipe.steps if kind == "join"]
+        if len(join_steps) > self.fuse_max_joins:
+            return False
+        builds = self._prepare_builds(pipe, params, snapshot)
+        for step, bt in zip(join_steps, builds):
+            if isinstance(bt, J.PartitionedBuild) or (
+                    not bt.unique and step.kind in ("inner", "left",
+                                                    "mark")):
+                return False
+        (plan, pipe, scan_cols, schema, partial_schema, dicts,
+         join_metas) = self._fused_plan_setup(plan, builds)
+        storage_names = [s for (s, _i) in pipe.scan.columns]
+        rename = {s: i for (s, i) in pipe.scan.columns}
+        sources, src_ids = enumerate_scan_sources(table, snapshot,
+                                                  pipe.scan.prune or None)
+        Kb = shape_buckets.bucket_sources(len(sources))
+        if not sources or estimate_scan_bytes(sources, storage_names,
+                                              pad_to=Kb) \
+                > self.fused_scan_budget_bytes:
+            return False                 # empty / tiled-class scan
+        sb = self.device_cache.superblock(table, storage_names, rename,
+                                          snapshot,
+                                          pipe.scan.prune or None,
+                                          sources, src_ids, pad_to=Kb)
+        if sb is None:
+            return False
+        arrays, valids, lengths, K, CAP, sb_dicts = sb
+        sb_valid_names = frozenset(valids.keys())
+        dicts.update(sb_dicts)
+        sort_params, sort_spec, rank_assigns = self._sort_setup_fused(
+            plan, schema, dicts)
+        all_params = {**params, **sort_params}
+        lift_limit, lim_key = self._lift_limit_setup(plan, all_params)
+        builds_sig = tuple(F.build_inputs_sig(bt) for bt in builds)
+        key = F.fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names,
+                                builds_sig, sort_spec, rank_assigns,
+                                tuple(sorted(all_params)), lim_key=lim_key)
+        if key in self._fused_cache:
+            return False                 # already live — nothing to warm
+
+        def _builder():
+            fn, layout_box = F.build_fused_fn(
+                pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
+                join_metas, rank_assigns, sort_spec, plan.limit, plan.offset,
+                tuple(dict.fromkeys(n for (n, _lbl) in plan.output)),
+                lift_limit=lift_limit)
+            keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
+            out_cols = [c for c in schema.columns if c.name in keep] \
+                or list(schema.columns)
+            return fn, layout_box, Schema(out_cols)
+
+        dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray)
+                          else v) for k, v in all_params.items()}
+        build_inputs = [F.build_traced_inputs(bt) for bt in builds]
+        self._fused_fill(
+            "fused", key, _builder,
+            (arrays, valids, lengths, build_inputs, dev_params),
+            source="compile_ahead", warm_lane=True)
+        # the program is ready before its first dispatch — whether it
+        # was compiled here or deserialized from the store
+        GLOBAL.inc("prog/compile_ahead_hits")
+        return True
 
     def _sort_setup_fused(self, plan: QueryPlan, schema: Schema,
                           dicts: dict):
@@ -603,13 +871,15 @@ class Executor:
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
         sources, src_ids = enumerate_scan_sources(table, snapshot, None)
-        if not sources or estimate_scan_bytes(sources, storage_names) \
+        Kb = shape_buckets.bucket_sources(len(sources))
+        if not sources or estimate_scan_bytes(sources, storage_names,
+                                              pad_to=Kb) \
                 > self.fused_scan_budget_bytes:
             return None                  # empty / tiled-class scan
         with self._span("superblock-upload"):
             sb = self.device_cache.superblock(table, storage_names, rename,
                                               snapshot, None, sources,
-                                              src_ids)
+                                              src_ids, pad_to=Kb)
         if sb is None:
             return None
         arrays, valids, lengths, K, CAP, sb_dicts = sb
@@ -687,17 +957,21 @@ class Executor:
         # the identical trace is dispatched/recorded, not what it computes
         # lint: allow-cache-key(progstats/memledger/critpath observe only)
         cached = self._fused_cache.get(key)
-        if cached is None:
-            fn, layout_box = F.build_fused_batched_fn(
+        fresh_compile = cached is None
+        if cached is not None:
+            fn, layout_box, out_schema = cached
+            progstats.record_hit(getattr(fn, "key_id", None))
+        else:
+            fn = layout_box = out_schema = None
+
+        def _builder():
+            bfn, box = F.build_fused_batched_fn(
                 pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
                 join_metas, rank_assigns, sort_spec, plan.limit,
                 plan.offset, keep, dict(axes), Bb, lift_limit=lift_limit)
             out_cols = [c for c in schema.columns if c.name in keep] \
                 or list(schema.columns)
-            out_schema = Schema(out_cols)
-        else:
-            fn, layout_box, out_schema = cached
-            progstats.record_hit(getattr(fn, "key_id", None))
+            return bfn, box, Schema(out_cols)
 
         dev_params = {k: (jnp.asarray(v) if isinstance(v, np.ndarray)
                           else v) for k, v in stacked.items()}
@@ -708,18 +982,21 @@ class Executor:
                     _xla_scope("device-dispatch-batched"):
                 import time as _time
                 t_disp = _time.perf_counter()
-                if cached is None:
-                    # AOT capture for the stacked program too (compile
-                    # inside the dispatch span; a trace error re-raises
-                    # at the call below and the lane falls back
-                    # per-member exactly as before)
-                    fn = progstats.capture(
-                        "batched", key, fn,
-                        (arrays, valids, lengths, build_inputs,
-                         dev_params))
+                if fn is None:
+                    # fill for the stacked program too: store consult →
+                    # AOT capture, single-flight deduped (compile inside
+                    # the dispatch span; a trace error re-raises at the
+                    # call below and the lane falls back per-member
+                    # exactly as before). cache=False — the entry parks
+                    # only after the first successful dispatch.
+                    (fn, layout_box, out_schema), fresh_compile = \
+                        self._fused_fill(
+                            "batched", key, _builder,
+                            (arrays, valids, lengths, build_inputs,
+                             dev_params), cache=False)
                 data_stacks, valid_stack, length = fn(
                     arrays, valids, lengths, build_inputs, dev_params)
-                if cached is None:
+                if fresh_compile:
                     dsp.attrs["compile_ms"] = round(
                         (_time.perf_counter() - t_disp) * 1000.0, 3)
         except Exception:                # noqa: BLE001 — lane, not law
@@ -749,7 +1026,7 @@ class Executor:
             jax.block_until_ready((data_stacks, valid_stack, length))
             exec_ms = (_time.perf_counter() - t_exec) * 1000.0
         progstats.record_exec(getattr(fn, "key_id", None), exec_ms,
-                              fresh=cached is None)
+                              fresh=fresh_compile)
         with self._span("readout-transfer", b=len(members)):
             blocks = F.fetch_fused_batch(data_stacks, valid_stack, length,
                                          layout_box, out_schema, out_dicts,

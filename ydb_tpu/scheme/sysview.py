@@ -21,7 +21,7 @@ PREFIX = ".sys/"
 VIEWS = ("tables", "partition_stats", "counters", "query_metrics",
          "top_queries_by_duration", "dq_stage_stats", "query_profiles",
          "cluster_nodes", "query_memory", "device_transfers",
-         "query_critical_path", "compiled_programs")
+         "query_critical_path", "compiled_programs", "progstore")
 
 
 def is_sysview(name: str) -> bool:
@@ -257,7 +257,8 @@ def sysview_block(engine, name: str) -> HostBlock:
         from ydb_tpu.utils.progstats import inventory_rows
         rows = [{
             "program": r["program"], "kind": r["kind"],
-            "state": r["state"], "hits": int(r["hits"]),
+            "state": r["state"], "source": r["source"],
+            "hits": int(r["hits"]),
             "misses": int(r["misses"]),
             "evictions": int(r["evictions"]),
             "compiles": int(r["compiles"]),
@@ -282,7 +283,8 @@ def sysview_block(engine, name: str) -> HostBlock:
             "bound_class": r["bound_class"],
         } for r in inventory_rows()]
         return _block(rows, [("program", str), ("kind", str),
-                             ("state", str), ("hits", "int64"),
+                             ("state", str), ("source", str),
+                             ("hits", "int64"),
                              ("misses", "int64"),
                              ("evictions", "int64"),
                              ("compiles", "int64"),
@@ -304,6 +306,45 @@ def sysview_block(engine, name: str) -> HostBlock:
                              ("intensity", "float64"),
                              ("utilization_pct", "float64"),
                              ("bound_class", str)])
+    if view == "progstore":
+        # the persistent compiled-program store (ydb_tpu/progstore):
+        # one row — index size, on-disk footprint, per-kind entry
+        # counts, this process's load/save activity, the cumulative
+        # store counters, and the admission backlog the compile-ahead
+        # lane overlaps with. A disabled store reports root='' with
+        # zero entries (never a fabricated store).
+        from ydb_tpu.progstore import store as _pstore
+        st = _pstore.stats()
+        bl = engine.admission.backlog() \
+            if hasattr(engine, "admission") else {}
+        rows = [{
+            "root": st["root"], "entries": int(st["entries"]),
+            "objects": int(st["objects"]),
+            "object_bytes": int(st["object_bytes"]),
+            "fused": int(st["kinds"].get("fused", 0)),
+            "batched": int(st["kinds"].get("batched", 0)),
+            "program": int(st["kinds"].get("program", 0)),
+            "loads": int(st["loads"]), "saves": int(st["saves"]),
+            "hits": int(st["hits"]), "misses": int(st["misses"]),
+            "writes": int(st["writes"]), "corrupt": int(st["corrupt"]),
+            "refused": int(st["refused"]), "errors": int(st["errors"]),
+            "env": st["env"], "device": st["device"],
+            "admission_active": int(bl.get("active", 0)),
+            "admission_in_flight_bytes":
+                int(bl.get("in_flight_bytes", 0)),
+        }]
+        return _block(rows, [("root", str), ("entries", "int64"),
+                             ("objects", "int64"),
+                             ("object_bytes", "int64"),
+                             ("fused", "int64"), ("batched", "int64"),
+                             ("program", "int64"), ("loads", "int64"),
+                             ("saves", "int64"), ("hits", "int64"),
+                             ("misses", "int64"), ("writes", "int64"),
+                             ("corrupt", "int64"),
+                             ("refused", "int64"), ("errors", "int64"),
+                             ("env", str), ("device", str),
+                             ("admission_active", "int64"),
+                             ("admission_in_flight_bytes", "int64")])
     if view == "device_transfers":
         # the host-transfer flight recorder's recent-transfer ring
         # (utils/memledger.py, process-wide): one row per recorded

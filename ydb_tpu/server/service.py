@@ -208,6 +208,22 @@ class QueryServicer:
             return {"error": "Unauthenticated: invalid or missing token"}
         return {"counters": self.engine.counters()}
 
+    def prog_store_stats(self, request, context):
+        """Persistent program-store snapshot (the zero-compile serving
+        surface): store inventory + hit/miss/corrupt/refused counters +
+        the admission backlog a compile-ahead fill overlaps with. The
+        warm-start workflow polls this after restart to confirm every
+        dispatched shape came from disk."""
+        if not self._authed(request):
+            return {"error": "Unauthenticated: invalid or missing token"}
+        try:
+            from ydb_tpu.progstore import store as prog_store
+            snap = prog_store.stats()
+            snap["admission"] = self.engine.admission.backlog()
+            return {"store": snap}
+        except Exception as e:               # noqa: BLE001 — wire boundary
+            return {"error": f"{type(e).__name__}: {e}"}
+
     # -- worker<->worker exchange (DQ channel data plane) ------------------
     #
     # The DQ task runner (`ydb_tpu/dq/runner.py`) drives stage graphs:
@@ -603,6 +619,9 @@ def serve(engine, port: int = 2136, max_workers: int = 8,
         "Counters": grpc.unary_unary_rpc_method_handler(
             servicer.counters, request_deserializer=_deser,
             response_serializer=_ser),
+        "ProgStoreStats": grpc.unary_unary_rpc_method_handler(
+            servicer.prog_store_stats, request_deserializer=_deser,
+            response_serializer=_ser),
         "Ping": grpc.unary_unary_rpc_method_handler(
             servicer.ping, request_deserializer=_deser,
             response_serializer=_ser),
@@ -717,6 +736,9 @@ class Client:
         self._counters = self._channel.unary_unary(
             f"/{SERVICE}/Counters", request_serializer=_ser,
             response_deserializer=_deser)
+        self._prog_store_stats = self._channel.unary_unary(
+            f"/{SERVICE}/ProgStoreStats", request_serializer=_ser,
+            response_deserializer=_deser)
         self._ping = self._channel.unary_unary(
             f"/{SERVICE}/Ping", request_serializer=_ser,
             response_deserializer=_deser)
@@ -756,6 +778,12 @@ class Client:
         if "error" in resp:
             raise RuntimeError(resp["error"])
         return resp["counters"]
+
+    def prog_store_stats(self) -> dict:
+        resp = self._prog_store_stats({"token": self.token})
+        if "error" in resp:
+            raise RuntimeError(resp["error"])
+        return resp["store"]
 
     def dq_run_task(self, task_id: str, stage: str, sql: str,
                     outputs: list, src: str = "",

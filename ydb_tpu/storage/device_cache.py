@@ -51,13 +51,16 @@ def enumerate_scan_sources(table, snapshot, prune):
     return sources, src_ids
 
 
-def estimate_scan_bytes(sources, storage_names: list) -> int:
+def estimate_scan_bytes(sources, storage_names: list,
+                        pad_to: int = 0) -> int:
     """Superblock HBM footprint of a scan: K stacked sources at the max
     capacity bucket, per column data + validity — the fused-path
-    admission estimate (no upload happens to find out it didn't fit)."""
+    admission estimate (no upload happens to find out it didn't fit).
+    `pad_to`: the shape-bucketed row count (padded rows allocate real
+    HBM, so the estimate must charge them)."""
     if not sources:
         return 0
-    K = len(sources)
+    K = max(len(sources), pad_to)
     CAP = max(bucket_capacity(max(b.length, 1)) for b in sources)
     total = 0
     for s in storage_names:
@@ -172,13 +175,22 @@ class DeviceColumnCache:
         return self._insert(key, data, valid, nbytes)
 
     def superblock(self, table, storage_names: list, rename: dict,
-                   snapshot, prune, sources=None, src_ids=None):
+                   snapshot, prune, sources=None, src_ids=None,
+                   pad_to: int = 0):
         """Stacked (K, CAP) device arrays covering every visible scan source
         of `table` — the input of the whole-query fused program
         (`ydb_tpu/ops/fused.py`), one upload per column per data version.
 
         `sources`/`src_ids`: pass a pre-enumerated source list (the
         executor's admission estimate already walked the shards once).
+
+        `pad_to`: quantize the row count up to a shape bucket
+        (`progstore/buckets.bucket_sources`) — rows beyond the real K
+        are zero-filled with length 0, which the fused kernels mask out
+        exactly like a short real source, so a growing table reuses the
+        bucket's compiled program instead of minting a shape per count.
+        The EFFECTIVE row count rides the cache key (an exact-K stack
+        and its padded sibling are different device arrays).
 
         Returns (arrays {internal: (K,CAP)}, valids {internal: (K,CAP)},
         lengths jnp (K,), K, CAP, dicts) or None when the table has no
@@ -187,15 +199,16 @@ class DeviceColumnCache:
             sources, src_ids = enumerate_scan_sources(table, snapshot, prune)
         if not sources:
             return None
-        K = len(sources)
+        K = max(len(sources), pad_to)
         CAP = max(bucket_capacity(max(b.length, 1)) for b in sources)
         # no snapshot component: src_ids already reflect exactly which
         # sources the snapshot sees (portions are immutable), and
         # data_version covers commits — a snapshot in the key would make
         # every write to ANY table re-stack and re-upload this one
-        src_key = (table.uid, table.data_version, tuple(src_ids), CAP)
+        src_key = (table.uid, table.data_version, tuple(src_ids), CAP, K)
 
-        lengths_np = np.array([b.length for b in sources], np.int32)
+        lengths_np = np.zeros(K, np.int32)
+        lengths_np[:len(sources)] = [b.length for b in sources]
         arrays, valids, dicts = {}, {}, {}
         for s in storage_names:
             out = rename.get(s, s)

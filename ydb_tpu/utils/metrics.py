@@ -376,6 +376,34 @@ COUNTER_REGISTRY = {
         "[viz] AOT calls re-dispatched via jit (aval/device drift)",
     "prog/utilization_pct":
         "[hist] per-execution roofline utilization (% of peak)",
+    # -- persistent program store + compile-ahead lane (ydb_tpu/progstore):
+    # executables that outlive the process, shape buckets, background
+    # compiles overlapped with the admission wait ---------------------------
+    "prog/store_hits":
+        "[viz] executables deserialized from the on-disk store "
+        "(compile_ms ~= 0 — the zero-compile restart path)",
+    "prog/store_misses": "[viz] store lookups that found no entry",
+    "prog/store_writes": "[viz] fresh executables serialized to disk",
+    "prog/store_corrupt":
+        "[viz] corrupt/truncated/version-skewed entries evicted from "
+        "disk and treated as cold misses",
+    "prog/store_refused":
+        "[viz] entries refused on device-fingerprint mismatch (a "
+        "copied data dir must not dispatch a foreign executable)",
+    "prog/store_errors":
+        "[viz] store I/O failures swallowed as misses (a broken disk "
+        "never fails the query)",
+    "prog/compile_ahead_launches":
+        "[viz] background fused-program fills kicked before admission",
+    "prog/compile_ahead_hits":
+        "[viz] programs the background lane made ready before their "
+        "first dispatch",
+    "prog/compile_ahead_dedup":
+        "[viz] concurrent fills that deduped onto an in-flight "
+        "compile (the storm-compiles-once guarantee)",
+    "prog/compile_ahead_errors":
+        "[viz] background fills that failed (the synchronous path "
+        "re-raises with full context)",
     "device_cache/hits": "(derived) HBM column cache hits",
     "device_cache/misses": "(derived) HBM column cache misses",
     "device_cache/bytes": "(derived) HBM column cache residency",
@@ -538,8 +566,14 @@ class QueryStats:
                 head += f" | {p['bound_class']}"
             out += head
             for pr in p["programs"][:6]:
-                line = (f"\n--   {pr['key']}"
-                        f"{' [fresh]' if pr.get('fresh') else ''}: ")
+                # provenance tag: [fresh] = compiled inside this
+                # statement; [store]/[compile-ahead] = the compile was
+                # skipped (persistent store hit / background lane)
+                src = pr.get("source", "fresh")
+                tag = (" [fresh]" if pr.get("fresh")
+                       else f" [{src.replace('_', '-')}]"
+                       if src != "fresh" else "")
+                line = f"\n--   {pr['key']}{tag}: "
                 if pr.get("bound_class") == "unavailable" \
                         or pr.get("flops") is None:
                     line += ("cost unavailable (backend withheld "

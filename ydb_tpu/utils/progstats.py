@@ -277,6 +277,35 @@ def _hlo_op_count(compiled) -> int:
         return 0
 
 
+class LazyJit:
+    """The jit-path stand-in for a store-loaded handle: the store hit
+    skipped tracing entirely, so there is no jitted callable to fall
+    back to until drift actually happens. `rebuild()` then produces it
+    once (paying exactly the trace+compile the legacy path would have
+    paid) and is memoized."""
+
+    __slots__ = ("_rebuild", "_fn", "_mu")
+
+    def __init__(self, rebuild):
+        self._rebuild = rebuild
+        self._fn = None
+        self._mu = threading.Lock()
+
+    def __call__(self, *args):
+        with self._mu:
+            if self._fn is None:
+                self._fn = self._rebuild()
+            fn = self._fn
+        return fn(*args)
+
+    def clear_cache(self) -> None:
+        with self._mu:
+            fn, self._fn = self._fn, None
+        cc = getattr(fn, "clear_cache", None)
+        if callable(cc):
+            cc()
+
+
 class ProgramHandle:
     """A cache entry wrapping the AOT-compiled executable. Calls
     dispatch through the `Compiled`; aval/device drift (a mesh path
@@ -309,16 +338,44 @@ class ProgramHandle:
             cc()
 
 
-def capture(kind: str, key, jit_fn, args):
+def _analysis_triple(compiled, extra=None):
+    """(cost, memory, hlo_ops) for an executable — preferring the
+    values the SAVING process persisted (a deserialized executable may
+    withhold analysis the original compile reported)."""
+    extra = extra or {}
+    cost = extra.get("cost") or _cost_dict(compiled)
+    mem = extra.get("memory") or _memory_dict(compiled)
+    hlo = int(extra.get("hlo_ops") or 0) or _hlo_op_count(compiled)
+    return cost, mem, hlo
+
+
+def capture(kind: str, key, jit_fn, args, consult_store: bool = True,
+            store_extra=None, source: str = "fresh"):
     """AOT-compile `jit_fn(*args)` at a fresh cache fill, recording the
     executable's cost/memory analysis into the inventory. Returns a
     `ProgramHandle` to cache in place of `jit_fn` — or `jit_fn`
     unchanged when disabled or when lower/compile raises (trace errors
     then surface at the normal jit call site, byte-identical to the
-    legacy lazy path)."""
+    legacy lazy path).
+
+    With the program store enabled, the store is consulted FIRST: a hit
+    deserializes the persisted executable and registers with
+    `compile_ms = 0` and `source = "store"` (no trace, no compile). A
+    fresh compile is serialized back into the store. `consult_store =
+    False` skips the lookup for call sites that already consulted the
+    store themselves (the fused lane, which needs the stored extra
+    payload before it can even build `jit_fn`)."""
     if not enabled():
         return jit_fn
     kid = key_id(kind, key)
+    pstore = _store() if consult_store else None
+    if pstore is not None:
+        rec = pstore.load(kind, key)
+        if rec is not None:
+            compiled = rec["compiled"]
+            cost, mem, hlo = _analysis_triple(compiled, rec["extra"])
+            _register(kid, kind, 0.0, cost, mem, hlo, source="store")
+            return ProgramHandle(kid, jit_fn, compiled, 0.0)
     t0 = time.perf_counter()
     try:
         compiled = jit_fn.lower(*args).compile()
@@ -326,13 +383,68 @@ def capture(kind: str, key, jit_fn, args):
         GLOBAL.inc("prog/aot_errors")  # re-raises the real error
         return jit_fn
     ms = (time.perf_counter() - t0) * 1000.0
-    _register(kid, kind, ms, _cost_dict(compiled),
-              _memory_dict(compiled), _hlo_op_count(compiled))
+    cost, mem, hlo = (_cost_dict(compiled), _memory_dict(compiled),
+                      _hlo_op_count(compiled))
+    _register(kid, kind, ms, cost, mem, hlo, source=source)
+    pstore = _store()
+    if pstore is not None:
+        extra = dict(store_extra or {})
+        extra.update({"cost": cost, "memory": mem, "hlo_ops": hlo})
+        pstore.save(kind, key, compiled, extra=extra)
     return ProgramHandle(kid, jit_fn, compiled, round(ms, 3))
 
 
+def store_load(kind: str, key, rebuild):
+    """Fused-lane store lookup: deserialize the persisted executable
+    for (kind, key) WITHOUT building or tracing anything. Returns
+    `(handle, extra)` — `extra` carrying whatever the saving process
+    persisted alongside (the fused lane needs `layout_box`/`out_schema`
+    that only trace time would otherwise produce) — or None on any
+    miss. `rebuild` lazily reconstructs the jitted callable for the
+    drift-fallback path (memoized, never called on the hit path)."""
+    if not enabled():
+        return None
+    pstore = _store()
+    if pstore is None:
+        return None
+    rec = pstore.load(kind, key)
+    if rec is None:
+        return None
+    kid = key_id(kind, key)
+    compiled = rec["compiled"]
+    cost, mem, hlo = _analysis_triple(compiled, rec["extra"])
+    _register(kid, kind, 0.0, cost, mem, hlo, source="store")
+    return ProgramHandle(kid, LazyJit(rebuild), compiled, 0.0), rec["extra"]
+
+
+def store_save(kind: str, key, handle, extra=None) -> None:
+    """Persist an already-captured handle's executable (the fused lane
+    saves AFTER first successful dispatch, when `layout_box` is
+    populated — a trace-time artifact the store hit must replay)."""
+    pstore = _store()
+    if pstore is None or not isinstance(handle, ProgramHandle):
+        return
+    compiled = handle._compiled
+    if compiled is None:
+        return
+    ent = inventory_entry(handle.key_id) or {}
+    full = {"cost": ent.get("cost"), "memory": ent.get("memory"),
+            "hlo_ops": ent.get("hlo_ops", 0)}
+    full.update(extra or {})
+    pstore.save(kind, key, compiled, extra=full)
+
+
+def _store():
+    """The active program store, or None (lever off / open failure)."""
+    try:
+        from ydb_tpu.progstore import store as _ps
+        return _ps.get_store()
+    except Exception:                  # noqa: BLE001 — store is optional
+        return None
+
+
 def _register(kid: str, kind: str, compile_ms, cost, mem,
-              hlo_ops: int) -> None:
+              hlo_ops: int, source: str = "fresh") -> None:
     GLOBAL.inc("prog/registered")
     if compile_ms:
         GLOBAL.inc("prog/compile_ms", compile_ms)
@@ -346,7 +458,7 @@ def _register(kid: str, kind: str, compile_ms, cost, mem,
                 "hits": 0, "misses": 0, "evictions": 0, "compiles": 0,
                 "compile_ms": 0.0, "cost": None, "memory": None,
                 "hlo_ops": 0, "execs": 0, "device_ms": 0.0,
-                "device_ms_max": 0.0,
+                "device_ms_max": 0.0, "source": source,
             }
         was_evicted = ent["state"] == "evicted"
         ent["state"] = "live"
@@ -356,6 +468,7 @@ def _register(kid: str, kind: str, compile_ms, cost, mem,
         ent["cost"] = cost
         ent["memory"] = mem
         ent["hlo_ops"] = int(hlo_ops)
+        ent["source"] = source
         _INVENTORY.move_to_end(kid)
         while len(_INVENTORY) > ring_len():
             _INVENTORY.popitem(last=False)
@@ -410,6 +523,7 @@ def record_exec(kid, device_ms: float, fresh: bool = False) -> None:
         ent["device_ms_max"] = max(ent["device_ms_max"], device_ms)
         cost = dict(ent["cost"]) if ent["cost"] else None
         kind = ent["kind"]
+        source = ent.get("source", "fresh")
     GLOBAL.inc("prog/executions")
     GLOBAL.inc("prog/device_ms", device_ms)
     rf = roofline(cost.get("flops") if cost else None,
@@ -419,7 +533,7 @@ def record_exec(kid, device_ms: float, fresh: bool = False) -> None:
         GLOBAL_HIST.observe("prog/utilization_pct", rf["utilization_pct"])
     st = current()
     if st is not None:
-        st.add({"key": kid, "kind": kind,
+        st.add({"key": kid, "kind": kind, "source": source,
                 "device_ms": round(device_ms, 3), "fresh": bool(fresh),
                 "flops": cost.get("flops") if cost else None,
                 "bytes_accessed":
@@ -524,6 +638,7 @@ def inventory_rows() -> list:
                       e["device_ms_max"] or None, pk=pk)
         rows.append({
             "program": e["key"], "kind": e["kind"], "state": e["state"],
+            "source": e.get("source", "fresh"),
             "hits": e["hits"], "misses": e["misses"],
             "evictions": e["evictions"], "compiles": e["compiles"],
             "compile_ms": round(e["compile_ms"], 3),

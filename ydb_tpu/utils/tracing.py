@@ -82,8 +82,14 @@ def phase_breakdown(spans) -> dict:
     {phase: ms} dict. Compile happens INSIDE the first dispatch of a
     fresh shape (the dispatch span's dur contains it, stamped as the
     `compile_ms` attr), so it is pulled OUT of dispatch_ms here —
-    the phases are disjoint and safe to sum."""
+    the phases are disjoint and safe to sum. A compile-ahead build runs
+    on the lane's worker thread CONCURRENTLY with planning: the span
+    then carries `compile_wait_ms` (the portion of the build the
+    dispatch actually blocked on), and only that much is pulled out —
+    subtracting the full off-thread build would eat the real enqueue
+    time the span also covers."""
     out: dict = {}
+    in_dispatch = 0.0
     for s in spans:
         key = PHASE_SPANS.get(s.name)
         if key is not None:
@@ -91,9 +97,10 @@ def phase_breakdown(spans) -> dict:
         c = s.attrs.get("compile_ms")
         if c:
             out["compile_ms"] = out.get("compile_ms", 0.0) + float(c)
-    if out.get("compile_ms") and out.get("dispatch_ms"):
-        out["dispatch_ms"] = max(0.0,
-                                 out["dispatch_ms"] - out["compile_ms"])
+            w = s.attrs.get("compile_wait_ms")
+            in_dispatch += float(c) if w is None else float(w)
+    if in_dispatch and out.get("dispatch_ms"):
+        out["dispatch_ms"] = max(0.0, out["dispatch_ms"] - in_dispatch)
     return {k: round(v, 3) for k, v in out.items()}
 
 

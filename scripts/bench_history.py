@@ -44,6 +44,10 @@ HISTORY_PATH = os.path.join(REPO, "BENCH_HISTORY.jsonl")
 LAST_GOOD_PATH = os.path.join(REPO, ".bench_last_good.json")
 MULTICHIP_PATH = os.path.join(REPO, "MULTICHIP_r06.json")
 REGRESSION = float(os.environ.get("BENCH_GATE_REGRESSION", "1.25"))
+# planned-redistribution wire ceiling: the multichip leg's measured
+# padded/live on ICI segment frames (count-sized segments; the legacy
+# 2x path measured ~3.25x)
+PAD_CEILING = float(os.environ.get("BENCH_GATE_PAD_CEILING", "1.3"))
 _PROC_T0 = time.time()
 
 
@@ -205,6 +209,18 @@ def gate() -> int:
         if regressed:
             out["ok"] = False
     out["compared_suites"] = compared
+    # wire-padding trajectory: when the candidate ran the multichip leg,
+    # its planned segments must keep padded/live under the ceiling — a
+    # sizing regression (seg ladder, bound misuse) shows up here before
+    # any wall-clock number moves
+    pol = (cand.get("multichip") or {}).get("padded_over_live")
+    if pol is not None:
+        verdict = "ok" if float(pol) <= PAD_CEILING else "REGRESSED"
+        out["multichip"] = {"padded_over_live": round(float(pol), 3),
+                            "ceiling": PAD_CEILING,
+                            "verdict": verdict}
+        if verdict == "REGRESSED":
+            out["ok"] = False
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

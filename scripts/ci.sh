@@ -121,6 +121,19 @@ if [ "$irc" -ne 0 ]; then
     exit "$irc"
 fi
 
+echo "== device-resident spine gate (planned redistribution: zero in-plan host sync, wire <= 1.3x live) =="
+# the stage-spine floor: a multi-stage join runs with
+# hostsync/to_pandas_in_plan == 0 (stage results ride the device link),
+# the planned exchange keeps ICI wire bytes <= 1.3x live (the legacy 2x
+# path measured ~3.25x), results stay byte-equal vs the forced host
+# plane, and YDB_TPU_DQ_PLANNED=0 restores the legacy path byte-equal
+JAX_PLATFORMS=cpu python scripts/spine_gate.py
+sprc=$?
+if [ "$sprc" -ne 0 ]; then
+    echo "device-resident spine gate FAILED (rc=$sprc)" >&2
+    exit "$sprc"
+fi
+
 echo "== resource-ledger memory gate (padding ratio, peak HBM, flight recorder, /metrics) =="
 # the bytes floor: the bench-shaped DQ join must report a padding ratio
 # from counters alone, a fused SELECT must measure nonzero mem/peak_bytes

@@ -131,7 +131,9 @@ def child() -> int:
                "hostsync/bytes", "hostsync/to_pandas_in_plan")
 
     # -- 1/3: the DQ bench join reports a padding ratio + pins
-    # to_pandas-inside-plan nonzero -------------------------------------
+    # to_pandas-inside-plan at ZERO (the device-resident stage spine
+    # hands stage results device→device; this gate used to pin the
+    # debt nonzero before the planned path retired it) ------------------
     c, engines = mk_cluster()
     c.query(JOIN_SQL)                    # warm: compile + dictionaries
     pad0, hs0 = snap(pad_keys), snap(hs_keys)
@@ -143,7 +145,7 @@ def child() -> int:
                       "padded_over_live": round(ratio, 2)}
     out["to_pandas_in_plan"] = int(hs_d["hostsync/to_pandas_in_plan"])
     pad_ok = pad_d["pad/padded_bytes"] > 0 and ratio > 1.0
-    in_plan_ok = hs_d["hostsync/to_pandas_in_plan"] > 0
+    in_plan_ok = hs_d["hostsync/to_pandas_in_plan"] == 0
 
     # -- 2: fused SELECT peak + sysview row -----------------------------
     eng = engines[0]

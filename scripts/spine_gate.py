@@ -1,0 +1,172 @@
+"""CI gate for the device-resident stage spine (`dq/` planned path).
+
+Deterministic CPU proxy for the PR's acceptance shape: under a virtual
+4-device mesh (self-provisioned in a subprocess, the
+`__graft_entry__.dryrun_multichip` stance) a sharded×sharded bench-class
+join must
+
+  1. run its multi-stage plan with ZERO in-plan pandas materializations
+     (`hostsync/to_pandas_in_plan` flat — stage results ride the device
+     link, `devlink/handoffs` > 0);
+  2. keep planned ICI wire bytes ≤ 1.3× live bytes, measured from the
+     per-channel `.sys/dq_stage_stats` pad rows (the legacy 2x path
+     measured ~3.25×);
+  3. stay BYTE-EQUAL vs the forced host plane (`YDB_TPU_DQ_PLANE=host`,
+     the escape-hatch lever);
+  4. with `YDB_TPU_DQ_PLANNED=0`, restore the legacy 2x-padded exchange
+     byte-equal (the lever-off hatch) — its measured wire ratio must
+     EXCEED the planned one, or the lever is not switching anything.
+
+Prints one JSON line; exit 0 = green.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NDEV = 4
+ROWS = 20000
+NKEYS = 997
+WIRE_CEILING = 1.3
+JOIN_SQL = ("select k, count(*) as n, sum(v) as s, sum(x) as sx "
+            "from t, u where k = uid group by k order by k")
+
+
+def mk_cluster():
+    import numpy as np
+    import pandas as pd
+
+    from ydb_tpu.cluster import ShardedCluster
+    from ydb_tpu.dq.runner import LocalWorker
+    from ydb_tpu.query import QueryEngine
+
+    engines = []
+    for wid in range(NDEV):
+        e = QueryEngine(block_rows=1 << 14)
+        e.execute("create table t (id Int64 not null, k Int64 not null, "
+                  "v Double not null, primary key (id)) "
+                  "with (store = column)")
+        ids = np.arange(wid, ROWS, NDEV, dtype=np.int64)
+        # dyadic v: float sums are order-independent, so byte-equality
+        # across planes is a fair demand
+        t = e.catalog.table("t")
+        t.bulk_upsert(pd.DataFrame(
+            {"id": ids, "k": ids % NKEYS, "v": ids * 0.5}),
+            e._next_version())
+        t.indexate()
+        e.execute("create table u (uid Int64 not null, x Double not null, "
+                  "primary key (uid))")
+        uids = np.arange(wid, NKEYS, NDEV, dtype=np.int64)
+        u = e.catalog.table("u")
+        u.bulk_upsert(pd.DataFrame(
+            {"uid": uids, "x": 10.0 + uids * 0.25}), e._next_version())
+        u.indexate()
+        engines.append(e)
+    c = ShardedCluster([LocalWorker(e, name=f"sp{i}")
+                        for i, e in enumerate(engines)],
+                       merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    return c, engines
+
+
+def _eq(a, b):
+    import numpy as np
+    if list(a.columns) != list(b.columns) or len(a) != len(b):
+        return False
+    return all(np.array_equal(a[col].to_numpy(), b[col].to_numpy())
+               for col in a.columns)
+
+
+def _wire_ratio(engine, mark: int):
+    """padded/live over the state='channel' stage-stats rows appended
+    after ring position `mark` — the per-edge pad accounting the
+    exchange itself stamps."""
+    rows = [r for r in list(engine.dq_stage_stats)[mark:]
+            if r.get("state") == "channel"
+            and r.get("pad_padded_bytes", 0) > 0]
+    live = sum(r["pad_live_bytes"] for r in rows)
+    padded = sum(r["pad_padded_bytes"] for r in rows)
+    return (padded / live if live else 0.0), live, padded, len(rows)
+
+
+def gate() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) >= NDEV, jax.devices()
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    os.environ.pop("YDB_TPU_DQ_PLANE", None)
+    os.environ.pop("YDB_TPU_DQ_PLANNED", None)
+    os.environ["YDB_TPU_DQ_QUANT"] = "0"
+    c, engines = mk_cluster()
+
+    # 3. escape hatch first: the host plane is the oracle
+    os.environ["YDB_TPU_DQ_PLANE"] = "host"
+    want = c.query(JOIN_SQL)
+
+    # 1+2. planned spine: no in-plan host sync, bounded wire padding
+    os.environ["YDB_TPU_DQ_PLANE"] = "auto"
+    c.query(JOIN_SQL)                      # warm: compile + dictionaries
+    n0 = GLOBAL.get("hostsync/to_pandas_in_plan")
+    h0 = GLOBAL.get("devlink/handoffs")
+    mark = len(engines[0].dq_stage_stats)
+    got = c.query(JOIN_SQL)
+    to_pandas_in_plan = GLOBAL.get("hostsync/to_pandas_in_plan") - n0
+    handoffs = GLOBAL.get("devlink/handoffs") - h0
+    ratio, live, padded, nchan = _wire_ratio(engines[0], mark)
+
+    byte_equal = _eq(got, want)
+    spine_ok = to_pandas_in_plan == 0 and handoffs > 0
+    wire_ok = nchan > 0 and 0.0 < ratio <= WIRE_CEILING
+
+    # 4. lever off: the legacy 2x exchange still answers byte-equal,
+    # and pays visibly more wire than the planned segments
+    os.environ["YDB_TPU_DQ_PLANNED"] = "0"
+    c.query(JOIN_SQL)                      # warm the legacy programs
+    mark = len(engines[0].dq_stage_stats)
+    got_legacy = c.query(JOIN_SQL)
+    legacy_ratio, _ll, _lp, lchan = _wire_ratio(engines[0], mark)
+    os.environ.pop("YDB_TPU_DQ_PLANNED", None)
+    legacy_ok = _eq(got_legacy, want) and lchan > 0 \
+        and legacy_ratio > ratio
+
+    out = {
+        "metric": "spine_gate", "n_devices": NDEV, "rows": ROWS,
+        "to_pandas_in_plan": int(to_pandas_in_plan),
+        "device_handoffs": int(handoffs),
+        "spine_ok": spine_ok,
+        "wire_live_bytes": int(live),
+        "wire_padded_bytes": int(padded),
+        "wire_padded_over_live": round(ratio, 3),
+        "wire_ceiling": WIRE_CEILING,
+        "wire_ok": wire_ok,
+        "byte_equal_vs_host_plane": byte_equal,
+        "legacy_padded_over_live": round(legacy_ratio, 3),
+        "legacy_lever_ok": legacy_ok,
+    }
+    ok = spine_ok and wire_ok and byte_equal and legacy_ok
+    out["ok"] = ok
+    print(json.dumps(out), flush=True)
+    return 0 if ok else 1
+
+
+def main() -> int:
+    if os.environ.get("YDB_TPU_SPINE_GATE_CHILD") == "1":
+        return gate()
+    # self-provision the virtual mesh BEFORE jax initializes (the
+    # parent's platform may be a single real chip or a 1-device CPU)
+    from ydb_tpu.utils.vmesh import virtual_mesh_env
+    env = virtual_mesh_env(NDEV)
+    env["YDB_TPU_SPINE_GATE_CHILD"] = "1"
+    r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                       env=env, timeout=900)
+    return r.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

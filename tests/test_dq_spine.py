@@ -1,0 +1,182 @@
+"""Device-resident stage spine: planned redistribution differentials.
+
+The planned exchange (`dq/ici.exchange_blocks`) sizes its collective
+segments from an exchanged count matrix instead of the legacy 2x
+power-of-two guess, and hands `DeviceStageBlock`s between stages by
+reference. Every scenario here must be BYTE-equal to the host plane
+(the escape hatch) and to the lever-off legacy exchange — the planned
+path changes wire layout and padding, never values or row order.
+
+Run on the virtual 8-device host mesh (conftest sets
+xla_force_host_platform_device_count).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ydb_tpu.cluster import ShardedCluster
+from ydb_tpu.cluster.router import ShardedCluster as _RouterCluster
+from ydb_tpu.dq.graph import HASH_SHUFFLE
+from ydb_tpu.dq.runner import LocalWorker
+from ydb_tpu.query import QueryEngine
+from ydb_tpu.utils.metrics import GLOBAL
+
+NW = 2
+ROWS = 140
+
+JOIN_SQL = ("select k, count(*) as n, sum(w) as s, min(x) as mn, "
+            "max(x) as mx from t, u where k = uid group by k order by k")
+
+
+def _mk_engine(wid: int, nw: int = NW, keys=None) -> QueryEngine:
+    """The test_dq_ici harness schema; `keys[i]` overrides row i's k so
+    scenarios can steer the shuffle's bucket histogram."""
+    eng = QueryEngine(block_rows=1 << 12)
+    eng.execute("create table t (id Int64 not null, k Int64 not null, "
+                "v Double not null, tag Utf8 not null, nv Double, "
+                "primary key (id))")
+    eng.execute("create table u (uid Int64 not null, w Double not null, "
+                "x Double not null, primary key (uid))")
+    mine = [i for i in range(ROWS) if i % nw == wid]
+    kof = (lambda i: keys[i]) if keys is not None else (lambda i: i % 7)
+    # v dyadic (i * 0.5): float sums exact in any order → byte-equality
+    eng.execute(
+        "insert into t (id, k, v, tag, nv) values "
+        + ", ".join(f"({i}, {kof(i)}, {i * 0.5}, 'tag{i % 3}', "
+                    + ("null" if i % 5 == 0 else f"{i * 0.25}") + ")"
+                    for i in mine))
+    umine = [i for i in range(7) if i % nw == wid]
+    if umine:
+        eng.execute("insert into u (uid, w, x) values "
+                    + ", ".join(f"({i}, {i}.0, {10.0 + i * 0.3})"
+                                for i in umine))
+    return eng
+
+
+def _mk_cluster(nw: int = NW, keys=None) -> ShardedCluster:
+    engines = [_mk_engine(i, nw, keys=keys) for i in range(nw)]
+    c = ShardedCluster([LocalWorker(e, name=f"sp{i}")
+                        for i, e in enumerate(engines)],
+                       merge_engine=engines[0])
+    c.key_columns["t"] = ["id"]
+    c.key_columns["u"] = ["uid"]
+    return c
+
+
+def _frames_equal(a: pd.DataFrame, b: pd.DataFrame):
+    assert list(a.columns) == list(b.columns)
+    assert len(a) == len(b)
+    for col in a.columns:
+        x, y = a[col].to_numpy(), b[col].to_numpy()
+        if x.dtype.kind == "f" or y.dtype.kind == "f":
+            assert np.array_equal(x.astype(np.float64),
+                                  y.astype(np.float64),
+                                  equal_nan=True), col
+        else:
+            assert np.array_equal(x, y), col
+
+
+def _both_planes(monkeypatch, cluster, sql):
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "host")
+    want = cluster.query(sql)
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    got = cluster.query(sql)
+    return got, want
+
+
+# -- planned path: spine invariants ----------------------------------------
+
+
+def test_planned_join_byte_equal_and_hostsync_free(monkeypatch):
+    """The headline differential: planned segments from exchanged
+    counts, device blocks by reference, zero in-plan to_pandas."""
+    cluster = _mk_cluster()
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "host")
+    want = cluster.query(JOIN_SQL)
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    n0 = GLOBAL.get("hostsync/to_pandas_in_plan")
+    h0 = GLOBAL.get("devlink/handoffs")
+    got = cluster.query(JOIN_SQL)
+    _frames_equal(got, want)
+    assert GLOBAL.get("hostsync/to_pandas_in_plan") - n0 == 0
+    assert GLOBAL.get("devlink/handoffs") - h0 > 0
+
+
+def test_zero_row_buckets(monkeypatch):
+    """Every t row carries ONE key → ndev-1 of each producer's buckets
+    are empty and most consumers land zero rows. Empty segments must
+    ship (zero-filled) without perturbing values or order."""
+    cluster = _mk_cluster(keys=[5] * ROWS)
+    got, want = _both_planes(monkeypatch, cluster, JOIN_SQL)
+    assert len(want) == 1           # the scenario really is degenerate
+    _frames_equal(got, want)
+
+
+def test_heavy_skew_single_bucket(monkeypatch):
+    """>90% of rows hash to one key: the count matrix is near-diagonal
+    and the planned segment is sized by the hot pair, not 2x the global
+    max — results still byte-equal."""
+    keys = [3 if i % 10 else i % 7 for i in range(ROWS)]  # ~93% k=3
+    cluster = _mk_cluster(keys=keys)
+    got, want = _both_planes(monkeypatch, cluster, JOIN_SQL)
+    _frames_equal(got, want)
+
+
+def test_single_worker_degenerate(monkeypatch):
+    """NW=1: no redistribution to plan — the plan collapses to local
+    execution and still matches the forced-host answer."""
+    cluster = _mk_cluster(nw=1)
+    got, want = _both_planes(monkeypatch, cluster, JOIN_SQL)
+    _frames_equal(got, want)
+
+
+def test_forged_low_bound_overflow_rerun(monkeypatch):
+    """An unsound out_bound (forged to 1 row) undercuts the measured
+    counts: the exchange books dq/planned_overflow_reruns and re-sizes
+    to full capacity — the answer is unchanged."""
+    cluster = _mk_cluster()
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "host")
+    want = cluster.query(JOIN_SQL)
+
+    orig = _RouterCluster._lower
+
+    def forged(self, stmt):
+        g = orig(self, stmt)
+        for ch in g.channels.values():
+            if ch.kind == HASH_SHUFFLE:
+                ch.out_bound = 1
+        return g
+
+    monkeypatch.setattr(_RouterCluster, "_lower", forged)
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    r0 = GLOBAL.get("dq/planned_overflow_reruns")
+    got = cluster.query(JOIN_SQL)
+    assert GLOBAL.get("dq/planned_overflow_reruns") > r0
+    _frames_equal(got, want)
+
+
+def test_lever_off_restores_legacy_2x_path(monkeypatch):
+    """YDB_TPU_DQ_PLANNED=0: the legacy 2x exchange still runs
+    byte-equal — and books the in-plan pandas debt the planned path
+    retired (the differential that proves the spine is the thing
+    removing it)."""
+    cluster = _mk_cluster()
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "host")
+    want = cluster.query(JOIN_SQL)
+    monkeypatch.setenv("YDB_TPU_DQ_PLANE", "auto")
+    monkeypatch.setenv("YDB_TPU_DQ_PLANNED", "0")
+    n0 = GLOBAL.get("hostsync/to_pandas_in_plan")
+    got = cluster.query(JOIN_SQL)
+    _frames_equal(got, want)
+    assert GLOBAL.get("hostsync/to_pandas_in_plan") - n0 > 0
+
+
+def test_strings_and_nulls_planned(monkeypatch):
+    """Dictionary and masked columns across the planned exchange: the
+    union-dictionary remap and validity planes survive by reference."""
+    sql = ("select tag, count(*) as n, sum(v) as s, sum(nv) as sn "
+           "from t, u where k = uid group by tag order by tag")
+    cluster = _mk_cluster()
+    got, want = _both_planes(monkeypatch, cluster, sql)
+    _frames_equal(got, want)

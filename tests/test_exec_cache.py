@@ -130,6 +130,9 @@ def test_evicted_program_recompile_is_miss_not_hit(monkeypatch):
     from ydb_tpu.utils.metrics import GLOBAL
 
     monkeypatch.setenv("YDB_TPU_PARAM_LIFT", "0")
+    # the inventory is process-global; scope the state assertions below
+    # to THIS test's programs, not leftovers from earlier suites
+    progstats.reset_for_tests()
     eng = QueryEngine(block_rows=1 << 12)
     eng.execute("create table ev (k Int64 not null, a Int64, b Double, "
                 "primary key (k))")

@@ -195,9 +195,10 @@ def test_explain_analyze_renders_memory_line():
 
 
 def test_flight_recorder_pins_to_pandas_inside_plan():
-    """A 2-worker DQ join's stage programs each round-trip through
-    pandas (the baselined ROADMAP item 1 debt): the recorder pins the
-    count so a later PR can gate it to zero."""
+    """The device-resident stage spine retired the per-task pandas
+    round-trip (ROADMAP item 1 debt, formerly pinned here at >= 2 per
+    plan): the recorder now gates it to ZERO — any reappearing in-plan
+    materialization is a regression, not new baseline."""
     from ydb_tpu.cluster import ShardedCluster
     from ydb_tpu.dq.runner import LocalWorker
 
@@ -215,15 +216,22 @@ def test_flight_recorder_pins_to_pandas_inside_plan():
                        merge_engine=engines[0])
     c.key_columns["t"] = ["id"]
     n0 = GLOBAL.get("hostsync/to_pandas_in_plan")
+    t0 = GLOBAL.get("hostsync/transfers")
+    b0 = GLOBAL.get("hostsync/boundary_transfers")
+    h0 = GLOBAL.get("devlink/handoffs")
     c.query("select k, sum(v) as s from t group by k order by k")
-    delta = GLOBAL.get("hostsync/to_pandas_in_plan") - n0
-    # every (stage, worker) task materializes once — a 2-worker
-    # scan→merge graph runs at least 2 worker tasks
-    assert delta >= 2
-    # the ring attributes them to the stage site
+    # the spine hands stage results device→device; only the router
+    # egress (client boundary) reads back
+    assert GLOBAL.get("hostsync/to_pandas_in_plan") - n0 == 0
+    # every surviving readback is a blessed boundary (count exchange,
+    # router egress): the NON-boundary transfer count stays flat
+    assert (GLOBAL.get("hostsync/transfers") - t0
+            == GLOBAL.get("hostsync/boundary_transfers") - b0)
+    # and the stage handoffs themselves ride the device link
+    assert GLOBAL.get("devlink/handoffs") - h0 > 0
     sites = {r["site"] for r in memledger.transfer_ring()
              if r["to_pandas_in_plan"]}
-    assert "dq/task.py::stage_to_pandas" in sites
+    assert "dq/task.py::stage_to_pandas" not in sites
 
 
 # -- padding ledger on a skewed shuffle ------------------------------------

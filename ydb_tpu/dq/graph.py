@@ -76,11 +76,11 @@ class Channel:
     quant_cols: list = field(default_factory=list)
     # bounds lattice: proven upper bound on rows any ONE producer ships
     # over this channel (0 = unknown). Stamped by the lowering (LIMIT
-    # pushdown today). This is the declared STATIC input for planned
-    # redistribution (ROADMAP item 1): sizing segments before any frame
-    # materializes. The current ICI exchange routes materialized frames,
-    # so its measured row counts always beat a static bound — it does
-    # not consult this field.
+    # pushdown today). Planned redistribution (`dq/ici.exchange_blocks`)
+    # consumes it: the bound caps the count-exchange segment sizing, so
+    # a proven-small channel never compiles a full-capacity collective
+    # even before the exchanged counts arrive. The legacy 2x exchange
+    # routes materialized frames and still ignores it.
     out_bound: int = 0
 
     @property

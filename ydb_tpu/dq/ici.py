@@ -57,6 +57,14 @@ def quant_enabled() -> bool:  # lint: tuning-provider
     return os.environ.get("YDB_TPU_DQ_QUANT", "0").strip() == "1"
 
 
+def planned_enabled() -> bool:  # lint: tuning-provider
+    """`YDB_TPU_DQ_PLANNED` lever: 1/unset = planned redistribution
+    (`exchange_blocks` — device blocks by reference, count-exchange
+    segment sizing on the fine ladder); 0 = the legacy pandas exchange
+    with 2x power-of-two segments and the device overflow probe."""
+    return os.environ.get("YDB_TPU_DQ_PLANNED", "1").strip() != "0"
+
+
 # -- mesh + compiled-exchange caches ---------------------------------------
 
 _MESHES: dict = {}
@@ -361,12 +369,12 @@ def exchange(ch, dfs: list, key_kind: str = None,
         seg = min(cap, bucket_capacity(
             max(1, (2 * max_rows + ndev - 1) // ndev),
             minimum=QUANT_BLOCK))
-        # (Channel.out_bound is NOT consulted here: `cap` above is
-        # already sized from the producers' MEASURED rows — this
-        # exchange routes materialized frames, so a static bound can
-        # never be tighter. The bound is the static input for planned
-        # redistribution — ROADMAP item 1 — which must size segments
-        # BEFORE materializing.)
+        # (Channel.out_bound is NOT consulted on THIS legacy path:
+        # `cap` above is already sized from the producers' MEASURED
+        # rows — this exchange routes materialized frames, so a static
+        # bound can never be tighter. The planned path
+        # (`exchange_blocks`) is the bound's consumer: it caps the
+        # count-exchange segment sizing with it.)
         while True:
             sig = ("shuffle", ndev, cap, seg, dt_sig,
                    tuple(quant_names))
@@ -441,3 +449,354 @@ def exchange(ch, dfs: list, key_kind: str = None,
         if padded_wire else None,
     }
     return out_dfs, stats
+
+
+# -- planned redistribution (device blocks by reference) -------------------
+
+
+def _build_counts_fn(ndev: int, cap: int):
+    """Compile the planned path's count exchange: per (producer, target)
+    live-row counts from the bucket plane. The [ndev, ndev] int32 result
+    is the ONE small sizing message the host reads before any row moves
+    — dropped/NULL rows already carry bucket -1, so a plain equality
+    reduction is the whole program."""
+    import jax
+    import jax.numpy as jnp
+
+    def counts(bucket):
+        return jnp.stack(
+            [jnp.sum(bucket == d, axis=1) for d in range(ndev)],
+            axis=1).astype(jnp.int32)
+
+    return jax.jit(counts)
+
+
+def _device_specs(ch, blocks, columns):
+    """One (codec_tag, numpy dtype) per column, decided over every
+    producer SCHEMA (no pandas, no sync) — the planned twin of
+    `_classify`. Strings ride as int32 dictionary codes (`_DICT`),
+    everything else as its schema dtype (`_NUM`); validity always rides
+    as a mask plane next to the data."""
+    specs = {}
+    for c in columns:
+        dts, is_str = set(), False
+        for b in blocks:
+            if b.schema.has(c):
+                dt = b.schema.dtype(c)
+                is_str = is_str or dt.is_string
+                dts.add(np.dtype(dt.np).str)
+        if not dts:
+            raise IciPlaneError(f"channel {ch.id}: column {c!r} missing "
+                                "from every producer")
+        if len(dts) > 1:
+            raise IciPlaneError(f"column {c!r}: producers disagree on "
+                                f"dtype ({sorted(dts)})")
+        np_dt = np.dtype(next(iter(dts)))
+        if np_dt.kind not in "iufb":
+            raise IciPlaneError(f"column {c!r}: dtype {np_dt} is not "
+                                "ICI-encodable")
+        specs[c] = (_DICT, np.dtype(np.int32)) if is_str \
+            else (_NUM, np_dt)
+    return specs
+
+
+def _union_dictionaries(ch, columns, specs, devs):
+    """Shared consumer dictionaries for string columns: one union
+    `Dictionary` per column over every producer's values (host METADATA
+    — never a device readback), plus per-producer code-remap LUTs
+    (old code → union code) applied device-side via `jnp.take`."""
+    from ydb_tpu.core.dictionary import Dictionary
+    unions, luts = {}, {}
+    for c in columns:
+        if specs[c][0] != _DICT:
+            continue
+        u = Dictionary()
+        per = []
+        for (dev, n) in devs:
+            d = dev.dictionaries.get(c)
+            if d is None:
+                if n > 0 and c in dev.arrays:
+                    raise IciPlaneError(
+                        f"channel {ch.id}: string column {c!r} has rows "
+                        "but no dictionary on a producer")
+                per.append(None)
+                continue
+            vals = d.values_array()
+            per.append(u.encode_bulk(vals).astype(np.int32) if len(vals)
+                       else np.zeros(0, np.int32))
+        unions[c] = u
+        luts[c] = per
+    return unions, luts
+
+
+def exchange_blocks(ch, blocks: list, key_kind: str = None,
+                    counters=None) -> tuple:
+    """Planned device-resident redistribution — the stage spine's data
+    plane. Producers and consumers speak device blocks BY REFERENCE:
+    `blocks[d]` is mesh device d's stage output (a `DeviceStageBlock`
+    stays on the accelerator; a plain `HostBlock` from a non-fused
+    stage is uploaded once), and the landed per-consumer partitions
+    come back as `DeviceStageBlock`s — no pandas, no npz, no host sync
+    on the row plane.
+
+    Segment sizing is PLANNED instead of guessed: a compiled count
+    exchange ships the per-(producer, target) live-row counts ([ndev,
+    ndev] int32 — the one small sizing message), and the collective's
+    segment size is the measured max bucketed UP onto the fine quarter-
+    octave ladder (`progstore/buckets.bucket_segment`, overshoot
+    <= 1.25x) so the compiled-program cache stays a handful of rungs —
+    retiring the legacy 2x power-of-two padding tax. `Channel.out_bound`
+    (the planner's bounds lattice) caps the sizing; a bound that
+    undercuts the measured counts trips the overflow escape hatch — ONE
+    rerun at full capacity, which cannot overflow. The device overflow
+    flag is NEVER fetched: sizing is host-known before dispatch.
+
+    Returns `(out_blocks, stats)`; raises `IciPlaneError` when the edge
+    cannot run device-resident (the runner falls back to the host
+    plane)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ydb_tpu.core.schema import Column, Schema
+    from ydb_tpu.dq.graph import BROADCAST, HASH_SHUFFLE
+    from ydb_tpu.ops.device import (DeviceBlock, DeviceStageBlock,
+                                    to_device)
+    from ydb_tpu.progstore.buckets import bucket_segment
+    from ydb_tpu.utils import memledger
+    from ydb_tpu.utils.hashing import splitmix64
+
+    ndev = len(blocks)
+    if ndev < 2:
+        raise IciPlaneError("ICI plane needs at least 2 producers")
+    mesh = _mesh(ndev)
+    if ch.kind not in (HASH_SHUFFLE, BROADCAST):
+        raise IciPlaneError(f"channel kind {ch.kind!r} has no ICI form")
+
+    columns = None
+    for b in blocks:
+        if list(b.schema.names):
+            columns = list(b.schema.names)
+            break
+    if columns is None:
+        columns = list(ch.columns)
+    if not columns:
+        raise IciPlaneError(f"channel {ch.id}: no columns to exchange")
+    specs = _device_specs(ch, blocks, columns)
+
+    # quantization: same contract as the legacy path — only lowering-
+    # proven columns, only plain (mask-free) floats, lever-gated;
+    # refusals are loud, never silently lossy
+    quant_names: list = []
+    refused: list = []
+
+    # producer buffer capacity on the fine ladder (not the legacy pow2)
+    max_len = max(max((b.length for b in blocks), default=0), 1)
+    cap = bucket_segment(max_len, minimum=1)
+
+    devs = []                           # (DeviceBlock view, host length)
+    for b in blocks:
+        if isinstance(b, DeviceStageBlock) and not b.materialized:
+            devs.append((b.device, b.length))
+        else:
+            devs.append((to_device(b, capacity=max(cap, b.length)),
+                         b.length))
+
+    def _masked(c):
+        return any(c in dev.valids for (dev, _n) in devs)
+
+    if quant_enabled():
+        for c in ch.quant_cols:
+            spec = specs.get(c)
+            if spec is not None and spec[0] == _NUM \
+                    and spec[1].kind == "f" and not _masked(c):
+                quant_names.append(c)
+            elif spec is not None:
+                refused.append(c)
+        if refused and counters is not None:
+            counters.inc("dq/quant_refused", len(refused))
+    if quant_names:
+        cap = -(-cap // QUANT_BLOCK) * QUANT_BLOCK
+
+    unions, luts = _union_dictionaries(ch, columns, specs, devs)
+
+    def _fit(a, want, fill=None):
+        m = int(a.shape[0])
+        if m == want:
+            return a
+        if m > want:
+            return a[:want]
+        pad = jnp.zeros((want - m,), a.dtype) if fill is None \
+            else jnp.full((want - m,), fill, a.dtype)
+        return jnp.concatenate([a, pad])
+
+    lengths = np.array([n for (_dev, n) in devs], np.int32)
+    lengths_col = jnp.asarray(lengths)[:, None]
+    idx_row = jnp.arange(cap, dtype=jnp.int32)[None, :]
+    arrays, valids = {}, {}
+    for c in columns:
+        want_dt = specs[c][1]
+        per_d, per_v = [], []
+        for di, (dev, n) in enumerate(devs):
+            if c not in dev.arrays:
+                raise IciPlaneError(f"channel {ch.id}: column {c!r} "
+                                    f"missing on producer {di}")
+            a = dev.arrays[c]
+            if specs[c][0] == _DICT:
+                lut_np = luts[c][di]
+                if lut_np is not None and len(lut_np):
+                    lut = jnp.asarray(lut_np)
+                    a = jnp.take(lut, jnp.clip(a.astype(jnp.int32), 0,
+                                               len(lut_np) - 1))
+            if a.dtype != want_dt:
+                a = a.astype(want_dt)
+            per_d.append(_fit(a, cap))
+            v = dev.valids.get(c)
+            per_v.append(jnp.ones((cap,), jnp.bool_) if v is None
+                         else _fit(v, cap))
+        arrays[c] = jnp.stack(per_d)
+        valids[c] = jnp.stack(per_v)
+    for c in quant_names:
+        # zero the inactive tail: capture-time pad rows may hold garbage
+        # whose magnitude would poison the per-block quant scales
+        arrays[c] = jnp.where(idx_row < lengths_col, arrays[c], 0)
+
+    names = tuple(columns)
+    dt_sig = tuple((c, specs[c][0], str(specs[c][1])) for c in names)
+    ce_bytes = 0
+    if ch.kind == HASH_SHUFFLE:
+        key = ch.key
+        if not key or key not in columns:
+            raise IciPlaneError(f"channel {ch.id}: shuffle key {key!r} "
+                                "is not an exchanged column")
+        kspec = specs[key]
+        kind = key_kind or ("string" if kspec[0] == _DICT
+                            else "float" if kspec[1].kind == "f"
+                            else "int")
+        if kind == "float":
+            raise IciPlaneError(
+                f"channel {ch.id} key {key!r}: float join keys are not "
+                "hash-partitionable")
+        # the bucket plane: the SAME per-row route the host plane's
+        # `key_buckets` computes — splitmix64 for ints (x64 bit parity),
+        # a host crc32 LUT over the union values for strings — with
+        # NULL/pad rows at -1 (dropped: inner-shuffle semantics)
+        if kind == "string":
+            import zlib
+            uvals = unions[key].values_array()
+            blut_np = np.array(
+                [int(np.uint64(zlib.crc32(str(v).encode())) %
+                     np.uint64(ndev)) for v in uvals],
+                np.int32) if len(uvals) else np.zeros(1, np.int32)
+            blut = jnp.asarray(blut_np)
+            bucket = jnp.take(blut, jnp.clip(
+                arrays[key].astype(jnp.int32), 0, len(blut_np) - 1))
+        else:
+            h = splitmix64(jnp, arrays[key].astype(jnp.int64))
+            bucket = (h % jnp.uint64(ndev)).astype(jnp.int32)
+        active = (idx_row < lengths_col) & valids[key]
+        bucket = jnp.where(active, bucket, jnp.int32(-1))
+
+        csig = ("counts", ndev, cap)
+        # lint: allow-cache-key(the counts program depends only on (ndev, cap) — no tuning lever feeds it)
+        cfn = _FNS.get(csig)
+        if cfn is None:
+            cfn = _FNS[csig] = _build_counts_fn(ndev, cap)
+        # the count exchange: the planned path's ONE host round trip —
+        # ndev^2 int32, counted as the blessed sizing message (the
+        # legacy row-plane device_get disappears entirely)
+        counts_host = jax.device_get(cfn(bucket))
+        ce_bytes = ndev * ndev * 4
+        memledger.record_transfer("dq/ici.py::count_exchange", ce_bytes,
+                                  boundary=True)
+        max_pair = int(counts_host.max()) if counts_host.size else 0
+        seg = bucket_segment(max(max_pair, 1), minimum=1)
+        bound = getattr(ch, "out_bound", None)
+        if bound:
+            bseg = bucket_segment(int(bound), minimum=1)
+            if bseg < seg:
+                seg = bseg
+        if max_pair > seg:
+            # an unsound (or forged) bound undercut the measured counts:
+            # the overflow escape hatch — ONE rerun at full capacity,
+            # which cannot overflow (a target receives at most one
+            # producer's full row count)
+            if counters is not None:
+                counters.inc("dq/planned_overflow_reruns")
+            seg = cap
+        if quant_names:
+            seg = -(-seg // QUANT_BLOCK) * QUANT_BLOCK
+        seg = min(seg, cap)
+
+        sig = ("shuffle", ndev, cap, seg, dt_sig, tuple(quant_names))
+        # lint: allow-cache-key(the quant lever rides in quant_names above — flipping YDB_TPU_DQ_QUANT changes the tuple, never serves a stale program)
+        fn = _FNS.get(sig)
+        if fn is None:
+            dtypes = {c: specs[c][1] for c in names}
+            fn = _FNS[sig] = _build_shuffle_fn(
+                mesh, ndev, cap, seg, names, dtypes, tuple(quant_names))
+        out_d, out_v, _lens, _ovf = fn(arrays, valids, bucket, lengths)
+        # _lens/_ovf are NEVER fetched: the landed totals and the
+        # no-overflow verdict are host-known from the count exchange
+        landed = [int(counts_host[:, d].sum()) for d in range(ndev)]
+        out_cap = ndev * seg
+    else:
+        seg = cap                       # broadcast gathers full buffers
+        sig = ("broadcast", ndev, cap, dt_sig)
+        # lint: allow-cache-key(broadcast edges never quantize — quant_cols apply only to hash-shuffle segments)
+        fn = _FNS.get(sig)
+        if fn is None:
+            fn = _FNS[sig] = _build_broadcast_fn(mesh, ndev, cap, names)
+        out_d, out_v, _lens = fn(arrays, valids, lengths)
+        landed = [int(lengths.sum())] * ndev
+        out_cap = ndev * cap
+
+    # landed per-consumer blocks: array REFERENCES into the collective's
+    # output, wrapped with host-known lengths — the consumer stage's
+    # fused scan stacks them without any readback
+    out_cols, out_dicts = [], {}
+    for c in columns:
+        sdt = next(b.schema.dtype(c) for b in blocks if b.schema.has(c))
+        out_cols.append(Column(c, sdt))
+        if c in unions:
+            out_dicts[c] = unions[c]
+    out_schema = Schema(out_cols)
+    masked = {c: _masked(c) for c in columns}
+    out_blocks = []
+    for d in range(ndev):
+        dev = DeviceBlock(
+            out_schema, {c: out_d[c][d] for c in columns},
+            {c: out_v[c][d] for c in columns if masked[c]},
+            landed[d], out_cap, dict(out_dicts))
+        out_blocks.append(DeviceStageBlock(dev, landed[d]))
+
+    # wire + padding account: planned segments on the ladder vs the live
+    # rows that actually crossed, plus the sizing messages (per-segment
+    # counts and the count exchange itself)
+    per_row = sum(_wire_bytes_per_row(specs[c], c in quant_names)
+                  for c in columns)
+    exact_row = sum(_wire_bytes_per_row(specs[c], False)
+                    for c in columns)
+    segs = ndev * ndev
+    live_rows = int(sum(landed))
+    padded_rows = segs * seg
+    padded_wire = int(segs * seg * per_row + segs * 4 + ce_bytes)
+    live_wire = int(live_rows * per_row)
+    memledger.record_alloc("collective", memledger.deep_nbytes(
+        (arrays, valids)))
+    memledger.record_pad("ici_frames", live_rows, padded_rows,
+                         live_wire, padded_wire)
+    stats = {
+        "ici_bytes": padded_wire,
+        "ici_frames": segs,
+        "quant_bytes_saved": int(segs * seg * (exact_row - per_row)),
+        "quant_cols": list(quant_names),
+        "quant_refused": list(refused),
+        "pad_live_bytes": live_wire,
+        "pad_padded_bytes": padded_wire,
+        "pad_efficiency": round(live_wire / padded_wire, 3)
+        if padded_wire else None,
+        "planned": True,
+        "seg": int(seg),
+        "count_exchange_bytes": ce_bytes,
+    }
+    return out_blocks, stats

@@ -321,33 +321,57 @@ class DqTaskRunner:
         (`dq/channel_bytes` stays untouched for these edges)."""
         from ydb_tpu.dq import ici
         by_idx = {i: resp for (i, resp, _e) in results}
-        dfs = []
+        blocks = []
         for i in range(len(self.workers)):
             resp = by_idx.get(i)
-            if resp is None or "ici_df" not in resp:
+            if resp is None or "ici_block" not in resp:
                 raise ici.IciPlaneError(
                     f"stage {stage.id}: task w{i} shipped no device "
                     "frame")
-            dfs.append(resp["ici_df"])
-        hint: dict = {}
-        for resp in by_idx.values():
-            hint.update(resp.get("dtypes") or {})
+            blocks.append(resp["ici_block"])
+        planned = ici.planned_enabled()
+        dfs = hint = None
+        if not planned:
+            # YDB_TPU_DQ_PLANNED=0 comparison lane: the legacy exchange
+            # routes pandas, so materialize each producer ONCE here —
+            # honestly booked as in-plan host-sync debt (the exact tax
+            # the planned path retires) — and overwrite the schema
+            # dtype hints with the exact pandas dtypes
+            from ydb_tpu.utils import memledger
+            dfs, hint = [], {}
+            for i, b in enumerate(blocks):
+                # lint: transfer-ok(lever-off legacy lane — booked on to_pandas_in_plan below)
+                df = b.to_pandas()
+                memledger.record_transfer(
+                    "dq/runner.py::legacy_ici_to_pandas",
+                    int(df.memory_usage(index=False).sum()),
+                    to_pandas_in_plan=True)
+                dts = {c: str(df[c].dtype) for c in df.columns}
+                by_idx[i]["dtypes"] = dts
+                hint.update(dts)
+                dfs.append(df)
         agg = self._ici_stage_stats.setdefault(
             stage.id, {"ici_bytes": 0, "ici_frames": 0,
                        "quant_bytes_saved": 0,
-                       "pad_live_bytes": 0, "pad_padded_bytes": 0})
+                       "pad_live_bytes": 0, "pad_padded_bytes": 0,
+                       "count_exchange_bytes": 0})
         for ch in ici_chs:
             kkind = None
             for resp in by_idx.values():
                 kkind = (resp.get("ici_key_kinds") or {}).get(ch.id) \
                     or kkind
             with self._span("ici-exchange", channel=ch.id, kind=ch.kind):
-                out_dfs, stats = ici.exchange(
-                    ch, dfs, key_kind=kkind, dtypes_hint=hint,
-                    counters=self.counters)
+                if planned:
+                    out_parts, stats = ici.exchange_blocks(
+                        ch, blocks, key_kind=kkind,
+                        counters=self.counters)
+                else:
+                    out_parts, stats = ici.exchange(
+                        ch, dfs, key_kind=kkind, dtypes_hint=hint,
+                        counters=self.counters)
             share = max(1, stats["ici_bytes"] // len(self.workers))
             for i, w in enumerate(self.workers):
-                w.ici_land(ch.id, out_dfs[i], share,
+                w.ici_land(ch.id, out_parts[i], share,
                            src=f"ici.{ch.id}", seq=i)
             self.counters.inc("dq/ici_bytes", stats["ici_bytes"])
             self.counters.inc("dq/ici_frames", stats["ici_frames"])
@@ -355,8 +379,21 @@ class DqTaskRunner:
                 self.counters.inc("dq/quant_bytes_saved",
                                   stats["quant_bytes_saved"])
             for k in ("ici_bytes", "ici_frames", "quant_bytes_saved",
-                      "pad_live_bytes", "pad_padded_bytes"):
+                      "pad_live_bytes", "pad_padded_bytes",
+                      "count_exchange_bytes"):
                 agg[k] += max(0, stats.get(k) or 0)
+            # per-CHANNEL pad accounting row (`.sys/dq_stage_stats`,
+            # state='channel', worker='' so the load signal skips it):
+            # the planned exchange's padded/live is a per-edge property —
+            # the task-row aggregate hides which edge pays the tax
+            live = int(stats.get("pad_live_bytes") or 0)
+            padded = int(stats.get("pad_padded_bytes") or 0)
+            self.stage_stats.append(self._stage_row(
+                graph, stage, "", "channel", 1, channel=ch.id,
+                plane="ici", ici_bytes=int(stats["ici_bytes"]),
+                pad_live_bytes=live, pad_padded_bytes=padded,
+                pad_efficiency=round(live / padded, 3) if padded
+                else 0.0))
 
     def _run_stage_attempts(self, graph, stage, specs):
         """The pending → running → finished/failed attempt loop. Every
@@ -523,6 +560,7 @@ class DqTaskRunner:
         row = {"trace_id": (ctx or {}).get("trace_id", 0) or 0,
                "graph": graph.tag, "stage": stage.id, "worker": worker,
                "state": state, "attempts": int(attempts),
+               "channel": "",
                "rows": 0, "bytes": 0, "frames": 0,
                "plane": "host", "ici_bytes": 0,
                "pad_live_bytes": 0, "pad_padded_bytes": 0,
@@ -743,6 +781,13 @@ class LocalWorker:
         self.engine = engine
         self.endpoint = f"local:{name or hex(id(engine))[2:]}"
         self.exchange = ExchangeBuffer()
+        # device-resident channel landings (planned ICI exchange): the
+        # exchange buffer speaks pandas frames, so blocks that stay on
+        # the accelerator land here instead — channel → DeviceStageBlock,
+        # with the same (src, seq) idempotency the frame path gets from
+        # ExchangeBuffer.put
+        self._device_landed: dict = {}
+        self._device_seen: set = set()
         self._peers = [self]
         # task table: mutated by the runner's pool threads while
         # dq_tasks() snapshots it — same discipline as the servicer's
@@ -798,16 +843,40 @@ class LocalWorker:
 
     def ici_land(self, channel: str, df, nbytes: int,
                  src: str = "ici", seq=None) -> None:
-        """Land one ICI-exchanged partition straight in the exchange
-        buffer — the device plane's replacement for an ExchangePut frame
-        (same (src, seq) idempotency discipline, no npz, no gRPC)."""
+        """Land one ICI-exchanged partition — the device plane's
+        replacement for an ExchangePut frame (same (src, seq)
+        idempotency discipline, no npz, no gRPC). A pandas frame (the
+        legacy exchange) goes into the exchange buffer; a block (the
+        planned exchange — a `DeviceStageBlock` still on the
+        accelerator) lands by REFERENCE in the device store, counted as
+        a device→device handoff, never a host transfer."""
+        from ydb_tpu.core.block import HostBlock
+        if isinstance(df, HostBlock):
+            key = (channel, src, seq)
+            if seq is not None and key in self._device_seen:
+                return
+            self._device_seen.add(key)
+            self._device_landed[channel] = df
+            from ydb_tpu.utils import memledger
+            memledger.record_device_handoff(
+                "dq/runner.py::ici_land",
+                df.live_nbytes() if hasattr(df, "live_nbytes")
+                else int(nbytes))
+            return
         self.exchange.put(channel, df, int(nbytes), src=src, seq=seq)
 
     def channel_open(self, channel: str, table: str, columns=None,
                      timeout=None) -> dict:
-        from ydb_tpu.dq.task import materialize_channel
-        stats = materialize_channel(self.engine, self.exchange, channel,
-                                    table, columns)
+        from ydb_tpu.dq.task import (materialize_channel,
+                                     materialize_device_channel)
+        blk = self._device_landed.get(channel)
+        if blk is not None:
+            # kept (not popped) until channel_close: a consumer-stage
+            # retry re-opens the channel and must find the landing again
+            stats = materialize_device_channel(self.engine, blk, table)
+        else:
+            stats = materialize_channel(self.engine, self.exchange,
+                                        channel, table, columns)
         return {"ok": True, **stats}
 
     def channel_close(self, tables=(), channels=(), timeout=None) -> dict:
@@ -818,6 +887,9 @@ class LocalWorker:
                 self.engine.catalog.drop_table(name)
         for ch in channels:
             self.exchange.drop(ch)
+            self._device_landed.pop(ch, None)
+            self._device_seen = {k for k in self._device_seen
+                                 if k[0] != ch}
         return {"ok": True}
 
     def dq_tasks(self, timeout=None) -> dict:

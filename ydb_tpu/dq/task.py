@@ -85,22 +85,45 @@ def _run_task_body(engine, executor, sql, outputs, src, send, token,
     t0 = time.perf_counter()
     with span("task-exec", src=src):
         executor.dq_stage_depth += 1
+        executor.dq_device_capture = True
         try:
             block = engine.execute(sql)
         finally:
             executor.dq_stage_depth -= 1
+            executor.dq_device_capture = False
     exec_ms = (time.perf_counter() - t0) * 1000.0
-    # the stage-chain host round trip — ROADMAP item 1's debt, pinned by
-    # the flight recorder (`hostsync/to_pandas_in_plan`) so "zero
-    # to_pandas inside a plan" becomes a counter gate, not a claim
-    df = block.to_pandas()
-    from ydb_tpu.utils import memledger
-    memledger.record_transfer(
-        "dq/task.py::stage_to_pandas",
-        int(df.memory_usage(index=False).sum()),
-        to_pandas_in_plan=True)
-    resp = {"ok": True, "rows_in": len(df),
-            "dtypes": {c: str(df[c].dtype) for c in df.columns}}
+    # the device-resident stage spine: the block stays wherever the
+    # engine produced it (a `DeviceStageBlock` for fused plans — still
+    # on the accelerator). Pandas materializes LAZILY below: only a
+    # host-plane egress lane pays the readback, and only the
+    # hash_shuffle/broadcast escape hatch books it as in-plan host-sync
+    # debt (`hostsync/to_pandas_in_plan` — the counter the spine gate
+    # pins to zero). ICI edges ship the block BY REFERENCE.
+    resp = {"ok": True, "rows_in": int(block.length),
+            "dtypes": _schema_dtypes(block)}
+    df_box: list = []
+    debt_box: list = []
+
+    def df_for(debt: bool):
+        """Materialize pandas ONCE for host-plane egress. `debt=True`
+        lanes (the hash_shuffle/broadcast escape hatch) book the
+        readback on `hostsync/to_pandas_in_plan`; the router-bound
+        collection is the worker's result egress — the one blessed
+        boundary — and stays debt-free."""
+        if not df_box:
+            # lint: transfer-ok(host-plane egress — the block records its own boundary readback; escape-hatch lanes book in-plan debt below)
+            df = block.to_pandas()
+            resp["dtypes"] = {c: str(df[c].dtype) for c in df.columns}
+            df_box.append(df)
+        if debt and not debt_box:
+            debt_box.append(True)
+            from ydb_tpu.utils import memledger
+            memledger.record_transfer(
+                "dq/task.py::host_plane_to_pandas",
+                int(df_box[0].memory_usage(index=False).sum()),
+                to_pandas_in_plan=True)
+        return df_box[0]
+
     total_bytes = total_frames = 0
     t0 = time.perf_counter()
     with span("output-flush", channels=len(outputs),
@@ -108,22 +131,22 @@ def _run_task_body(engine, executor, sql, outputs, src, send, token,
         for out in outputs:
             kind = out["kind"]
             if kind in ("union_all", "merge"):
-                resp["collected_df"] = df
+                resp["collected_df"] = df_for(debt=False)
                 channel_stats.append({
                     "channel": out["channel"], "frames": 0,
-                    "rows": len(df), "bytes": 0,
+                    "rows": int(block.length), "bytes": 0,
                     "backpressure_wait_ms": 0.0})
                 continue
             if out.get("plane") == "ici":
                 # device-resident edge: NO frames leave this task — the
                 # runner (which owns the mesh) collects every producer's
                 # stage output and executes the redistribution as ONE
-                # collective (`dq/ici.py`). Ship the block by reference
+                # collective (`dq/ici.py`). Ship the BLOCK by reference
                 # (ICI edges only lower between in-process mesh
                 # workers) plus the schema's hash-kind verdict for the
                 # routing key, the same signal the host plane feeds
                 # `hash_partition`.
-                resp["ici_df"] = df
+                resp["ici_block"] = block
                 kkinds = resp.setdefault("ici_key_kinds", {})
                 key = out.get("key", "")
                 if key and block.schema.has(key):
@@ -133,7 +156,7 @@ def _run_task_body(engine, executor, sql, outputs, src, send, token,
                         else "float" if dt.is_float else "int")
                 channel_stats.append({
                     "channel": out["channel"], "frames": 0,
-                    "rows": len(df), "bytes": 0, "plane": "ici",
+                    "rows": int(block.length), "bytes": 0, "plane": "ici",
                     "backpressure_wait_ms": 0.0})
                 continue
             n_peers = int(out["n_peers"])
@@ -150,9 +173,10 @@ def _run_task_body(engine, executor, sql, outputs, src, send, token,
                     dt = block.schema.dtype(key)
                     kkind = ("string" if dt.is_string
                              else "float" if dt.is_float else "int")
-                parts = hash_partition(df, key, n_peers, kind=kkind)
+                parts = hash_partition(df_for(debt=True), key, n_peers,
+                                       kind=kkind)
             elif kind == "broadcast":
-                parts = [df] * n_peers
+                parts = [df_for(debt=True)] * n_peers
             else:
                 raise ValueError(f"bad output channel kind {kind!r}")
             writer = ChannelWriter(
@@ -184,6 +208,55 @@ def _run_task_body(engine, executor, sql, outputs, src, send, token,
         counters.inc("dq/frames", total_frames)
         counters.inc("dq/channel_bytes", total_bytes)
     return resp
+
+
+def _schema_dtypes(block) -> dict:
+    """The pandas dtype `to_pandas` WOULD render, derived from the
+    schema WITHOUT materializing host arrays: strings and NULL-bearing
+    columns widen to object, everything else keeps its numpy dtype
+    name. For a device-resident block a still-on-device validity mask
+    reads as nullable (collapsing an all-valid mask to None is host
+    knowledge the spine refuses to sync for); every host-plane egress
+    lane overwrites these hints with exact pandas dtypes."""
+    import numpy as np
+    dev = getattr(block, "device", None)
+    use_dev = dev is not None and not block.materialized
+    out = {}
+    for c in block.schema:
+        masked = (c.name in dev.valids) if use_dev \
+            else (block.columns[c.name].valid is not None)
+        out[c.name] = "object" if (c.dtype.is_string or masked) \
+            else np.dtype(c.dtype.np).name
+    return out
+
+
+def materialize_device_channel(engine, block, table: str) -> dict:
+    """ChannelOpen, device-resident: register a landed
+    `DeviceStageBlock` as the transient channel table WITHOUT
+    materializing host arrays — the consumer stage's fused scan stacks
+    the device columns directly (`storage/device_cache.py` superblock
+    fast path), so a multi-stage plan never leaves the accelerator
+    between stages. `indexate()` is deliberately skipped: portion
+    min/max stats are host readbacks, and a committed-but-unindexed
+    insert entry is a first-class scan source."""
+    import time
+
+    from ydb_tpu.storage.mvcc import WriteVersion
+    t0 = time.perf_counter()
+    if engine.catalog.has(table):
+        old = engine.catalog.table(table)
+        if not getattr(old, "transient", False):
+            raise ValueError(f"refusing to replace non-transient table "
+                             f"{table!r}")
+        engine.catalog.drop_table(table)
+    t = engine.catalog.create_table(
+        table, block.schema, [block.schema.names[0]], transient=True)
+    # the landed block's dictionaries BECOME the table's (same contract
+    # as the host-plane materialize below)
+    t.dictionaries = dict(block.device.dictionaries)
+    t.commit(t.write(block), WriteVersion(1, 1))
+    return {"rows": block.length, "bytes": block.live_nbytes(),
+            "wait_ms": round((time.perf_counter() - t0) * 1000.0, 3)}
 
 
 def materialize_channel(engine, exchange, channel: str, table: str,

@@ -109,6 +109,86 @@ def to_host(dblock: DeviceBlock) -> HostBlock:
     return HostBlock(dblock.schema, cols, n)
 
 
+class DeviceStageBlock(HostBlock):
+    """A stage-boundary block whose columns still live on the
+    accelerator: the device-resident spine's unit of flow between DQ
+    stages.
+
+    It IS a ``HostBlock`` to every consumer that only looks at
+    ``schema``/``length`` or calls the block protocol — but ``columns``
+    is a lazy property that materializes host arrays ONCE (one batched
+    ``to_host`` readback, honestly counted as a boundary transfer) the
+    first time a host-only path touches it. Stage plumbing that stays
+    device-resident (the planned ICI exchange, the device landing in
+    the channel table, the fused scan fast path) reads ``.device``
+    directly and never triggers that readback; ``to_pandas`` therefore
+    survives only where a consumer genuinely leaves the device plane —
+    the client-result boundary.
+
+    ``length`` is host-known (stamped at capture from the fused
+    program's length scalar), so shape planning — segment sizing, the
+    count exchange, channel stats — never syncs."""
+
+    def __init__(self, device: DeviceBlock, length: int):
+        # deliberately NOT the dataclass __init__: `columns` is a
+        # read-only property here, not a field
+        self.schema = device.schema
+        self.device = device
+        self.length = int(length)
+        self._cols = None
+
+    @property
+    def columns(self) -> dict:
+        if self._cols is None:
+            self._cols = to_host(
+                DeviceBlock(self.device.schema, self.device.arrays,
+                            self.device.valids, self.length,
+                            self.device.capacity,
+                            self.device.dictionaries)).columns
+        return self._cols
+
+    @property
+    def materialized(self) -> bool:
+        """True once a host path has forced the readback."""
+        return self._cols is not None
+
+    def live_nbytes(self) -> int:
+        """Live payload bytes (length x schema itemsizes + masks) —
+        shape arithmetic only, never a device sync."""
+        n = 0
+        for c in self.schema:
+            n += self.length * int(np.dtype(c.dtype.np).itemsize)
+            if c.name in self.device.valids:
+                n += self.length
+        return n
+
+    def project(self, output: list) -> "DeviceStageBlock":
+        """Device-side mirror of the executor's `_project_output`
+        (rename + duplicate-label suffixing) — array references move,
+        no bytes do."""
+        from ydb_tpu.core.schema import Column
+
+        arrays, valids, dicts = {}, {}, {}
+        schema_cols = []
+        used = set()
+        for (internal, label) in output:
+            lbl = label
+            k = 2
+            while lbl in used:
+                lbl = f"{label}_{k}"
+                k += 1
+            used.add(lbl)
+            arrays[lbl] = self.device.arrays[internal]
+            if internal in self.device.valids:
+                valids[lbl] = self.device.valids[internal]
+            if internal in self.device.dictionaries:
+                dicts[lbl] = self.device.dictionaries[internal]
+            schema_cols.append(Column(lbl, self.schema.dtype(internal)))
+        dev = DeviceBlock(Schema(schema_cols), arrays, valids,
+                          self.device.length, self.device.capacity, dicts)
+        return DeviceStageBlock(dev, self.length)
+
+
 class DeviceResultFuture:
     """Handle to a dispatched device computation whose device→host
     readout is deferred until the result is actually consumed.

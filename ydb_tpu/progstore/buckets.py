@@ -68,3 +68,40 @@ def bucket_sources(k: int) -> int:  # lint: tuning-provider
         if b >= k:
             return b
     return k
+
+
+def segment_ladder(limit: int) -> tuple:
+    """The FINE quarter-octave ladder for collective segment sizes:
+    {m * 2^e : m in {4, 5, 6, 7}} — 4, 5, 6, 7, 8, 10, 12, 14, 16,
+    20, ... — ratio <= 1.25. Segment padding is wire bytes shipped
+    ndev^2 times, so the coarse ~1.41-ratio source ladder (up to 1.5x
+    overshoot) would blow the <= 1.3x wire/live budget on its own;
+    this ladder caps the per-segment overshoot at 25% while keeping
+    the program-shape count O(log n)."""
+    vals = {v for v in (1, 2, 3) if v <= limit}
+    e = 0
+    while 4 * 2 ** e <= limit:
+        for m in (4, 5, 6, 7):
+            if m * 2 ** e <= limit:
+                vals.add(m * 2 ** e)
+        e += 1
+    return tuple(sorted(vals))
+
+
+def bucket_segment(n: int, minimum: int = 1) -> int:  # lint: tuning-provider
+    """Quantize a collective segment size UP to its fine-ladder rung
+    (at least `minimum`, itself rounded up to a rung). Unlike
+    `bucket_sources` this is NOT gated by `YDB_TPU_SHAPE_BUCKETS`:
+    planned redistribution always buckets its segments — the ladder IS
+    the shape-stability mechanism, not an optional compression of an
+    exact shape."""
+    n = max(int(n), int(minimum), 1)
+    if n <= 4:
+        return n                      # 1, 2, 3, 4 are their own rungs
+    e = 0
+    while 7 * 2 ** e < n:
+        e += 1
+    for m in (4, 5, 6, 7):
+        if m * 2 ** e >= n:
+            return m * 2 ** e
+    return 8 * 2 ** e

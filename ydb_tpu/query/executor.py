@@ -174,6 +174,20 @@ class Executor:
     def dq_stage_depth(self, v: int):
         self._tls.dq_stage_depth = v
 
+    # device-resident stage spine: while True on THIS THREAD, a fused
+    # statement's result is handed back as a `DeviceStageBlock` (device
+    # arrays by reference, host readback deferred) instead of being
+    # drained through `fetch_fused_result`. Armed by `dq/task.py` around
+    # stage statements so multi-stage plans flow device→device; plain
+    # client statements never see it.
+    @property
+    def dq_device_capture(self) -> bool:
+        return getattr(self._tls, "dq_device_capture", False)
+
+    @dq_device_capture.setter
+    def dq_device_capture(self, v: bool):
+        self._tls.dq_device_capture = v
+
     @property
     def last_path(self) -> str:
         return getattr(self._tls, "last_path", "")
@@ -478,6 +492,11 @@ class Executor:
         limit = plan.limit
 
         prog_kid = getattr(fn, "key_id", None)
+        # stage-spine capture: read the thread-local flag at DISPATCH
+        # time (the future may be resolved on another thread). An OFFSET
+        # tail would force a host slice anyway, so those plans keep the
+        # host readout.
+        capture_device = bool(self.dq_device_capture) and not lo
 
         def fetch() -> HostBlock:
             # split the readout into on-device execute (block_until_ready
@@ -493,6 +512,21 @@ class Executor:
             # roofline join: the measured device-execute delta against
             # this program's compiler-reported flops/bytes
             progstats.record_exec(prog_kid, exec_ms, fresh=fresh_compile)
+            if capture_device:
+                # device-resident spine: hand the stage result back as
+                # device arrays by reference — the 4-byte length scalar
+                # is the ONLY thing that crosses the link (plan
+                # metadata, counted as a device handoff, not a host
+                # sync; the program is already done executing)
+                from ydb_tpu.ops.device import DeviceStageBlock
+                n = int(length)
+                dev = F.capture_fused_device(data_stacks, valid_stack, n,
+                                             layout_box, out_schema,
+                                             out_dicts)
+                blk = DeviceStageBlock(dev, n)
+                memledger.record_device_handoff(
+                    "query/executor.py::fused_capture", blk.live_nbytes())
+                return blk
             with self._span("readout-transfer"):
                 block = F.fetch_fused_result(data_stacks, valid_stack,
                                              length, layout_box,
@@ -2241,6 +2275,12 @@ class Executor:
     # -- output ------------------------------------------------------------
 
     def _project_output(self, block: HostBlock, output: list) -> HostBlock:
+        from ydb_tpu.ops.device import DeviceStageBlock
+        if isinstance(block, DeviceStageBlock) and not block.materialized:
+            # stage-spine path: rename device-side, references only —
+            # touching `block.columns` here would force the readback the
+            # capture exists to avoid
+            return block.project(output)
         cols = {}
         schema_cols = []
         used = set()

@@ -91,6 +91,7 @@ def sysview_block(engine, name: str) -> HostBlock:
             "graph": r.get("graph", ""), "stage": r.get("stage", ""),
             "worker": r.get("worker", ""), "state": r.get("state", ""),
             "attempts": int(r.get("attempts", 0)),
+            "channel": str(r.get("channel", "")),
             "rows": int(r.get("rows", 0)),
             "bytes": int(r.get("bytes", 0)),
             "frames": int(r.get("frames", 0)),
@@ -108,6 +109,7 @@ def sysview_block(engine, name: str) -> HostBlock:
         return _block(rows, [("trace_id", "int64"), ("graph", str),
                              ("stage", str), ("worker", str),
                              ("state", str), ("attempts", "int64"),
+                             ("channel", str),
                              ("rows", "int64"), ("bytes", "int64"),
                              ("frames", "int64"), ("plane", str),
                              ("ici_bytes", "int64"),
@@ -348,18 +350,22 @@ def sysview_block(engine, name: str) -> HostBlock:
     if view == "device_transfers":
         # the host-transfer flight recorder's recent-transfer ring
         # (utils/memledger.py, process-wide): one row per recorded
-        # device→host readback, newest last
+        # device→host readback — plus device→device stage handoffs
+        # (`device_to_device` true), which never cross the link —
+        # newest last
         from ydb_tpu.utils.memledger import transfer_ring
         rows = [{
             "seq": int(r["seq"]), "site": r["site"],
             "bytes": int(r["bytes"]), "count": int(r["count"]),
             "boundary": bool(r["boundary"]),
             "to_pandas_in_plan": bool(r["to_pandas_in_plan"]),
+            "device_to_device": bool(r.get("device_to_device", False)),
         } for r in transfer_ring()]
         return _block(rows, [("seq", "int64"), ("site", str),
                              ("bytes", "int64"), ("count", "int64"),
                              ("boundary", "bool"),
-                             ("to_pandas_in_plan", "bool")])
+                             ("to_pandas_in_plan", "bool"),
+                             ("device_to_device", "bool")])
     raise KeyError(f"unknown system view {name!r} "
                    f"(have: {', '.join(PREFIX + v for v in VIEWS)})")
 

@@ -51,22 +51,46 @@ def enumerate_scan_sources(table, snapshot, prune):
     return sources, src_ids
 
 
+def _device_source(b):
+    """The still-on-device view of a stage-spine scan source (a landed
+    `DeviceStageBlock` channel table), or None for plain host blocks.
+    Reading it instead of `.columns` keeps the admission estimate and
+    the superblock stack from forcing the block's host readback."""
+    return getattr(b, "device", None)
+
+
+def _source_cap(b) -> int:
+    dev = _device_source(b)
+    return dev.capacity if dev is not None \
+        else bucket_capacity(max(b.length, 1))
+
+
+def _source_has_valid(b, s: str) -> bool:
+    dev = _device_source(b)
+    return (s in dev.valids) if dev is not None \
+        else (b.columns[s].valid is not None)
+
+
 def estimate_scan_bytes(sources, storage_names: list,
                         pad_to: int = 0) -> int:
     """Superblock HBM footprint of a scan: K stacked sources at the max
     capacity bucket, per column data + validity — the fused-path
     admission estimate (no upload happens to find out it didn't fit).
     `pad_to`: the shape-bucketed row count (padded rows allocate real
-    HBM, so the estimate must charge them)."""
+    HBM, so the estimate must charge them). Device-resident sources
+    answer from shape metadata — no readback."""
     if not sources:
         return 0
     K = max(len(sources), pad_to)
-    CAP = max(bucket_capacity(max(b.length, 1)) for b in sources)
+    CAP = max(_source_cap(b) for b in sources)
     total = 0
     for s in storage_names:
-        cd0 = sources[0].columns[s]
-        total += K * CAP * cd0.data.itemsize
-        if any(b.columns[s].valid is not None for b in sources):
+        b0 = sources[0]
+        itemsize = int(np.dtype(b0.schema.dtype(s).np).itemsize) \
+            if _device_source(b0) is not None \
+            else b0.columns[s].data.itemsize
+        total += K * CAP * itemsize
+        if any(_source_has_valid(b, s) for b in sources):
             total += K * CAP
     return total
 
@@ -200,7 +224,7 @@ class DeviceColumnCache:
         if not sources:
             return None
         K = max(len(sources), pad_to)
-        CAP = max(bucket_capacity(max(b.length, 1)) for b in sources)
+        CAP = max(_source_cap(b) for b in sources)
         # no snapshot component: src_ids already reflect exactly which
         # sources the snapshot sees (portions are immutable), and
         # data_version covers commits — a snapshot in the key would make
@@ -218,6 +242,49 @@ class DeviceColumnCache:
                 arrays[out] = hit[0]
                 if hit[1] is not None:
                     valids[out] = hit[1]
+            elif all(_device_source(b) is not None for b in sources):
+                # device-resident sources (stage-spine channel
+                # landings): stack BY REFERENCE on device — no host
+                # readback, no re-upload. Pad regions zero and validity
+                # is length-clipped, so the stack is bit-identical to
+                # what the host path would have built.
+                iota = jnp.arange(CAP, dtype=jnp.int32)
+                has_valid = any(_source_has_valid(b, s) for b in sources)
+                rows_d, rows_v = [], []
+                for b in sources:
+                    dv = _device_source(b)
+                    act = iota < jnp.int32(b.length)
+                    a = dv.arrays[s]
+                    if a.shape[0] > CAP:
+                        a = a[:CAP]
+                    elif a.shape[0] < CAP:
+                        a = jnp.concatenate(
+                            [a, jnp.zeros(CAP - a.shape[0], a.dtype)])
+                    rows_d.append(jnp.where(act, a, 0))
+                    if has_valid:
+                        va = dv.valids.get(s)
+                        if va is not None:
+                            if va.shape[0] > CAP:
+                                va = va[:CAP]
+                            elif va.shape[0] < CAP:
+                                va = jnp.concatenate(
+                                    [va, jnp.zeros(CAP - va.shape[0],
+                                                   jnp.bool_)])
+                            va = va & act
+                        else:
+                            va = act
+                        rows_v.append(va)
+                for _ in range(K - len(sources)):
+                    rows_d.append(jnp.zeros(CAP, rows_d[0].dtype))
+                    if has_valid:
+                        rows_v.append(jnp.zeros(CAP, jnp.bool_))
+                d = jnp.stack(rows_d)
+                v = jnp.stack(rows_v) if has_valid else None
+                nbytes = d.nbytes + (v.nbytes if v is not None else 0)
+                d, v = self._insert(key, d, v, nbytes)
+                arrays[out] = d
+                if v is not None:
+                    valids[out] = v
             else:
                 # stack + upload OUTSIDE the mutex (see column())
                 dtype = sources[0].columns[s].data.dtype
@@ -238,9 +305,11 @@ class DeviceColumnCache:
                 arrays[out] = d
                 if v is not None:
                     valids[out] = v
-            cd0 = sources[0].columns[s]
-            if cd0.dictionary is not None:
-                dicts[out] = cd0.dictionary
+            dv0 = _device_source(sources[0])
+            dic = dv0.dictionaries.get(s) if dv0 is not None \
+                else sources[0].columns[s].dictionary
+            if dic is not None:
+                dicts[out] = dic
 
         lkey = ("sbl", src_key)
         lhit = self._lookup(lkey)

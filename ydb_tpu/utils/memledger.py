@@ -274,6 +274,29 @@ def record_transfer(site: str, nbytes: int, count: int = 1,
         led.transfer(site, nbytes, count, boundary, to_pandas_in_plan)
 
 
+def record_device_handoff(site: str, nbytes: int, count: int = 1) -> None:
+    """Flight-record one device→device stage handoff (the stage spine's
+    block-by-reference seam: fused capture, planned-exchange landing,
+    channel-table device write). These are NOT host transfers — the
+    bytes never cross the link — so they count under `devlink/*`, ride
+    the same ring for `.sys/device_transfers` visibility (tagged
+    `device_to_device`), and leave every `hostsync/*` counter flat. The
+    classification is the regression surface: a handoff site that
+    mistakenly calls `record_transfer` would re-open ROADMAP item 1's
+    zero-to_pandas gate from the accounting side."""
+    if not enabled():
+        return
+    nbytes, count = int(nbytes), int(count)
+    GLOBAL.inc("devlink/handoffs", count)
+    GLOBAL.inc("devlink/bytes", nbytes)
+    with _RING_MU:
+        _RING_SEQ[0] += 1
+        _RING.append({"seq": _RING_SEQ[0], "site": site,
+                      "bytes": nbytes, "count": count,
+                      "boundary": False, "to_pandas_in_plan": False,
+                      "device_to_device": True})
+
+
 def transfer_ring() -> list:
     """Snapshot of the recent-transfer ring (newest last) — the
     `.sys/device_transfers` payload."""

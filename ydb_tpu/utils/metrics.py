@@ -275,6 +275,9 @@ COUNTER_REGISTRY = {
         "[viz] the transfer-ok-excused boundary subset (client egress)",
     "hostsync/to_pandas_in_plan":
         "[viz] to_pandas materializations INSIDE a multi-stage plan",
+    "devlink/handoffs":
+        "[viz] device→device block handoffs (stage spine, no host sync)",
+    "devlink/bytes": "[viz] live bytes those handoffs kept on device",
     # -- DQ task-graph runtime ---------------------------------------------
     "dq/stages": "stages executed (runner)",
     "dq/tasks": "tasks launched (runner + worker)",
@@ -298,6 +301,9 @@ COUNTER_REGISTRY = {
         "[viz] wire bytes saved by EQuARX block quantization",
     "dq/quant_refused":
         "[viz] declared quant columns refused (shipped exact)",
+    "dq/planned_overflow_reruns":
+        "[viz] planned exchanges whose counts beat the sized segment "
+        "(full-capacity rerun)",
     # -- Hive control plane -------------------------------------------------
     "hive/registered": "[viz] workers registered (first time)",
     "hive/heartbeats": "[viz] lease renewals (push agents or pulse)",

@@ -1167,6 +1167,145 @@ def cold_start_main(n: int = 48, rows: int = 8192) -> int:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def views_main(max_scale: int = 100, reads: int = 20) -> int:
+    """Materialized-view serving leg (`bench.py --views`): one group-by
+    view over a row table under sustained ingest.
+
+    Two claims, measured in-process with the fold lever at its most
+    aggressive (YDB_TPU_VIEW_FOLD_BATCH=1 — every commit folds on the
+    write path, the HTAP posture):
+
+      * read latency vs write scale: median/p99 view-read latency with
+        1x / 10x / 100x write traffic interleaved between reads must
+        stay flat — the 100x median within BENCH_VIEWS_MAX_RATIO
+        (default 1.5x) of the idle read (reads are O(state), never
+        O(backlog): the write path already folded the deltas);
+      * fold O(delta): mean per-fold wall for a FIXED 64-row delta as
+        the source table grows 16x must stay flat (folds touch the
+        delta capacity bucket, not the table).
+
+    Emits ONE JSON line and a VIEWS_r19.json artifact; rides
+    BENCH_HISTORY.jsonl via scripts/bench_history.py. rc 0 = latency
+    ratio under the ceiling, fold flat, differential check green."""
+    os.environ["YDB_TPU_VIEW_FOLD_BATCH"] = "1"
+    import numpy as np
+
+    from ydb_tpu.query import QueryEngine
+    from ydb_tpu.utils.metrics import GLOBAL
+
+    sel = ("select g, count(*) as n, sum(v) as s, min(v) as mn, "
+           "max(v) as mx, avg(v) as av from t group by g")
+    eng = QueryEngine(block_rows=1 << 13)
+    eng.execute("create table t (id Int64 not null, g Int64 not null, "
+                "v Double not null, primary key (id)) with (store = row)")
+    eng.execute(f"create materialized view mv as {sel}")
+    nxt = [0]
+
+    def ingest(rows_n: int) -> None:
+        # one commit per statement: every commit is a write-path fold
+        while rows_n > 0:
+            k = min(rows_n, 64)
+            vals = ", ".join(
+                f"({i}, {i % 7}, {(i % 1000) * 0.5})"
+                for i in range(nxt[0], nxt[0] + k))
+            eng.execute(f"insert into t (id, g, v) values {vals}")
+            nxt[0] += k
+            rows_n -= k
+
+    def read_ms() -> float:
+        t0 = time.perf_counter()
+        eng.query("select * from mv")
+        return (time.perf_counter() - t0) * 1e3
+
+    ingest(512)                                     # seed + warm shapes
+    read_ms()
+    # idle baseline = serving cost with ZERO backlog (cache-busted:
+    # merge + finalize, the apples-to-apples contrast for reads under
+    # write traffic); the cached quiet-view read is reported alongside
+    mv = eng.views.get("mv")
+    idle_cached = [read_ms() for _ in range(reads)]
+    idle = []
+    for _ in range(reads):
+        mv._serve = None
+        idle.append(read_ms())
+
+    scales = {}
+    for scale in (1, 10, max_scale):
+        lat = []
+        for _ in range(reads):
+            ingest(scale)                           # write traffic
+            lat.append(read_ms())
+        scales[str(scale)] = {
+            "writes_per_read": scale,
+            "median_ms": round(float(np.median(lat)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        }
+
+    # fold O(delta): fixed 64-row delta, table grows 16x
+    fold_curve = []
+    for target in (2_048, 8_192, 32_768):
+        ingest(target - nxt[0])
+        eng.query("select * from mv")               # settle the backlog
+        ms0 = GLOBAL.get("view/fold_ms")
+        f0 = eng.views.get("mv").folds
+        ingest(64)
+        eng.query("select * from mv")
+        f1 = eng.views.get("mv").folds
+        fold_curve.append({
+            "table_rows": nxt[0] - 64,
+            "delta_rows": 64,
+            "fold_ms": round((GLOBAL.get("view/fold_ms") - ms0)
+                             / max(f1 - f0, 1), 3),
+        })
+
+    # differential floor: the served state still equals a recompute
+    def _df_eq(a, b):
+        a = a.sort_values("g").reset_index(drop=True)
+        b = b.sort_values("g").reset_index(drop=True)
+        return all(np.allclose(a[c].astype(float), b[c].astype(float),
+                               rtol=1e-9) for c in a.columns)
+
+    diff_ok = _df_eq(eng.query("select * from mv"), eng.query(sel))
+
+    max_ratio = float(os.environ.get("BENCH_VIEWS_MAX_RATIO", "1.5"))
+    idle_med = float(np.median(idle))
+    hot = scales[str(max_scale)]["median_ms"]
+    ratio = hot / idle_med if idle_med else 0.0
+    folds = [c["fold_ms"] for c in fold_curve]
+    fold_flat = (max(folds) / max(min(folds), 1e-3)) if folds else 0.0
+    out = {
+        "metric": "view_read_latency_vs_write_scale",
+        "unit": "ms",
+        "idle_median_ms": round(idle_med, 3),
+        "idle_p99_ms": round(float(np.percentile(idle, 99)), 3),
+        "idle_cached_median_ms":
+            round(float(np.median(idle_cached)), 3),
+        "scales": scales,
+        "read_over_idle_at_max": round(ratio, 3),
+        "max_ratio": max_ratio,
+        "fold_curve": fold_curve,
+        "fold_flat_ratio": round(fold_flat, 3),
+        "table_rows": nxt[0],
+        "folds": eng.views.get("mv").folds,
+        "rebuilds": eng.views.get("mv").rebuilds,
+        "diff_ok": bool(diff_ok),
+    }
+    # fold-flat ceiling is generous (4x over a 16x table growth): the
+    # claim is O(delta) not O(table) — a linear-in-table fold shows ~16x
+    out["ok"] = bool(diff_ok and ratio <= max_ratio and fold_flat <= 4.0
+                     and out["rebuilds"] == 0)
+    print(json.dumps(out), flush=True)
+    me = os.path.abspath(__file__)
+    artifact = os.path.join(os.path.dirname(me), "VIEWS_r19.json")
+    with open(artifact, "w") as f:
+        json.dump(out, f, indent=2)
+    log(f"views: read {hot}ms @ {max_scale}x writes vs idle "
+        f"{out['idle_median_ms']}ms ({out['read_over_idle_at_max']}x, "
+        f"ceiling {max_ratio}x), fold flat {out['fold_flat_ratio']}x "
+        f"over 16x table growth, diff_ok={diff_ok} -> {artifact}")
+    return 0 if out["ok"] else 1
+
+
 def multichip_main(n: int, rows: int) -> int:
     """Multi-chip shuffle leg (`bench.py --multichip [N]`): an N-worker,
     N-device sharded×sharded join driven through BOTH channel planes —
@@ -1426,6 +1565,28 @@ def main() -> None:
             suites["cold_start"] = {"error": f"{type(e).__name__}"}
             log(f"cold-start leg failed: {type(e).__name__}")
         _emit(suites)
+    # materialized-view serving leg (read latency vs write scale + fold
+    # O(delta) evidence): same child + watchdog shape as the other legs
+    views_n = int(os.environ.get("BENCH_VIEWS", "100") or 0)
+    if views_n:
+        cmd = [sys.executable, os.path.abspath(__file__), "--views",
+               str(views_n)]
+        try:
+            p = subprocess.run(cmd, timeout=QUERY_TIMEOUT,
+                               capture_output=True)
+            line = p.stdout.decode(errors="replace").strip() \
+                .splitlines()[-1] if p.stdout.strip() else "{}"
+            suites["views"] = json.loads(line)
+            suites["views"]["rc"] = p.returncode
+            log(f"views: {suites['views'].get('read_over_idle_at_max')}x "
+                f"read-over-idle @ {views_n}x writes, fold flat "
+                f"{suites['views'].get('fold_flat_ratio')}x, "
+                f"diff_ok={suites['views'].get('diff_ok')}")
+        except (subprocess.TimeoutExpired, json.JSONDecodeError,
+                IndexError) as e:
+            suites["views"] = {"error": f"{type(e).__name__}"}
+            log(f"views leg failed: {type(e).__name__}")
+        _emit(suites)
     plan = [("tpch", sf) for sf in SUITE_SFS]
     if TPCDS_SF:
         plan.append(("tpcds", float(TPCDS_SF)))
@@ -1487,6 +1648,9 @@ if __name__ == "__main__":
         sys.exit(cold_start_main(
             int(sys.argv[2]) if len(sys.argv) > 2 else 48,
             rows=int(os.environ.get("BENCH_COLD_START_ROWS", "8192"))))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--views":
+        sys.exit(views_main(
+            int(sys.argv[2]) if len(sys.argv) > 2 else 100))
     elif len(sys.argv) > 1 and sys.argv[1] == "--multichip":
         sys.exit(multichip_main(
             int(sys.argv[2]) if len(sys.argv) > 2 else 4,

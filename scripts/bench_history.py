@@ -96,6 +96,15 @@ def entry_from_suites(suites: dict, source: str = "bench.py") -> dict:
                 "zero_compile_restart": s.get("zero_compile_restart"),
             }
             continue
+        if key == "views":
+            e["views"] = {
+                "idle_median_ms": s.get("idle_median_ms"),
+                "read_over_idle_at_max": s.get("read_over_idle_at_max"),
+                "scales": s.get("scales"),
+                "fold_flat_ratio": s.get("fold_flat_ratio"),
+                "diff_ok": s.get("diff_ok"),
+            }
+            continue
         if "geomean_ms" not in s:
             continue
         e["suites"][key] = {

@@ -212,6 +212,22 @@ if [ "$drc" -ne 0 ]; then
     exit "$drc"
 fi
 
+echo "== materialized-views gate (differential fold, kill -9 mirror restart, zero fold recompiles, DROP frees) =="
+# the continuous-query floor: a group-by view (NULLable string key,
+# count/sum/min/max/avg) under seeded randomized insert/update/delete
+# must read equal to a full recompute at the same watermark after every
+# batch (incl. min/max-under-delete), survive kill -9 via the host
+# mirror with ZERO counted rebuilds, resume folding with
+# prog/compile_ms EXACTLY 0 (fold programs deserialize from the
+# progstore), and DROP MATERIALIZED VIEW must unsubscribe the consumer
+# and free state (view/registered back to 0, mirror + auto topic gone)
+JAX_PLATFORMS=cpu python scripts/views_gate.py
+vrc=$?
+if [ "$vrc" -ne 0 ]; then
+    echo "materialized-views gate FAILED (rc=$vrc)" >&2
+    exit "$vrc"
+fi
+
 echo "== Hive chaos gate (3 workers, kill -9 mid-query, re-placement) =="
 # the elastic-cluster floor: kill -9 one of three durable+mirrored
 # workers while a query stream runs — every query must COMPLETE after

@@ -365,3 +365,22 @@ def test_oid0_param_stays_string(pg):
     assert rows == [["x"]]
     c.query("drop table p0")
     c.close()
+
+
+def test_matview_over_the_wire(pg):
+    """Materialized-view DDL routes like any other DDL (command tags)
+    and a view read serves rows through the simple-query flow."""
+    c = PgClient(pg.port)
+    c.query("create table mvsrc (k Int64 not null, v Int64, "
+            "primary key (k)) with (store = row)")
+    _c, _r, tag = c.query("create materialized view wv as "
+                          "select count(*) as n, sum(v) as s from mvsrc")
+    assert tag == "CREATE MATERIALIZED VIEW"
+    c.query("insert into mvsrc (k, v) values (1, 10), (2, 32)")
+    cols, rows, _tag = c.query("select * from wv")
+    assert cols == ["n", "s"] and rows == [["2", "42"]]
+    _c, _r, tag = c.query("drop materialized view wv")
+    assert tag == "DROP MATERIALIZED VIEW"
+    _c, _r, tag = c.query("drop table mvsrc")
+    assert tag == "DROP TABLE"
+    c.close()

@@ -121,8 +121,17 @@ class QueryEngine:
         # ydb_tpu/storage/topic.py); durable under <root>/__topics
         self.topics: dict = {}
         self._changefeeds: dict = {}    # table -> topic name
+        self._cdc_since: dict = {}      # table -> plan_step at enable
         if self.catalog.store is not None:
             self._load_topics()
+        self._reconcile_changefeeds()
+        # materialized views (ydb_tpu/views/): continuous queries over
+        # the changefeeds above; loaded AFTER topics + healing so the
+        # consumers resume against a consistent topic tail
+        from ydb_tpu.views import ViewManager
+        self.views = ViewManager(self)
+        self.views.load()
+        self._view_tls = threading.local()   # per-read serving notes
         # tracing (Wilson analog, utils/tracing.py): span tree per
         # statement, rendered by EXPLAIN ANALYZE; `trace_to_topic()`
         # wires the OTLP-uploader seat
@@ -369,6 +378,9 @@ class QueryEngine:
             t.changefeed = ChangefeedSink(self.topic(topic_name),
                                           table_name, t.key_columns)
             self._changefeeds[table_name] = topic_name
+            # publication floor: commits at or below this step predate
+            # the changefeed and must not be re-emitted by replay healing
+            self._cdc_since[table_name] = self.coordinator.last_plan_step
             self._save_topics()
 
     def _topic_root(self, name: str):
@@ -384,7 +396,9 @@ class QueryEngine:
             os.path.join(self.catalog.store.root, "topics.json"),
             {"topics": {n: len(t.partitions)
                         for n, t in self.topics.items()},
-             "changefeeds": dict(self._changefeeds)})
+             "changefeeds": {t: {"topic": n,
+                                 "since": self._cdc_since.get(t, 0)}
+                             for t, n in self._changefeeds.items()}})
 
     def _load_topics(self) -> None:
         import json as _json
@@ -396,19 +410,44 @@ class QueryEngine:
             meta = _json.load(f)
         for n, parts in meta.get("topics", {}).items():
             self.topics[n] = Topic(n, parts, self._topic_root(n))
-        for table_name, topic_name in meta.get("changefeeds", {}).items():
+        for table_name, cf in meta.get("changefeeds", {}).items():
+            # legacy format stored a bare topic name; treat its floor as
+            # "now" so replay healing never republishes history
+            topic_name = cf["topic"] if isinstance(cf, dict) else cf
+            since = cf.get("since", 0) if isinstance(cf, dict) \
+                else self.coordinator.last_plan_step
             if self.catalog.has(table_name) and topic_name in self.topics:
                 t = self.catalog.table(table_name)
                 t.changefeed = ChangefeedSink(
                     self.topics[topic_name], table_name, t.key_columns)
                 self._changefeeds[table_name] = topic_name
+                self._cdc_since[table_name] = int(since)
+
+    def _reconcile_changefeeds(self) -> None:
+        """Heal torn topic tails after recovery: re-emit the row-WAL
+        replay events through each wired changefeed. The deterministic
+        producer seq_no dedups everything already published, so only a
+        tail lost to a crash between the row-WAL fsync and the topic
+        append lands again — exactly once, in commit order."""
+        for table_name in self._changefeeds:
+            t = self.catalog.table(table_name)
+            log = getattr(t, "_replay_log", None)
+            since = self._cdc_since.get(table_name, 0)
+            if t.changefeed is None or not log:
+                continue
+            for version, events in log:
+                if events and version.plan_step > since:
+                    t.changefeed.emit(events, version)
+        for t in self.catalog.tables.values():
+            if getattr(t, "_replay_log", None) is not None:
+                t._replay_log = None
 
     # -- entry -------------------------------------------------------------
 
     _AUDITED_KINDS = frozenset((
         "createtable", "droptable", "altertable", "createindex",
         "dropindex", "insert", "update", "delete", "begin", "commit",
-        "rollback"))
+        "rollback", "creatematerializedview", "dropmaterializedview"))
 
     def execute(self, sql: str, session=None,
                 _internal: bool = False) -> HostBlock:
@@ -653,6 +692,11 @@ class QueryEngine:
                         # message — SQL clients see the distinct error
                         # text; session-API clients get the distinct type
                         raise QueryError(str(e)) from e
+                    if isinstance(stmt, ast.Commit):
+                        # a tx commit lands its CDC events at stamp time —
+                        # give lagging views a chance to fold off-read
+                        for vt in list(self.views._by_source):
+                            self.views.on_commit(vt)
                 return _unit_block()
             if isinstance(stmt, ast.Explain):
                 return self._explain_stmt(stmt, session)
@@ -691,14 +735,38 @@ class QueryEngine:
                         raise QueryError("DDL inside a transaction is not "
                                          "supported")
                     return self._create_table(stmt)
+                if isinstance(stmt, ast.CreateMaterializedView):
+                    if tx is not None:
+                        raise QueryError("DDL inside a transaction is not "
+                                         "supported")
+                    from ydb_tpu.views import UnsupportedView
+                    try:
+                        self.views.create(stmt.name, stmt.query, stmt.sql)
+                    except UnsupportedView as e:
+                        raise QueryError(
+                            f"unsupported materialized view: {e}") from e
+                    return _unit_block()
+                if isinstance(stmt, ast.DropMaterializedView):
+                    if tx is not None:
+                        raise QueryError("DDL inside a transaction is not "
+                                         "supported")
+                    self.views.drop(stmt.name, stmt.if_exists)
+                    return _unit_block()
                 if isinstance(stmt, ast.DropTable):
                     if tx is not None:
                         raise QueryError("DDL inside a transaction is not "
                                          "supported")
                     if stmt.if_exists and not self.catalog.has(stmt.name):
                         return _unit_block()
+                    deps = self.views.on_table(stmt.name)
+                    if deps:
+                        raise QueryError(
+                            f"table {stmt.name!r} feeds materialized "
+                            "view(s): "
+                            + ", ".join(sorted(v.name for v in deps)))
                     self.catalog.drop_table(stmt.name)
                     if self._changefeeds.pop(stmt.name, None) is not None:
+                        self._cdc_since.pop(stmt.name, None)
                         self._save_topics()   # else the topic stays pinned
                     return _unit_block()
                 if isinstance(stmt, ast.AlterTable):
@@ -741,6 +809,9 @@ class QueryEngine:
     def _execute_read(self, stmt, sql: str, snap, stats, t) -> HostBlock:
         """SELECT / set-op execution — lock-free, runs concurrently."""
         from ydb_tpu.utils.metrics import GLOBAL
+        # collect this read's view-serving decisions (thread-local:
+        # reads run concurrently) for QueryStats / EXPLAIN ANALYZE
+        self._view_tls.notes = []
         if isinstance(stmt, ast.SetOp):
             block = self._execute_set_op(stmt, snap)
             self.executor.last_path = "set-op"
@@ -973,6 +1044,7 @@ class QueryEngine:
         stats.rows_out = block.length
         stats.fused = self.executor.last_path == "fused"
         stats.distributed = self.executor.last_path == "distributed"
+        stats.view_serving = getattr(self._view_tls, "notes", None) or []
         delta = groupby_trace_delta(getattr(stats, "_gb_mark", {}))
         # the bounds-lattice gauges ride the same trace window under a
         # `bounds_` prefix — split them into their own stats surface
@@ -1119,6 +1191,19 @@ class QueryEngine:
                     self.planner.plan_select(stmt.query)).split("\n")
             except (BindError, PlanError, KeyError) as e:
                 raise QueryError(str(e)) from e
+        if isinstance(stmt.query, ast.Select):
+            # serving-mode probe (no fold): which way would this read go
+            snap = self.snapshot()
+            for name in sorted(self._referenced_tables(stmt.query)):
+                view = self.views.get(name)
+                if view is not None:
+                    mode = view.peek_mode(snap)
+                    serving = (f"state @ plan_step {view.watermark}"
+                               if mode == "state"
+                               else f"base-query fallback ({mode})")
+                    lines.append(
+                        f"-- view {name}: watermark plan_step="
+                        f"{view.watermark}, serving={serving}")
         if stmt.analyze:
             block = self.execute(stmt.sql, session=session, _internal=True)
             lines += self.last_stats.render().split("\n")
@@ -1479,8 +1564,11 @@ class QueryEngine:
         if sel.ctes:
             return True
         from ydb_tpu.scheme import sysview as SV
-        if any(SV.is_sysview(n) for n in self._referenced_tables(sel)):
+        refs = self._referenced_tables(sel)
+        if any(SV.is_sysview(n) for n in refs):
             return True               # `.sys/...` materializes at plan time
+        if any(self.views.has(n) for n in refs):
+            return True               # view reads serve from folded state
 
         def rel_has(r):
             if isinstance(r, ast.SubqueryRef):
@@ -1552,6 +1640,24 @@ class QueryEngine:
                 t = cte_map.get(r.name)
                 if t is not None:
                     return ast.TableRef(t, r.alias or r.name)
+                view = self.views.get(r.name)
+                if view is not None:
+                    vsnap = snap or self.snapshot()
+                    blk, mode = view.serve(vsnap)
+                    notes = getattr(self._view_tls, "notes", None)
+                    if notes is not None:
+                        notes.append({"view": r.name, "mode": mode,
+                                      "watermark": view.watermark})
+                    if blk is not None:
+                        tname = self._register_temp(blk, temps, vsnap)
+                        return ast.TableRef(tname, r.alias or r.name)
+                    # base-query fallback: materialize the defining
+                    # SELECT at this read's snapshot
+                    from ydb_tpu.sql.parser import parse
+                    sub = self._rewrite_sel(parse(view.vp.sql), {},
+                                            temps, vsnap)
+                    tname = self._materialize(sub, temps, vsnap)
+                    return ast.TableRef(tname, r.alias or r.name)
                 from ydb_tpu.scheme import sysview as SV
                 if SV.is_sysview(r.name):
                     try:
@@ -1667,6 +1773,9 @@ class QueryEngine:
             if stmt.if_not_exists:
                 return _unit_block()
             raise QueryError(f"table {stmt.name!r} already exists")
+        if self.views.has(stmt.name):
+            raise QueryError(
+                f"{stmt.name!r} already names a materialized view")
         cols = [Column(name, sql_type_to_dtype(ty, not_null))
                 for (name, ty, not_null) in stmt.columns]
         pk = stmt.primary_key or [cols[0].name]
@@ -1910,6 +2019,9 @@ class QueryEngine:
         else:
             with self._commit_step() as version:
                 table.apply(ops, version)
+            # threshold-fold for this table's views: keeps read-time
+            # drains to one small tail (non-blocking, no-op without views)
+            self.views.on_commit(table.name)
 
 
     # -- UPDATE / DELETE ---------------------------------------------------

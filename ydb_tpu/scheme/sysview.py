@@ -21,7 +21,8 @@ PREFIX = ".sys/"
 VIEWS = ("tables", "partition_stats", "counters", "query_metrics",
          "top_queries_by_duration", "dq_stage_stats", "query_profiles",
          "cluster_nodes", "query_memory", "device_transfers",
-         "query_critical_path", "compiled_programs", "progstore")
+         "query_critical_path", "compiled_programs", "progstore",
+         "materialized_views")
 
 
 def is_sysview(name: str) -> bool:
@@ -347,6 +348,30 @@ def sysview_block(engine, name: str) -> HostBlock:
                              ("env", str), ("device", str),
                              ("admission_active", "int64"),
                              ("admission_in_flight_bytes", "int64")])
+    if view == "materialized_views":
+        # the continuous-query registry (ydb_tpu/views/): one row per
+        # view — source, CDC topic, the watermark plan_step its state is
+        # exact at, current lag in coordinator steps, state size, fold/
+        # rebuild activity, and the degraded flag (permanent base-query
+        # fallback after the bounds escape)
+        rows = [{
+            "name": r["name"], "source": r["source"],
+            "kind": r["kind"], "topic": r["topic"],
+            "watermark_step": int(r["watermark_step"]),
+            "lag_versions": int(r["lag_versions"]),
+            "state_rows": int(r["state_rows"]),
+            "state_bytes": int(r["state_bytes"]),
+            "folds": int(r["folds"]), "rebuilds": int(r["rebuilds"]),
+            "degraded": bool(r["degraded"]),
+        } for r in engine.views.sysview_rows()]
+        return _block(rows, [("name", str), ("source", str),
+                             ("kind", str), ("topic", str),
+                             ("watermark_step", "int64"),
+                             ("lag_versions", "int64"),
+                             ("state_rows", "int64"),
+                             ("state_bytes", "int64"),
+                             ("folds", "int64"), ("rebuilds", "int64"),
+                             ("degraded", "bool")])
     if view == "device_transfers":
         # the host-transfer flight recorder's recent-transfer ring
         # (utils/memledger.py, process-wide): one row per recorded

@@ -409,7 +409,9 @@ class _Handler(socketserver.BaseRequestHandler):
 
     _DDL_TAGS = {"createtable": "CREATE TABLE", "droptable": "DROP TABLE",
                  "altertable": "ALTER TABLE", "createindex": "CREATE INDEX",
-                 "dropindex": "DROP INDEX"}
+                 "dropindex": "DROP INDEX",
+                 "creatematerializedview": "CREATE MATERIALIZED VIEW",
+                 "dropmaterializedview": "DROP MATERIALIZED VIEW"}
 
     def _run(self, srv, session, sql: str) -> bytes:
         if not sql.strip():

@@ -275,6 +275,22 @@ class Update:
     where: Optional[Expr] = None
 
 
+@dataclass
+class CreateMaterializedView:
+    """CREATE MATERIALIZED VIEW v AS SELECT ... — registers a continuous
+    query maintained from the source table's changefeed (ydb_tpu/views/,
+    the reference's change-exchange + continuous-query surface)."""
+    name: str
+    query: "Select"
+    sql: str = ""                  # defining SELECT text (restart recompile)
+
+
+@dataclass
+class DropMaterializedView:
+    name: str
+    if_exists: bool = False
+
+
 @dataclass(frozen=True)
 class Explain:
     query: "Select"
@@ -298,4 +314,5 @@ class Rollback:
 
 
 Statement = Union[Select, CreateTable, DropTable, Insert, Delete, Update,
+                  CreateMaterializedView, DropMaterializedView,
                   Explain, Begin, Commit, Rollback]

@@ -79,13 +79,19 @@ class Parser:
         if self.at_kw("with") or self.at_kw("select"):
             stmt = self.parse_select()
         elif self.at_kw("create"):
-            if self.peek(1).value.lower() == "index":
+            nxt = self.peek(1).value.lower()
+            if nxt == "index":
                 stmt = self.parse_create_index()
+            elif nxt == "materialized":
+                stmt = self.parse_create_matview()
             else:
                 stmt = self.parse_create_table()
         elif self.at_kw("drop"):
-            if self.peek(1).value.lower() == "index":
+            nxt = self.peek(1).value.lower()
+            if nxt == "index":
                 stmt = self.parse_drop_index()
+            elif nxt == "materialized":
+                stmt = self.parse_drop_matview()
             else:
                 stmt = self.parse_drop_table()
         elif self.at_kw("alter"):
@@ -691,6 +697,29 @@ class Parser:
         iname = self.ident()
         self.expect_kw("on")
         return ast.DropIndex(iname, self.ident())
+
+    def parse_create_matview(self) -> ast.CreateMaterializedView:
+        self.expect_kw("create")
+        self.next()                       # "materialized" (contextual)
+        if self.next().value.lower() != "view":
+            raise SqlError("expected VIEW after MATERIALIZED")
+        name = self.ident()
+        self.expect_kw("as")
+        # capture the defining SELECT verbatim: the view registry persists
+        # it and recompiles the fold programs from it at restart
+        sql = self.text[self.peek().pos:].rstrip().rstrip(";").rstrip()
+        return ast.CreateMaterializedView(name, self.parse_select(), sql)
+
+    def parse_drop_matview(self) -> ast.DropMaterializedView:
+        self.expect_kw("drop")
+        self.next()                       # "materialized"
+        if self.next().value.lower() != "view":
+            raise SqlError("expected VIEW after MATERIALIZED")
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        return ast.DropMaterializedView(self.ident(), if_exists)
 
     def parse_alter_table(self) -> ast.AlterTable:
         self.expect_kw("alter")

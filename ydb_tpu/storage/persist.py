@@ -477,6 +477,12 @@ class Store:
 
             if tm.get("store_kind", "column") == "row":
                 wal = os.path.join(self._tdir(name), "rowwal.bin")
+                # arm the CDC replay log: the engine re-emits these
+                # through the table's changefeed after topics load, so a
+                # topic tail torn off by a crash between the row-WAL
+                # fsync and the topic append heals (seq dedup drops the
+                # already-published prefix)
+                t._replay_log = []
                 for rec in B.wal_replay(wal):
                     ver = WriteVersion(rec["plan_step"], rec["tx_id"])
                     ops = [(kind, vals) for (kind, vals) in rec["ops"]]

@@ -117,6 +117,19 @@ class RowTable:
         # CDC sink (storage/topic.ChangefeedSink) — committed mutations
         # publish to a topic in commit order (change_exchange analog)
         self.changefeed = None
+        # open-tx CDC events (old/new images captured at statement time;
+        # emitted at stamp_tx, discarded at rollback_tx). Statement-time
+        # images are commit-time-correct: optimistic point-conflict
+        # validation aborts any tx whose touched keys were re-committed
+        # under it, so a tx that reaches stamp_tx saw the images it
+        # publishes.
+        self._tx_events: dict = {}
+        # WAL-replay event log: persist.load arms this ([]) before
+        # replaying rowwal.bin, apply() appends (version, events) per
+        # replayed commit, and the engine re-emits them through the
+        # changefeed after topics load — producer seq dedup drops all but
+        # a torn topic tail. None outside recovery.
+        self._replay_log = None
 
     # -- write path -------------------------------------------------------
 
@@ -131,6 +144,18 @@ class RowTable:
             return int(self.dictionaries[col].encode([str(v)])[0])
         return dt.np(v).item() if not isinstance(v, (int, float, bool)) \
             else v
+
+    def _decode_row(self, values) -> Optional[dict]:
+        """Stored (encoded) value tuple -> {col: python value} with string
+        codes decoded — the CDC row-image domain."""
+        if values is None:
+            return None
+        out = {}
+        for c, v in zip(self.schema.columns, values):
+            if v is not None and c.dtype.is_string:
+                v = self.dictionaries[c.name]._values[v]
+            out[c.name] = v
+        return out
 
     # -- schema evolution (ALTER TABLE) ------------------------------------
 
@@ -211,6 +236,7 @@ class RowTable:
         view = Snapshot(2 ** 62, 2 ** 62, tx_view=tx)
         appends: list[tuple[tuple, object]] = []   # (pk, values | None)
         overlay: dict[tuple, object] = {}          # batch-local live view
+        events: list = []   # CDC: committed effects with old/new images
         for kind, vals in ops:
             # non-strict = WAL replay: mutations may predate a DROP COLUMN
             enc = {c: self._encode_value(c, v) for c, v in vals.items()
@@ -222,9 +248,11 @@ class RowTable:
                 live = self._visible(self.rows.get(pk, ()), view)
             if kind == "delete":
                 if live is None:
-                    continue
+                    continue           # no-op delete: no effect, no event
                 appends.append((pk, None))
                 overlay[pk] = None
+                events.append({"op": kind, "row": vals,
+                               "old": self._decode_row(live), "new": None})
                 continue
             if kind == "insert" and live is not None:
                 raise ValueError(
@@ -242,6 +270,9 @@ class RowTable:
             values = tuple(row[c] for c in self.schema.names)
             appends.append((pk, values))
             overlay[pk] = values
+            events.append({"op": kind, "row": vals,
+                           "old": self._decode_row(live),
+                           "new": self._decode_row(values)})
         # validation passed — mutate
         idx_cols = [(col, self.schema.names.index(col), data)
                     for col, data in self._index_data.items()]
@@ -253,15 +284,20 @@ class RowTable:
         if tx is not None:
             self._tx_touched.setdefault(tx, set()).update(
                 pk for pk, _v in appends)
+            if events:
+                self._tx_events.setdefault(tx, []).extend(events)
         self.data_version += 1
         self._snap_cache.clear()
         if durable and tx is None and self.store is not None:
             self.store.row_wal_append(self.name, ops, version)
             self.store.save_dictionaries(self)
             self.store.save_state(version.plan_step)
+        if tx is None and version is not None \
+                and self._replay_log is not None:
+            self._replay_log.append((version, events))
         if self.changefeed is not None and tx is None \
-                and version is not None and durable:
-            self.changefeed.emit(ops, version)
+                and version is not None and durable and events:
+            self.changefeed.emit(events, version)
         return len(appends)
 
     def max_committed_step(self, pks) -> int:
@@ -304,10 +340,12 @@ class RowTable:
             self.store.row_wal_append(self.name, ops_for_wal, version)
             self.store.save_dictionaries(self)
             self.store.save_state(version.plan_step)
-        if self.changefeed is not None and ops_for_wal:
-            self.changefeed.emit(ops_for_wal, version)
+        events = self._tx_events.pop(tx, None)
+        if self.changefeed is not None and events:
+            self.changefeed.emit(events, version)
 
     def rollback_tx(self, tx: int) -> None:
+        self._tx_events.pop(tx, None)
         for pk in self._tx_touched.pop(tx, ()):
             chain = [(v, vals, etx)
                      for (v, vals, etx) in self.rows.get(pk, [])

@@ -125,24 +125,48 @@ class Topic:
             _atomic_json(self._offsets_path, self.offsets)
 
 
+def _plain(v):
+    return v.item() if hasattr(v, "item") else v
+
+
+def _plain_row(d):
+    return None if d is None else {c: _plain(v) for c, v in d.items()}
+
+
 class ChangefeedSink:
     """CDC: publishes committed row-table mutations into a topic,
     partitioned by primary key (per-key ordering, like the reference's
-    changefeed partitioning by key hash)."""
+    changefeed partitioning by key hash).
+
+    Exactly-once: every message carries producer `cdc:<table>` with a
+    DETERMINISTIC seq_no `(plan_step << 32) | index-in-commit`. Commits
+    are the only emitters and each table sees one emit() per commit, so
+    the sequence is globally monotone per table — and therefore monotone
+    along the subsequence routed to any one partition, which is exactly
+    what the per-(producer, partition) high-water dedup needs. A torn
+    topic tail (crash between the row-WAL fsync and the topic append)
+    heals at reopen: the engine re-emits row-WAL replay events through
+    this same path and dedup drops everything already on disk."""
 
     def __init__(self, topic: Topic, table_name: str,
                  key_columns: list):
         self.topic = topic
         self.table_name = table_name
         self.key_columns = list(key_columns)
+        self.producer = f"cdc:{table_name}"
 
-    def emit(self, ops: list, version) -> None:
-        def plain(v):
-            return v.item() if hasattr(v, "item") else v
-        for (kind, vals) in ops:
-            row = {c: plain(v) for c, v in vals.items()}
+    def emit(self, events: list, version) -> None:
+        """events: [{"op", "row", "old", "new"}] — committed effects only
+        (no-op deletes never reach here), in commit order, with decoded
+        old/new row images (NEWIMAGE mode; consumers that maintain
+        derived state need both sides of every mutation)."""
+        base = version.plan_step << 32
+        for i, ev in enumerate(events):
+            row = _plain_row(ev["row"])
             key = tuple(row.get(k) for k in self.key_columns)
             self.topic.write(
-                {"table": self.table_name, "op": kind, "row": row,
+                {"table": self.table_name, "op": ev["op"], "row": row,
+                 "old": _plain_row(ev.get("old")),
+                 "new": _plain_row(ev.get("new")),
                  "plan_step": version.plan_step, "tx_id": version.tx_id},
-                key=str(key))
+                key=str(key), producer=self.producer, seq_no=base | i)

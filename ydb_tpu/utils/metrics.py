@@ -433,6 +433,27 @@ COUNTER_REGISTRY = {
     "slow_query/count": "[viz] over-threshold statements",
     "slow_query/worst_ms": "worst statement wall seen",
     "slow_query/*": "over-threshold statements by kind",
+    # -- materialized views (ydb_tpu/views/): continuous queries folding
+    # CDC deltas into device-maintained aggregate state ----------------------
+    "view/registered": "(dynamic) materialized views currently defined",
+    "view/applied_deltas":
+        "[viz] changefeed messages folded into view state",
+    "view/delta_rows":
+        "[viz] signed delta rows (old/new images) through fold programs",
+    "view/fold_ms":
+        "[hist] one delta-batch fold wall (delta block -> row program "
+        "-> partial group-by -> state apply) — flat in delta size, "
+        "never O(table)",
+    "view/rebuilds":
+        "[viz] full-recompute escapes (bound exceeded / pre-image-less "
+        "mutation / missing host mirror)",
+    "view/lag_versions":
+        "(dynamic) coordinator steps the laggiest fold is behind",
+    "view/reads_state":
+        "[viz] view reads served from folded state at the watermark",
+    "view/reads_fallback":
+        "[viz] view reads that fell back to the base query (snapshot "
+        "behind state, or degraded view)",
     # -- servers ------------------------------------------------------------
     "server/http_queries": "HTTP front statements",
     "server/rpc_in_flight": "(dynamic) gRPC handler gauge",
@@ -495,6 +516,11 @@ class QueryStats:
     # bound_class, programs: [...]}. Empty when no instrumented program
     # ran or YDB_TPU_PROGSTATS=0.
     programs: dict = field(default_factory=dict)
+    # materialized-view serving decisions (`views/manager.py`): one
+    # {view, mode, watermark} per view this read referenced — mode
+    # "state" served the folded aggregate state at the watermark,
+    # "fallback"/"degraded" re-ran the defining query at the snapshot
+    view_serving: list = field(default_factory=list)
 
     def render(self) -> str:
         path = ("mesh-distributed" if self.distributed
@@ -562,6 +588,14 @@ class QueryStats:
                 line += f", {m['to_pandas_in_plan']} to_pandas-in-plan"
             line += ")"
             out += line
+        for v in self.view_serving:
+            if v.get("mode") == "state":
+                out += (f"\n-- view {v['view']}: state @ plan_step "
+                        f"{v['watermark']}")
+            else:
+                out += (f"\n-- view {v['view']}: base-query fallback "
+                        f"({v.get('mode', 'fallback')}, watermark "
+                        f"plan_step {v['watermark']})")
         if self.programs and self.programs.get("programs"):
             p = self.programs
             head = (f"\n-- programs: {p['n']} | "

@@ -1,0 +1,29 @@
+"""Incremental materialized views (continuous queries over CDC).
+
+The reference publishes committed DataShard mutations into topics
+precisely so downstream consumers can maintain derived state without
+re-scanning the source (`ydb/core/change_exchange/`). This package is
+that consumer surface: `CREATE MATERIALIZED VIEW v AS SELECT ...`
+registers a continuous query whose aggregate state is folded forward
+from the source table's changefeed — a view update costs O(delta), a
+view read costs O(state), never O(table).
+
+  * `compile.py`  — the fold compiler: the defining SELECT becomes a
+    row program (key/weighted-input assigns + WHERE filter), a partial
+    GroupBy (the segment-reduce of one delta batch) and a merge GroupBy
+    (per-partition partial state → served groups, the DQ partial/final
+    merge shape), all plain `ops/ir` programs executed through
+    `ops/xla_exec` — so they ride the ProgramCache, the progstore
+    (restart folds with compile_ms == 0) and the roofline observatory
+    like any other program.
+  * `manager.py`  — the view registry + maintainer: consumes the CDC
+    topic per partition, folds deltas into keyed aggregate state,
+    mirrors state to the host store for restart, and serves reads at
+    the view's high-watermark WriteVersion (a read at a snapshot the
+    state has run ahead of falls back to the base query).
+"""
+
+from ydb_tpu.views.manager import MatView, ViewManager
+from ydb_tpu.views.compile import UnsupportedView, compile_view
+
+__all__ = ["MatView", "ViewManager", "UnsupportedView", "compile_view"]

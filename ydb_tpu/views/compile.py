@@ -1,0 +1,434 @@
+"""Fold-program compiler: a view's defining SELECT → op-IR programs.
+
+A materialized view's maintenance loop is three ordinary `ops/ir`
+programs (arxiv 2603.09555's compiler-first constant-cost-update stance:
+compile the update rule once, apply it per delta):
+
+  * **row program** — per delta row (a CDC old/new image carrying a
+    ``__sign`` of -1/+1): group-key assigns, sign-weighted aggregate
+    inputs (``sum(x)`` folds as ``sign * coalesce(x, 0)`` plus a
+    non-null counter, so DELETE is subtraction), then the WHERE filter.
+  * **partial program** — the segment-reduce of one delta batch:
+    GroupBy over the key columns summing the weighted inputs. Chained
+    device-to-device after the row program.
+  * **merge program** — per-partition partial state stacked and
+    re-grouped (sum of partial sums, min of partition minima) — the
+    same partial/final shape the DQ distributed aggregate uses, run at
+    read time.
+
+All three are plain programs through `ops/xla_exec.run_on_device`, so
+they ride the ProgramCache, persist in the progstore (a restarted
+worker folds with ``compile_ms == 0``) and land roofline rows in
+`.sys/compiled_programs` like any other program.
+
+Supported shapes (v1, checked here — anything else raises
+`UnsupportedView` and the DDL is refused): single row-store table
+source; WHERE over non-string columns; GROUP BY over scalar
+expressions (string columns as bare keys only — delta batches encode
+them through a batch-local dictionary, so no table-dictionary LUT can
+go stale); aggregates count(*)/count/sum/min/max/avg with min/max over
+bare non-string columns (exact under DELETE via per-group value
+multisets, `manager.py`); or the non-grouped filter/project case.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ydb_tpu.core import dtypes as dt
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.ops import ir
+from ydb_tpu.query import binder as B
+from ydb_tpu.sql import ast
+
+
+class UnsupportedView(ValueError):
+    """Definition shape the incremental maintainer cannot fold."""
+
+
+_AGGS = ("count", "sum", "min", "max", "avg")
+_UNSIGNED = (dt.Kind.UINT8, dt.Kind.UINT16, dt.Kind.UINT32, dt.Kind.UINT64)
+_SUMMABLE = (dt.Kind.INT8, dt.Kind.INT16, dt.Kind.INT32, dt.Kind.INT64,
+             dt.Kind.UINT8, dt.Kind.UINT16, dt.Kind.UINT32, dt.Kind.UINT64,
+             dt.Kind.FLOAT32, dt.Kind.FLOAT64)
+
+
+@dataclass
+class KeySpec:
+    out: str                       # served output column label
+    col: str                       # internal key column (__k<i>)
+    dtype: dt.DType                # served dtype (STRING kind for strings)
+    source_col: Optional[str] = None   # bare string key's source column
+
+
+@dataclass
+class AggSpec:
+    func: str                      # count_all | count | sum | min | max | avg
+    out: str                       # served output column label
+    dtype: dt.DType                # served dtype (engine agg_result_dtype)
+    n_col: Optional[str] = None    # partial: signed non-null counter
+    s_col: Optional[str] = None    # partial: signed sum
+    s_dtype: Optional[dt.DType] = None
+    m_col: Optional[str] = None    # partial: per-partition extreme (min/max)
+    arg_col: Optional[str] = None  # min/max: bare source column
+
+
+@dataclass
+class PlainItem:
+    out: str
+    dtype: dt.DType
+    col: Optional[str] = None      # row-program output column (__v<j>)
+    source_col: Optional[str] = None   # string passthrough source column
+
+
+class ViewProgram:
+    """Compiled maintenance plan for one view (see module docstring)."""
+
+    def __init__(self, name: str, source: str, kind: str, sql: str):
+        self.name = name
+        self.source = source
+        self.kind = kind               # "agg" | "plain"
+        self.sql = sql
+        self.delta_schema: Schema = None
+        self.string_cols: tuple = ()
+        self.row_program: ir.Program = None
+        self.row_schema: Schema = None
+        self.keys: list = []
+        self.aggs: list = []
+        self.items: list = []          # ("key", KeySpec) | ("agg", AggSpec)
+        self.partial_cols: list = []   # [(name, DType)] summed in partials
+        self.minmax: list = []         # AggSpecs maintained via multisets
+        self.plain_items: list = []    # PlainItems (kind == "plain")
+        self.out_schema: Schema = None
+        self.planned_bound = 0         # planner-proven group bound (0: none)
+        self._partials: dict = {}      # out_bound -> GroupBy program
+        self._merges: dict = {}
+
+    def partial_program(self, out_bound: int) -> ir.Program:
+        """Delta-batch segment-reduce (chained after the row program).
+        ``out_bound`` is the delta block capacity — sound (every group
+        holds >= 1 surviving row) and aligned with the ProgramCache's
+        capacity bucketing, so the bound costs no extra compiles."""
+        p = self._partials.get(out_bound)
+        if p is None:
+            aggs = [ir.Agg("__rows", "sum", "__sign")]
+            aggs += [ir.Agg(n, "sum", n) for (n, _d) in self.partial_cols]
+            p = ir.Program().group_by([k.col for k in self.keys], aggs,
+                                      out_bound=out_bound)
+            self._partials[out_bound] = p
+        return p
+
+    def merge_program(self, out_bound: int) -> ir.Program:
+        """Read-time merge over stacked per-partition partial state —
+        the DQ partial/final aggregate shape."""
+        p = self._merges.get(out_bound)
+        if p is None:
+            aggs = [ir.Agg("__rows", "sum", "__rows")]
+            aggs += [ir.Agg(n, "sum", n) for (n, _d) in self.partial_cols]
+            aggs += [ir.Agg(m.m_col, "min" if m.func == "min" else "max",
+                            m.m_col) for m in self.minmax]
+            p = ir.Program().group_by([k.col for k in self.keys], aggs,
+                                      out_bound=out_bound)
+            self._merges[out_bound] = p
+        return p
+
+    @property
+    def partial_schema(self) -> Schema:
+        """Per-partition partial state block (the merge program's input)."""
+        cols = [Column(k.col, k.dtype) for k in self.keys]
+        cols.append(Column("__rows", dt.DType(dt.Kind.INT64, False)))
+        cols += [Column(n, d) for (n, d) in self.partial_cols]
+        cols += [Column(m.m_col, m.dtype.with_nullable(True))
+                 for m in self.minmax]
+        return Schema(cols)
+
+
+# -- shape checks ----------------------------------------------------------
+
+
+def _walk_fields(e):
+    if e is None or not hasattr(e, "__dataclass_fields__"):
+        return
+    yield e
+    for f in e.__dataclass_fields__:
+        v = getattr(e, f)
+        for x in (v if isinstance(v, tuple) else (v,)):
+            yield from _walk_fields(x)
+
+
+def _reject_strings(e, scope: B.Scope, ctx: str) -> None:
+    """String columns fold only as bare group keys: any other use would
+    evaluate through table-dictionary LUTs, and delta batches carry
+    batch-local codes — a silent mismatch. Refuse at CREATE instead."""
+    for node in _walk_fields(e):
+        if isinstance(node, (ast.InSubquery, ast.Exists, ast.ScalarSubquery,
+                             ast.WindowFunc)):
+            raise UnsupportedView(
+                f"{ctx}: subqueries/window functions are not foldable")
+        if isinstance(node, ast.FuncCall) and node.name in B.AGG_NAMES:
+            raise UnsupportedView(
+                f"{ctx}: aggregates must be top-level select items")
+        if isinstance(node, ast.Name):
+            b = scope.try_resolve(node.parts)
+            if b is not None and b.dtype.is_string:
+                raise UnsupportedView(
+                    f"{ctx}: string column {'.'.join(node.parts)!r} is "
+                    "only supported as a bare GROUP BY key")
+
+
+def _check_shape(select: ast.Select) -> None:
+    for attr, what in (("ctes", "WITH"), ("having", "HAVING"),
+                       ("order_by", "ORDER BY"), ("limit", "LIMIT"),
+                       ("offset", "OFFSET")):
+        if getattr(select, attr):
+            raise UnsupportedView(f"{what} is not supported in a "
+                                  "materialized view definition")
+    if select.distinct:
+        raise UnsupportedView("DISTINCT is not supported in a "
+                              "materialized view definition")
+    if not isinstance(select.relation, ast.TableRef):
+        raise UnsupportedView("materialized views fold a single source "
+                              "table (no joins/subqueries yet)")
+    for it in select.items:
+        if isinstance(it.expr, ast.Star):
+            raise UnsupportedView("SELECT * is not supported; name the "
+                                  "view's columns")
+
+
+def _label(it: ast.SelectItem, idx: int, used: set) -> str:
+    if it.alias:
+        base = it.alias
+    elif isinstance(it.expr, ast.Name):
+        base = it.expr.parts[-1]
+    elif isinstance(it.expr, ast.FuncCall):
+        base = it.expr.name
+    else:
+        base = f"col{idx}"
+    lbl, k = base, 2
+    while lbl in used:
+        lbl, k = f"{base}_{k}", k + 1
+    used.add(lbl)
+    return lbl
+
+
+# -- aggregate compilation -------------------------------------------------
+
+
+def _bind_sum_input(e: ast.FuncCall, out: str, j: int, eb: B.ExprBinder,
+                    scope: B.Scope, delta_schema: Schema,
+                    prog: ir.Program, partial_cols: list) -> AggSpec:
+    arg = e.args[0]
+    _reject_strings(arg, scope, f"{e.name}()")
+    ax = eb.bind(arg)
+    adt = ir.infer_expr(ax, delta_schema)
+    if adt.kind not in _SUMMABLE:
+        raise UnsupportedView(f"{e.name}() over {adt!r} is not foldable")
+    # partial sums are SIGNED (DELETE subtracts), so unsigned inputs
+    # promote to int64 — finalize restores the engine's uint64 result
+    sx = ir.call("cast", ax, to=dt.Kind.INT64.value) \
+        if adt.kind in _UNSIGNED else ax
+    s_dtype = dt.FLOAT64 if adt.is_float else dt.DType(dt.Kind.INT64, False)
+    zero = ir.Const(0.0 if adt.is_float else 0, s_dtype.with_nullable(False))
+    n_col, s_col = f"__n{j}", f"__s{j}"
+    prog.assign(n_col, ir.call("if", ir.call("is_not_null", ax),
+                               ir.Col("__sign"),
+                               ir.Const(0, dt.DType(dt.Kind.INT64, False))))
+    prog.assign(s_col, ir.call("mul", ir.Col("__sign"),
+                               ir.call("coalesce", sx, zero)))
+    partial_cols.append((n_col, dt.DType(dt.Kind.INT64, False)))
+    partial_cols.append((s_col, s_dtype))
+    if e.name == "avg":
+        final = dt.FLOAT64
+    else:
+        final = ir.agg_result_dtype("sum", adt).with_nullable(True)
+    return AggSpec(e.name, out, final, n_col=n_col, s_col=s_col,
+                   s_dtype=s_dtype)
+
+
+def _compile_agg(e: ast.FuncCall, out: str, j: int, eb: B.ExprBinder,
+                 scope: B.Scope, delta_schema: Schema, prog: ir.Program,
+                 partial_cols: list) -> AggSpec:
+    if e.distinct:
+        raise UnsupportedView("DISTINCT aggregates are not foldable")
+    if e.name == "count" and (e.star or not e.args):
+        return AggSpec("count_all", out, dt.DType(dt.Kind.UINT64, False))
+    if not e.args:
+        raise UnsupportedView(f"{e.name}() needs an argument")
+    if e.name == "count":
+        arg = e.args[0]
+        if isinstance(arg, ast.Name) \
+                and scope.resolve(arg.parts).dtype.is_string:
+            ax = ir.Col(scope.resolve(arg.parts).internal)
+        else:
+            _reject_strings(arg, scope, "count()")
+            ax = eb.bind(arg)
+        n_col = f"__n{j}"
+        prog.assign(n_col, ir.call(
+            "if", ir.call("is_not_null", ax), ir.Col("__sign"),
+            ir.Const(0, dt.DType(dt.Kind.INT64, False))))
+        partial_cols.append((n_col, dt.DType(dt.Kind.INT64, False)))
+        return AggSpec("count", out, dt.DType(dt.Kind.UINT64, False),
+                       n_col=n_col)
+    if e.name in ("sum", "avg"):
+        return _bind_sum_input(e, out, j, eb, scope, delta_schema, prog,
+                               partial_cols)
+    # min/max: exact under DELETE needs the per-group value multiset
+    # (manager.py) — restricted to bare non-string columns so the
+    # multiset updates straight from the row images
+    arg = e.args[0]
+    if not isinstance(arg, ast.Name):
+        raise UnsupportedView(f"{e.name}() folds bare columns only")
+    b = scope.resolve(arg.parts)
+    if b.dtype.is_string:
+        raise UnsupportedView(f"{e.name}() over string columns is not "
+                              "foldable")
+    return AggSpec(e.name, out, b.dtype.with_nullable(True),
+                   m_col=f"__m{j}", arg_col=b.internal)
+
+
+# -- entry -----------------------------------------------------------------
+
+
+def compile_view(name: str, select: ast.Select, table, sql: str,
+                 planner=None) -> ViewProgram:
+    """Compile the defining SELECT against the source table's schema.
+    `planner` (optional) contributes the bounds-lattice group bound the
+    manager uses to size state capacity (rebuild escape when exceeded)."""
+    _check_shape(select)
+    rel = select.relation
+    src_alias = rel.alias or rel.name
+    schema = table.schema
+
+    scope = B.Scope()
+    for c in schema:
+        scope.add(src_alias, c.name, B.ColumnBinding(c.name, c.dtype))
+    pool = B.ParamPool("vp")
+    eb = B.ExprBinder(scope, pool)
+
+    has_agg = bool(select.group_by) or any(
+        isinstance(i.expr, ast.FuncCall) and i.expr.name in _AGGS
+        for i in select.items)
+
+    vp = ViewProgram(name, rel.name, "agg" if has_agg else "plain", sql)
+    vp.string_cols = tuple(c.name for c in schema if c.dtype.is_string)
+    # delta rows: every source column (strings as batch-local int64
+    # codes), a -1/+1 sign, and the event-order index (plain views fold
+    # per event in order; agg folds are order-free)
+    dcols = [Column(c.name, dt.DType(
+        dt.Kind.INT64 if c.dtype.is_string else c.dtype.kind, True))
+        for c in schema]
+    dcols += [Column("__sign", dt.DType(dt.Kind.INT64, False)),
+              Column("__idx", dt.DType(dt.Kind.INT64, False))]
+    vp.delta_schema = Schema(dcols)
+
+    prog = ir.Program()
+    used: set = set()
+
+    if has_agg:
+        key_exprs = []
+        for i, g in enumerate(select.group_by):
+            if isinstance(g, ast.Name):
+                b = scope.resolve(g.parts)
+                if b.dtype.is_string:
+                    vp.keys.append(KeySpec(g.parts[-1], f"__k{i}",
+                                           dt.DType(dt.Kind.STRING, True),
+                                           source_col=b.internal))
+                    key_exprs.append(ir.Col(b.internal))
+                    continue
+            _reject_strings(g, scope, "GROUP BY")
+            kx = eb.bind(g)
+            vp.keys.append(KeySpec(
+                f"k{i}", f"__k{i}",
+                ir.infer_expr(kx, vp.delta_schema).with_nullable(True)))
+            key_exprs.append(kx)
+        for ks, kx in zip(vp.keys, key_exprs):
+            prog.assign(ks.col, kx)
+
+        for idx, it in enumerate(select.items):
+            e = it.expr
+            if isinstance(e, ast.FuncCall) and e.name in _AGGS:
+                spec = _compile_agg(e, _label(it, idx, used), len(vp.aggs),
+                                    eb, scope, vp.delta_schema, prog,
+                                    vp.partial_cols)
+                vp.aggs.append(spec)
+                if spec.m_col is not None:
+                    vp.minmax.append(spec)
+                vp.items.append(("agg", spec))
+                continue
+            ki = next((i for i, g in enumerate(select.group_by) if e == g),
+                      None)
+            if ki is None:
+                raise UnsupportedView(
+                    "select items must be group keys or aggregates")
+            vp.keys[ki].out = _label(it, idx, used)
+            vp.items.append(("key", vp.keys[ki]))
+    else:
+        j = 0
+        for idx, it in enumerate(select.items):
+            e = it.expr
+            lbl = _label(it, idx, used)
+            if isinstance(e, ast.Name):
+                b = scope.resolve(e.parts)
+                if b.dtype.is_string:
+                    # identity passthrough: served straight from the row
+                    # image, no device column needed
+                    vp.plain_items.append(PlainItem(
+                        lbl, b.dtype, source_col=b.internal))
+                    continue
+            _reject_strings(e, scope, "select item")
+            vx = eb.bind(e)
+            col = f"__v{j}"
+            j += 1
+            prog.assign(col, vx)
+            vp.plain_items.append(PlainItem(
+                lbl, ir.infer_expr(vx, vp.delta_schema), col=col))
+        if select.where is not None:
+            _reject_strings(select.where, scope, "WHERE")
+        prog.assign("__keep",
+                    eb.bind(select.where) if select.where is not None
+                    else ir.Const(True, dt.DType(dt.Kind.BOOL, False)))
+
+    if has_agg and select.where is not None:
+        _reject_strings(select.where, scope, "WHERE")
+        prog.filter(eb.bind(select.where))
+
+    if pool.values:
+        # a bound LUT/param snapshots table-dictionary codes at compile
+        # time — stale against every future delta batch; not foldable
+        raise UnsupportedView(
+            "definition needs runtime parameters (string LUTs) — not "
+            "foldable")
+
+    if has_agg:
+        proj = [k.col for k in vp.keys] + ["__sign"]
+        proj += [n for (n, _d) in vp.partial_cols]
+        proj += [c for c in dict.fromkeys(m.arg_col for m in vp.minmax)
+                 if c not in proj]
+        prog.project(proj)
+    else:
+        prog.project(["__idx", "__sign", "__keep"]
+                     + [p.col for p in vp.plain_items if p.col])
+    vp.row_program = prog
+    vp.row_schema = ir.infer_schema(prog, vp.delta_schema)
+
+    if has_agg:
+        vp.out_schema = Schema([Column(sp.out, sp.dtype)
+                                for (_t, sp) in vp.items])
+    else:
+        vp.out_schema = Schema([Column(p.out, p.dtype)
+                                for p in vp.plain_items])
+
+    if planner is not None and has_agg:
+        # bounds lattice: the planner's proven group bound for this query
+        # shape sizes state capacity — the manager counts a rebuild
+        # (view/rebuilds) and re-derives it when state outgrows it
+        try:
+            plan = planner.plan_select(select)
+            bounds = [getattr(p, "out_bound", 0)
+                      for p in getattr(plan, "pipelines", ())]
+            bounds.append(getattr(plan, "out_bound", 0))
+            vp.planned_bound = max((b for b in bounds if b), default=0)
+        except Exception:              # noqa: BLE001 — advisory only
+            vp.planned_bound = 0
+    return vp

@@ -1,0 +1,800 @@
+"""Materialized-view registry + maintainer.
+
+Each `MatView` is a continuous query: a consumer of its source table's
+changefeed topic that folds committed deltas into persistent aggregate
+state. The reference's change exchange ships committed DataShard effects
+to topics exactly so a consumer like this can maintain derived state
+without rescanning the source (`ydb/core/change_exchange/`); the fold
+itself is the compiled-program discipline of the serving spine — one
+row program + one partial GroupBy per delta batch, one merge GroupBy
+per read (the DQ partial/final aggregate shape across topic
+partitions), all through `ops/xla_exec` so the programs persist in the
+progstore and a restarted worker folds with ``compile_ms == 0``.
+
+Cost model: a fold is O(delta) (delta batch → device → per-key partial
+applied to a host/device-mirrored state dict), a read is O(state)
+(stack per-partition partials → merge program → finalize), never
+O(table). min/max stay exact under DELETE via per-group value
+multisets (a decrement-able extreme needs the survivors, not just the
+current extreme).
+
+Serving contract: a read drains the topic first, then serves from
+state iff the view's high-watermark plan_step is at or below the read
+snapshot — CDC emission happens inside apply *before* publish, so
+after a drain every commit visible to the snapshot is already folded.
+A snapshot the state has run ahead of (or a degraded view) falls back
+to the base query. State pairs atomically with consumed offsets in a
+host mirror (`<root>/__views/<name>.json`), so restart resumes
+exactly-once without replaying folded history.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import zlib
+from typing import Optional
+
+import numpy as np
+
+from ydb_tpu.core.block import ColumnData, HostBlock
+from ydb_tpu.core.dictionary import Dictionary
+from ydb_tpu.core import dtypes as dt
+from ydb_tpu.core.schema import Column, Schema
+from ydb_tpu.ops.device import bucket_capacity, to_device, to_host
+from ydb_tpu.ops.xla_exec import run_on_device
+from ydb_tpu.utils.metrics import GLOBAL, GLOBAL_HIST
+from ydb_tpu.views.compile import UnsupportedView, compile_view
+
+_READ_CHUNK = 4096
+_REBUILD_CHUNK = 4096
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class _NeedRebuild(Exception):
+    """Raised mid-drain when incremental folding cannot continue."""
+
+    def __init__(self, reason: str, degrade: bool = False):
+        super().__init__(reason)
+        self.reason = reason
+        self.degrade = degrade
+
+
+class _PartState:
+    """Per-topic-partition fold state (mirrors the partition's pk
+    ordering: every mutation of one row lands in one partition)."""
+
+    __slots__ = ("offset", "groups", "mmaps", "rows")
+
+    def __init__(self):
+        self.offset = 0          # next topic offset to consume
+        self.groups: dict = {}   # key tuple -> [rows, partial sums...]
+        self.mmaps: dict = {}    # minmax idx -> {key tuple: {value: count}}
+        self.rows: dict = {}     # plain views: pk tuple -> value tuple
+
+
+def _item(v):
+    return v.item() if hasattr(v, "item") else v
+
+
+class MatView:
+    def __init__(self, mgr: "ViewManager", name: str, vp, topic_name: str,
+                 auto_topic: bool):
+        self.mgr = mgr
+        self.name = name
+        self.vp = vp
+        self.topic_name = topic_name
+        self.auto_topic = auto_topic
+        self.watermark = 0       # plan_step the state is exact at
+        self.degraded = False    # permanent base-query fallback
+        self.folds = 0
+        self.rebuilds = 0
+        self._mu = threading.RLock()
+        self._serve: Optional[HostBlock] = None
+        self.parts = [_PartState() for _ in self.topic.partitions]
+        # escape threshold: the planner's proven group bound sizes the
+        # state (with headroom — dictionary growth legitimately outgrows
+        # a plan-time bound), the env cap backstops unbounded keys
+        cap = _env_int("YDB_TPU_VIEW_MAX_GROUPS", 1 << 20)
+        if vp.planned_bound:
+            cap = min(cap, max(vp.planned_bound * 8, 4096))
+        self.max_groups = cap
+
+    @property
+    def topic(self):
+        return self.mgr.engine.topics[self.topic_name]
+
+    # -- lag --------------------------------------------------------------
+
+    def lag_messages(self) -> int:
+        t = self.topic
+        return sum(max(0, t.partitions[p].end_offset - self.parts[p].offset)
+                   for p in range(len(self.parts)))
+
+    def lag_versions(self) -> int:
+        return max(0, self.mgr.engine.coordinator.last_plan_step
+                   - self.watermark)
+
+    def group_count(self) -> int:
+        if self.vp.kind == "plain":
+            return sum(len(p.rows) for p in self.parts)
+        return sum(len(p.groups) for p in self.parts)
+
+    def state_bytes(self) -> int:
+        """Rough host-mirror footprint (vectors + multisets)."""
+        if self.vp.kind == "plain":
+            width = len(self.vp.plain_items) + 1
+            return sum(len(p.rows) for p in self.parts) * width * 8
+        width = 1 + len(self.vp.partial_cols)
+        n = sum(len(p.groups) for p in self.parts) * width * 8
+        n += sum(len(m) * 16 for p in self.parts
+                 for mm in p.mmaps.values() for m in mm.values())
+        return n
+
+    # -- fold -------------------------------------------------------------
+
+    def drain(self) -> None:
+        """Consume every pending changefeed message into state. Caller
+        holds `_mu`."""
+        if self.degraded:
+            return
+        before = self.folds
+        try:
+            t = self.topic
+            for p, part in enumerate(self.parts):
+                while True:
+                    recs = t.partitions[p].read(part.offset, _READ_CHUNK)
+                    if not recs:
+                        break
+                    self._fold_batch(part, [r["data"] for r in recs])
+                    part.offset += len(recs)
+            if self.group_count() > self.max_groups:
+                raise _NeedRebuild(
+                    f"group count {self.group_count()} exceeds planned "
+                    f"bound {self.max_groups}", degrade=True)
+        except _NeedRebuild as nr:
+            self._rebuild(nr.reason, degrade=nr.degrade)
+        else:
+            if self.folds != before:
+                self.save_mirror()
+        GLOBAL.set("view/lag_versions", self.lag_versions())
+
+    def _fold_batch(self, part: _PartState, events: list) -> None:
+        vp = self.vp
+        t0 = time.perf_counter()
+        rows = []       # (image dict, sign, event position, is_new)
+        steps = 0
+        for pos, d in enumerate(events):
+            if d.get("table") != vp.source:
+                continue
+            if "old" not in d or "new" not in d:
+                # pre-image-less legacy message: can't subtract — escape
+                # to a full recompute (counted)
+                raise _NeedRebuild("changefeed message without row images")
+            if d["old"] is not None:
+                rows.append((d["old"], -1, pos, False))
+            if d["new"] is not None:
+                rows.append((d["new"], +1, pos, True))
+            steps = max(steps, int(d.get("plan_step", 0)))
+        if rows:
+            block, strdicts = self._delta_block(rows)
+            cap = bucket_capacity(block.length)
+            dev = to_device(block, cap)
+            out = run_on_device(vp.row_program, dev)
+            if vp.kind == "plain":
+                self._apply_plain(part, to_host(out), rows)
+            else:
+                if vp.minmax:
+                    self._apply_minmax(part, to_host(out), strdicts)
+                pout = run_on_device(vp.partial_program(cap), out)
+                self._apply_partials(part, to_host(pout), strdicts)
+        self.watermark = max(self.watermark, steps)
+        self.folds += 1
+        self._serve = None
+        ms = (time.perf_counter() - t0) * 1000.0
+        GLOBAL.inc("view/applied_deltas", len(events))
+        GLOBAL.inc("view/delta_rows", len(rows))
+        GLOBAL.inc("view/fold_ms", ms)
+        GLOBAL_HIST.observe("view/fold_ms", ms)
+
+    def _delta_block(self, rows: list):
+        """Delta rows → HostBlock of the view's delta schema. String
+        columns encode through a batch-local dictionary (codes live only
+        for this fold: state keys are decoded python values, so no
+        table-dictionary LUT can go stale between batches)."""
+        vp = self.vp
+        n = len(rows)
+        arrays, valids = {}, {}
+        strdicts = {}
+        src = self.mgr.engine.catalog.table(vp.source)
+        for c in src.schema:
+            vals = [img.get(c.name) for (img, _s, _p, _n) in rows]
+            if c.name in vp.string_cols:
+                dic = strdicts[c.name] = Dictionary()
+                codes = dic.encode(vals).astype(np.int64)
+                valid = codes >= 0
+                arrays[c.name] = np.where(valid, codes, 0)
+                valids[c.name] = valid
+            else:
+                valid = np.array([v is not None for v in vals], dtype=bool)
+                np_dt = vp.delta_schema.dtype(c.name).np
+                arrays[c.name] = np.array(
+                    [0 if v is None else v for v in vals], dtype=np_dt)
+                valids[c.name] = valid
+        arrays["__sign"] = np.array([s for (_i, s, _p, _n) in rows],
+                                    dtype=np.int64)
+        arrays["__idx"] = np.arange(n, dtype=np.int64)
+        return HostBlock.from_arrays(vp.delta_schema, arrays,
+                                     valids), strdicts
+
+    def _decode_key(self, host: HostBlock, i: int, strdicts: dict):
+        out = []
+        for ks in self.vp.keys:
+            cd = host.columns[ks.col]
+            if cd.valid is not None and not cd.valid[i]:
+                out.append(None)
+            elif ks.source_col is not None:
+                out.append(strdicts[ks.source_col]._values[int(cd.data[i])])
+            else:
+                out.append(_item(cd.data[i]))
+        return tuple(out)
+
+    def _apply_partials(self, part: _PartState, phost: HostBlock,
+                        strdicts: dict) -> None:
+        vp = self.vp
+        width = 1 + len(vp.partial_cols)
+        cols = [phost.columns["__rows"]] \
+            + [phost.columns[n] for (n, _d) in vp.partial_cols]
+        for i in range(phost.length):
+            key = self._decode_key(phost, i, strdicts)
+            cur = part.groups.get(key)
+            if cur is None:
+                cur = part.groups[key] = [0] * width
+            for j, cd in enumerate(cols):
+                cur[j] += _item(cd.data[i])
+            if cur[0] == 0:
+                # all inserts cancelled by deletes: the group is gone
+                # (integer row counts — exact, no float dust here)
+                del part.groups[key]
+                for mm in part.mmaps.values():
+                    mm.pop(key, None)
+
+    def _apply_minmax(self, part: _PartState, rhost: HostBlock,
+                      strdicts: dict) -> None:
+        """Maintain per-group value multisets from the surviving
+        (post-WHERE) delta rows — min/max stay exact under DELETE."""
+        signs = rhost.columns["__sign"].data
+        for j, sp in enumerate(self.vp.minmax):
+            cd = rhost.columns[sp.arg_col]
+            mm = part.mmaps.setdefault(j, {})
+            for i in range(rhost.length):
+                if cd.valid is not None and not cd.valid[i]:
+                    continue       # NULL args never enter min/max
+                key = self._decode_key(rhost, i, strdicts)
+                val = _item(cd.data[i])
+                m = mm.get(key)
+                if m is None:
+                    m = mm[key] = {}
+                c = m.get(val, 0) + int(signs[i])
+                if c:
+                    m[val] = c
+                else:
+                    m.pop(val, None)
+                    if not m:
+                        del mm[key]
+
+    def _apply_plain(self, part: _PartState, rhost: HostBlock,
+                     rows: list) -> None:
+        """Fold filter/project deltas in event order: old image retires
+        the pk, new image lands iff it passes WHERE."""
+        vp = self.vp
+        src = self.mgr.engine.catalog.table(vp.source)
+        keep_cd = rhost.columns["__keep"]
+        for i, (img, _sign, _pos, is_new) in enumerate(rows):
+            pk = tuple(img.get(k) for k in src.key_columns)
+            if not is_new:
+                part.rows.pop(pk, None)
+                continue
+            keep = bool(keep_cd.data[i]) and (
+                keep_cd.valid is None or bool(keep_cd.valid[i]))
+            if not keep:
+                part.rows.pop(pk, None)
+                continue
+            vals = []
+            for p in vp.plain_items:
+                if p.source_col is not None:
+                    vals.append(img.get(p.source_col))
+                else:
+                    cd = rhost.columns[p.col]
+                    vals.append(None if cd.valid is not None
+                                and not cd.valid[i] else _item(cd.data[i]))
+            part.rows[pk] = tuple(vals)
+
+    # -- rebuild escape ----------------------------------------------------
+
+    def _rebuild(self, reason: str, degrade: bool = False,
+                 count: bool = True) -> None:
+        """Counted full-recompute escape: drop state, reposition the
+        consumer, refold from a table snapshot (synthetic insert events
+        routed exactly like the changefeed routes, so later deltas land
+        on the same partition state). Caller holds `_mu`."""
+        if count:
+            GLOBAL.inc("view/rebuilds")
+            self.rebuilds += 1
+        self._serve = None
+        eng = self.mgr.engine
+        for part in self.parts:
+            part.groups.clear()
+            part.mmaps.clear()
+            part.rows.clear()
+        if degrade:
+            self.degraded = True
+            self.save_mirror()
+            return
+        with eng.lock:
+            # writes serialize under the engine lock: (snapshot, topic
+            # positions, row iteration) observe one consistent point
+            snap = eng.snapshot()
+            t = self.topic
+            for p, part in enumerate(self.parts):
+                recs = t.partitions[p].records
+                idx = len(recs)
+                while idx > 0 and int(recs[idx - 1]["data"].get(
+                        "plan_step", 0)) > snap.plan_step:
+                    idx -= 1
+                part.offset = idx
+            src = eng.catalog.table(self.vp.source)
+            buckets = [[] for _ in self.parts]
+            for _pk, chain in src.rows.items():
+                vals = src._visible(chain, snap)
+                if vals is None:
+                    continue
+                row = src._decode_row(vals)
+                key = tuple(row.get(k) for k in src.key_columns)
+                p = zlib.crc32(str(str(key)).encode()) % len(self.parts)
+                buckets[p].append(
+                    {"table": self.vp.source, "op": "insert", "row": row,
+                     "old": None, "new": row,
+                     "plan_step": snap.plan_step, "tx_id": 0})
+        for p, events in enumerate(buckets):
+            for i in range(0, len(events), _REBUILD_CHUNK):
+                self._fold_batch(self.parts[p],
+                                 events[i:i + _REBUILD_CHUNK])
+        self.watermark = max(self.watermark, snap.plan_step)
+        self.save_mirror()
+
+    # -- serving -----------------------------------------------------------
+
+    def serve(self, snap):
+        """(block, mode): the served state block, or (None, mode) when
+        the read must fall back to the base query."""
+        with self._mu:
+            if not self.degraded:
+                self.drain()
+            if self.degraded:
+                GLOBAL.inc("view/reads_fallback")
+                return None, "degraded"
+            if self.watermark > snap.plan_step:
+                # the state ran ahead of this snapshot (older snapshot,
+                # or an unpublished commit's deltas already folded)
+                GLOBAL.inc("view/reads_fallback")
+                return None, "fallback"
+            if self._serve is None:
+                self._serve = self._build_serve()
+            GLOBAL.inc("view/reads_state")
+            return self._serve, "state"
+
+    def peek_mode(self, snap) -> str:
+        """EXPLAIN's serving-mode probe — no fold, no state touch."""
+        if self.degraded:
+            return "degraded"
+        return "state" if self.watermark <= snap.plan_step else "fallback"
+
+    def _build_serve(self) -> HostBlock:
+        if self.vp.kind == "plain":
+            return self._serve_plain()
+        merged = self._merged_host()
+        return self._finalize(merged)
+
+    def _merged_host(self) -> HostBlock:
+        """Stack per-partition partial state and merge. Grouped views run
+        the merge GroupBy on device (the DQ partial/final shape over
+        topic partitions); the global-aggregate case is a single vector
+        add per partition, merged host-side."""
+        vp = self.vp
+        schema = vp.partial_schema
+        if not vp.keys:
+            width = 1 + len(vp.partial_cols)
+            tot = [0] * width
+            for part in self.parts:
+                vec = part.groups.get(())
+                if vec:
+                    for j in range(width):
+                        tot[j] += vec[j]
+            arrays, valids = {}, {}
+            cols = [("__rows", tot[0])] + [
+                (n, tot[1 + j]) for j, (n, _d) in enumerate(vp.partial_cols)]
+            for cname, v in cols:
+                arrays[cname] = np.array([v], dtype=schema.dtype(cname).np)
+            for j, sp in enumerate(vp.minmax):
+                vals = [min(m) if sp.func == "min" else max(m)
+                        for part in self.parts
+                        for m in [part.mmaps.get(j, {}).get(())] if m]
+                ext = None if not vals else (
+                    min(vals) if sp.func == "min" else max(vals))
+                arrays[sp.m_col] = np.array(
+                    [0 if ext is None else ext], dtype=sp.dtype.np)
+                valids[sp.m_col] = np.array([ext is not None])
+            return HostBlock.from_arrays(schema, arrays, valids)
+
+        keys, vecs, owners = [], [], []
+        for part in self.parts:
+            for key, vec in part.groups.items():
+                keys.append(key)
+                vecs.append(vec)
+                owners.append(part)
+        n = len(keys)
+        arrays, valids, dicts = {}, {}, {}
+        for i, ks in enumerate(vp.keys):
+            kv = [k[i] for k in keys]
+            if ks.dtype.is_string:
+                dic = dicts[ks.col] = Dictionary()
+                codes = dic.encode(kv).astype(np.int32)
+                if not dic._values:
+                    dic.encode([""])    # decode target for clamped NULLs
+                valid = codes >= 0
+                arrays[ks.col] = np.where(valid, codes, 0).astype(np.int32)
+                valids[ks.col] = valid
+            else:
+                valid = np.array([v is not None for v in kv], dtype=bool)
+                arrays[ks.col] = np.array(
+                    [0 if v is None else v for v in kv], dtype=ks.dtype.np)
+                valids[ks.col] = valid
+        arrays["__rows"] = np.array([v[0] for v in vecs], dtype=np.int64)
+        for j, (cname, cdt) in enumerate(vp.partial_cols):
+            arrays[cname] = np.array([v[1 + j] for v in vecs], dtype=cdt.np)
+            if cdt.nullable:
+                valids[cname] = np.ones(n, dtype=bool)
+        for j, sp in enumerate(vp.minmax):
+            exts = []
+            for key, part in zip(keys, owners):
+                m = part.mmaps.get(j, {}).get(key)
+                exts.append(None if not m else
+                            (min(m) if sp.func == "min" else max(m)))
+            valid = np.array([e is not None for e in exts], dtype=bool)
+            arrays[sp.m_col] = np.array([0 if e is None else e for e in exts],
+                                        dtype=sp.dtype.np)
+            valids[sp.m_col] = valid
+        stacked = HostBlock.from_arrays(schema, arrays, valids, dicts)
+        if n == 0:
+            return stacked
+        cap = bucket_capacity(n)
+        out = run_on_device(self.vp.merge_program(cap),
+                            to_device(stacked, cap))
+        return to_host(out)
+
+    def _finalize(self, m: HostBlock) -> HostBlock:
+        """Merged partials → the served block, with the group-by
+        engine's exact null/dtype rules (differential-tested)."""
+        vp = self.vp
+        n = m.length
+        arrays, valids, dicts = {}, {}, {}
+        for tag, sp in vp.items:
+            if tag == "key":
+                cd = m.columns[sp.col]
+                arrays[sp.out] = cd.data
+                if cd.valid is not None:
+                    valids[sp.out] = cd.valid
+                if cd.dictionary is not None:
+                    dicts[sp.out] = cd.dictionary
+                continue
+            if sp.func == "count_all":
+                arrays[sp.out] = m.columns["__rows"].data.astype(np.uint64)
+            elif sp.func == "count":
+                arrays[sp.out] = m.columns[sp.n_col].data.astype(np.uint64)
+            elif sp.func in ("sum", "avg"):
+                nn = m.columns[sp.n_col].data.astype(np.int64)
+                s = m.columns[sp.s_col].data
+                live = nn > 0
+                if sp.func == "avg":
+                    out = np.divide(s.astype(np.float64),
+                                    np.maximum(nn, 1).astype(np.float64))
+                else:
+                    out = np.where(live, s, 0).astype(sp.dtype.np)
+                arrays[sp.out] = out
+                valids[sp.out] = live
+            else:                      # min / max from merged extremes
+                cd = m.columns[sp.m_col]
+                arrays[sp.out] = cd.data.astype(sp.dtype.np)
+                valids[sp.out] = (np.ones(n, dtype=bool)
+                                  if cd.valid is None else cd.valid)
+        return HostBlock.from_arrays(vp.out_schema, arrays, valids, dicts)
+
+    def _serve_plain(self) -> HostBlock:
+        vp = self.vp
+        rows = [v for part in self.parts for v in part.rows.values()]
+        n = len(rows)
+        arrays, valids, dicts = {}, {}, {}
+        for i, p in enumerate(vp.plain_items):
+            vals = [r[i] for r in rows]
+            if p.dtype.is_string:
+                dic = dicts[p.out] = Dictionary()
+                codes = dic.encode(vals).astype(np.int32)
+                if not dic._values:
+                    dic.encode([""])    # decode target for clamped NULLs
+                valid = codes >= 0
+                arrays[p.out] = np.where(valid, codes, 0).astype(np.int32)
+                valids[p.out] = valid
+            else:
+                valid = np.array([v is not None for v in vals], dtype=bool)
+                arrays[p.out] = np.array([0 if v is None else v
+                                          for v in vals], dtype=p.dtype.np)
+                valids[p.out] = valid
+        return HostBlock.from_arrays(vp.out_schema, arrays, valids, dicts)
+
+    # -- host mirror -------------------------------------------------------
+
+    def _mirror_path(self) -> Optional[str]:
+        store = self.mgr.engine.catalog.store
+        if store is None:
+            return None
+        return os.path.join(store.root, "__views", f"{self.name}.json")
+
+    def save_mirror(self) -> None:
+        path = self._mirror_path()
+        if path is None:
+            return
+        from ydb_tpu.storage.persist import _atomic_json
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        parts = []
+        for part in self.parts:
+            parts.append({
+                "offset": part.offset,
+                "groups": [[list(k), list(v)]
+                           for k, v in part.groups.items()],
+                "mmaps": {str(j): [[list(k), [[v, c] for v, c in m.items()]]
+                                   for k, m in mm.items()]
+                          for j, mm in part.mmaps.items()},
+                "rows": [[list(k), list(v)] for k, v in part.rows.items()],
+            })
+        _atomic_json(path, {
+            "watermark": self.watermark, "degraded": self.degraded,
+            "folds": self.folds, "rebuilds": self.rebuilds, "parts": parts})
+
+    def load_mirror(self) -> bool:
+        """Restore (state, offsets) atomically from the host mirror;
+        False → caller rebuilds from a table snapshot."""
+        path = self._mirror_path()
+        if path is None or not os.path.exists(path):
+            return False
+        import json
+        with open(path) as f:
+            m = json.load(f)
+        if len(m.get("parts", [])) != len(self.parts):
+            return False               # partition layout changed
+        self.watermark = int(m["watermark"])
+        self.degraded = bool(m.get("degraded", False))
+        self.folds = int(m.get("folds", 0))
+        self.rebuilds = int(m.get("rebuilds", 0))
+        for part, pm in zip(self.parts, m["parts"]):
+            part.offset = int(pm["offset"])
+            part.groups = {tuple(k): list(v) for k, v in pm["groups"]}
+            part.mmaps = {
+                int(j): {tuple(k): {_mm_key(v): c for v, c in pairs}
+                         for k, pairs in entries}
+                for j, entries in pm.get("mmaps", {}).items()}
+            part.rows = {tuple(k): tuple(v) for k, v in pm.get("rows", [])}
+        return True
+
+    def free(self) -> None:
+        """DROP: forget state and the mirror."""
+        with self._mu:
+            for part in self.parts:
+                part.groups.clear()
+                part.mmaps.clear()
+                part.rows.clear()
+            self._serve = None
+            path = self._mirror_path()
+            if path is not None and os.path.exists(path):
+                os.remove(path)
+
+
+def _mm_key(v):
+    # JSON round-trips int-valued floats as-is; keys came from row
+    # images, so the stored type is already the source type
+    return v
+
+
+class ViewManager:
+    """The engine's view registry: DDL, commit-time fold scheduling,
+    serving lookups, durability (views.json + per-view mirrors)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.views: dict = {}            # name -> MatView
+        self._by_source: dict = {}       # table -> [view names]
+        self.fold_batch = _env_int("YDB_TPU_VIEW_FOLD_BATCH", 256)
+
+    # -- registry ----------------------------------------------------------
+
+    def has(self, name: str) -> bool:
+        return name in self.views
+
+    def get(self, name: str) -> Optional[MatView]:
+        return self.views.get(name)
+
+    def on_table(self, table: str) -> list:
+        return [self.views[n] for n in self._by_source.get(table, ())
+                if n in self.views]
+
+    # -- DDL ---------------------------------------------------------------
+
+    def create(self, name: str, select, sql: str) -> MatView:
+        from ydb_tpu.query.engine import QueryError
+        eng = self.engine
+        if name in self.views:
+            raise QueryError(f"materialized view {name!r} already exists")
+        if eng.catalog.has(name):
+            raise QueryError(f"{name!r} already names a table")
+        rel = getattr(select, "relation", None)
+        import ydb_tpu.sql.ast as ast
+        if not isinstance(rel, ast.TableRef) or not eng.catalog.has(rel.name):
+            raise UnsupportedView(
+                "materialized views fold a single existing source table")
+        src = eng.catalog.table(rel.name)
+        if getattr(src, "store_kind", "column") != "row":
+            raise UnsupportedView(
+                "materialized views need a row-store source (CDC)")
+        vp = compile_view(name, select, src, sql, planner=eng.planner)
+
+        topic_name = eng._changefeeds.get(rel.name)
+        auto = topic_name is None
+        if auto:
+            topic_name = f"__cdc_{rel.name}"
+            if topic_name not in eng.topics:
+                eng.create_topic(topic_name, partitions=2)
+            eng.enable_changefeed(rel.name, topic_name)
+        view = MatView(self, name, vp, topic_name, auto)
+        with view._mu:
+            # initial population is a load, not a counted escape
+            view._rebuild("initial population", count=False)
+        self.views[name] = view
+        self._by_source.setdefault(rel.name, []).append(name)
+        self._persist()
+        GLOBAL.set("view/registered", len(self.views))
+        return view
+
+    def drop(self, name: str, if_exists: bool = False) -> bool:
+        from ydb_tpu.query.engine import QueryError
+        view = self.views.pop(name, None)
+        if view is None:
+            if if_exists:
+                return False
+            raise QueryError(f"unknown materialized view {name!r}")
+        src = view.vp.source
+        names = self._by_source.get(src, [])
+        if name in names:
+            names.remove(name)
+        if not names:
+            self._by_source.pop(src, None)
+        view.free()
+        eng = self.engine
+        shared = any(v.topic_name == view.topic_name
+                     for v in self.views.values())
+        if view.auto_topic and not shared:
+            # unsubscribe: unwire the changefeed we created, then drop
+            # its topic (drop_topic refuses while the feed is wired)
+            with eng.lock:
+                if eng._changefeeds.get(src) == view.topic_name:
+                    t = eng.catalog.table(src) if eng.catalog.has(src) \
+                        else None
+                    if t is not None:
+                        t.changefeed = None
+                    eng._changefeeds.pop(src, None)
+                    eng._cdc_since.pop(src, None)
+                    eng._save_topics()
+                if view.topic_name in eng.topics:
+                    eng.drop_topic(view.topic_name)
+        self._persist()
+        GLOBAL.set("view/registered", len(self.views))
+        return True
+
+    def drop_for_table(self, table: str) -> None:
+        for v in list(self.on_table(table)):
+            self.drop(v.name)
+
+    # -- commit hook -------------------------------------------------------
+
+    def on_commit(self, table: str) -> None:
+        """Fold when a source's lag crosses the batch threshold, so the
+        read path drains at most one small tail. Non-blocking: if a
+        reader holds the view lock it is folding already."""
+        names = self._by_source.get(table)
+        if not names:
+            return
+        for n in list(names):
+            v = self.views.get(n)
+            if v is None or v.degraded:
+                continue
+            if v.lag_messages() >= self.fold_batch:
+                if v._mu.acquire(blocking=False):
+                    try:
+                        v.drain()
+                    finally:
+                        v._mu.release()
+            GLOBAL.set("view/lag_versions", v.lag_versions())
+
+    # -- durability --------------------------------------------------------
+
+    def _persist(self) -> None:
+        store = self.engine.catalog.store
+        if store is None:
+            return
+        from ydb_tpu.storage.persist import _atomic_json
+        _atomic_json(
+            os.path.join(store.root, "views.json"),
+            {n: {"sql": v.vp.sql, "source": v.vp.source,
+                 "topic": v.topic_name, "auto_topic": v.auto_topic}
+             for n, v in self.views.items()})
+
+    def load(self) -> None:
+        """Restart: recompile each view from its defining SQL, restore
+        (state, offsets) from the host mirror, drain what landed while
+        down. Fold programs come back from the progstore — zero
+        recompiles. Missing/stale mirror → counted rebuild."""
+        store = self.engine.catalog.store
+        if store is None:
+            return
+        path = os.path.join(store.root, "views.json")
+        if not os.path.exists(path):
+            return
+        import json
+        from ydb_tpu.sql.parser import parse
+        with open(path) as f:
+            meta = json.load(f)
+        for name, vm in meta.items():
+            src_name = vm["source"]
+            if not self.engine.catalog.has(src_name) \
+                    or vm["topic"] not in self.engine.topics:
+                continue
+            src = self.engine.catalog.table(src_name)
+            try:
+                vp = compile_view(name, parse(vm["sql"]), src, vm["sql"],
+                                  planner=self.engine.planner)
+            except UnsupportedView:
+                continue
+            view = MatView(self, name, vp, vm["topic"],
+                           bool(vm.get("auto_topic")))
+            with view._mu:
+                if view.load_mirror():
+                    view.drain()
+                else:
+                    view._rebuild("missing host mirror")
+            self.views[name] = view
+            self._by_source.setdefault(src_name, []).append(name)
+        GLOBAL.set("view/registered", len(self.views))
+
+    # -- observability -----------------------------------------------------
+
+    def sysview_rows(self) -> list:
+        out = []
+        step = self.engine.coordinator.last_plan_step
+        for name in sorted(self.views):
+            v = self.views[name]
+            out.append({
+                "name": name, "source": v.vp.source, "kind": v.vp.kind,
+                "topic": v.topic_name, "watermark_step": v.watermark,
+                "lag_versions": max(0, step - v.watermark),
+                "state_rows": v.group_count(),
+                "state_bytes": v.state_bytes(),
+                "folds": v.folds, "rebuilds": v.rebuilds,
+                "degraded": v.degraded,
+            })
+        return out

@@ -14,7 +14,16 @@ numbers into it — see README "Benchmarks").
 The `--gate` mode is the CI leg (`scripts/ci.sh`): it compares the
 NEWEST history entry against last-known-good and fails on a >25%
 geomean regression for any suite present in both, naming the offending
-queries (per-query wall >25% over its last-good number). A missing
+queries (per-query wall >25% over its last-good number). Two per-query
+pins bite on their own, geomean notwithstanding: WATCHED queries
+(`BENCH_GATE_WATCH`, default q7,q9 — the late-materialization wins on
+the join-heavy tail) fail the gate when their own wall regresses, and
+any query whose `host_lane_ms` (the speed-gap ledger's non-device
+critical-path ms, stamped into entries since round 18 as
+[ms, % of wall]) exceeds `BENCH_GATE_HOST_LANE_MS` (default 120 —
+q12's folded 205 ms portioned residue must not regrow) while also
+DOMINATING its wall (≥ `BENCH_GATE_HOST_LANE_PCT`, default 20%)
+fails it too. A missing
 ledger fails loudly — the trajectory is a committed artifact, not an
 optional nicety. Runs with no comparable suites (e.g. a wedged run
 that completed nothing) pass with a stamped verdict: the platform
@@ -48,6 +57,21 @@ REGRESSION = float(os.environ.get("BENCH_GATE_REGRESSION", "1.25"))
 # padded/live on ICI segment frames (count-sized segments; the legacy
 # 2x path measured ~3.25x)
 PAD_CEILING = float(os.environ.get("BENCH_GATE_PAD_CEILING", "1.3"))
+# watched queries: a per-query regression on one of these fails the
+# gate outright, geomean notwithstanding — the late-materialization
+# win on the join-heavy tail (q7/q9) must not quietly erode behind a
+# geomean carried by the cheap queries
+WATCHED = tuple(q for q in os.environ.get(
+    "BENCH_GATE_WATCH", "q7,q9").split(",") if q)
+# statement-interior host-residue ceiling (crit/host_lane_ms): the
+# speed-gap table's non-device critical-path ms per query. q12 (205 ms
+# portioned residue) and q4 (104 ms) were folded into the fused
+# program; any query re-growing a host lane past this bound fails even
+# while its wall still looks survivable
+HOST_LANE_MS = float(os.environ.get("BENCH_GATE_HOST_LANE_MS", "120"))
+# ...and the share of its wall the lane must hold to count as a residue
+# CLASS rather than scheduler jitter (see gate())
+HOST_LANE_PCT = float(os.environ.get("BENCH_GATE_HOST_LANE_PCT", "20"))
 _PROC_T0 = time.time()
 
 
@@ -114,6 +138,20 @@ def entry_from_suites(suites: dict, source: str = "bench.py") -> dict:
             "per_query_ms": dict(s.get("per_query_ms") or {}),
             "fallbacks": list(s.get("fallbacks") or []),
             "utilization_geomean": s.get("utilization_geomean"),
+            # per-query statement-interior host residue (the speed-gap
+            # table's non-device critical-path ms) as [ms, % of wall] —
+            # the gate's HOST_LANE_MS ceiling reads this; the share
+            # distinguishes a host-lane-BOUND query (q12's old 205 ms
+            # portioned walk ≈ 100% of its wall) from one-off scheduler
+            # jitter on a device-bound query (a 128 ms blip on q8's
+            # 1.8 s wall is 7%, not a residue class)
+            "host_lane_ms": {
+                r["query"]: [r["non_device_ms"],
+                             round(100.0 * r["non_device_ms"]
+                                   / r["wall_ms"], 1)
+                             if r.get("wall_ms") else None]
+                for r in (s.get("speed_gap") or [])
+                if r.get("non_device_ms") is not None},
         }
     try:
         # only a multichip artifact written by THIS run (the leg runs
@@ -208,14 +246,39 @@ def gate() -> int:
                                   "ratio": round(ms / base, 2)})
         offenders.sort(key=lambda o: -o["ratio"])
         regressed = ratio > REGRESSION
+        # watched queries: their per-query walls fail the gate on their
+        # own — a join-heavy-tail regression must not hide behind a
+        # geomean the cheap queries carry
+        watched_bad = [o for o in offenders if o["query"] in WATCHED]
+        # host-lane ceiling: any query whose statement-interior
+        # non-device residue re-grew past the bound (entries predating
+        # the host_lane_ms field simply carry no rows to judge). A
+        # [ms, share%] pair must also show the lane DOMINATING its wall
+        # (share ≥ HOST_LANE_PCT) — the residue class this pins (q12's
+        # 205 ms portioned walk was ~100% of its wall) is a structural
+        # host lane, not one-off scheduler jitter on a device-bound
+        # query; bare-ms legacy entries judge on ms alone
+        lane_bad = []
+        for q, v in (cs.get("host_lane_ms") or {}).items():
+            ms, share = (v[0], v[1]) if isinstance(v, (list, tuple)) \
+                else (v, None)
+            if ms > HOST_LANE_MS and (share is None
+                                      or share >= HOST_LANE_PCT):
+                lane_bad.append({"query": q, "host_lane_ms": round(ms, 1),
+                                 "share_pct": share,
+                                 "ceiling_ms": HOST_LANE_MS})
+        lane_bad.sort(key=lambda o: -o["host_lane_ms"])
         out["suites"][key] = {
             "geomean_ms": round(c_geo, 1),
             "last_good_geomean_ms": round(lg_geo, 1),
             "ratio": round(ratio, 3),
             "offenders": offenders[:10],
-            "verdict": "REGRESSED" if regressed else "ok",
+            "watched_regressed": watched_bad,
+            "host_lane_over": lane_bad,
+            "verdict": "REGRESSED" if (regressed or watched_bad
+                                       or lane_bad) else "ok",
         }
-        if regressed:
+        if regressed or watched_bad or lane_bad:
             out["ok"] = False
     out["compared_suites"] = compared
     # wire-padding trajectory: when the candidate ran the multichip leg,

@@ -190,10 +190,27 @@ if [ "$borc" -ne 0 ]; then
     exit "$borc"
 fi
 
-echo "== bench trajectory regression gate (history vs last-known-good) =="
+echo "== late-materialization gate (row-id deferral, bound-sized compact, bytes_accessed down, lever byte-equal) =="
+# the late-mat floor: the bench join must defer its emit-only payloads
+# (counter + EXPLAIN `latemat:` lines) on the FUSED path, plan a
+# bound-sized ir.Compact (< scan capacity / 2, zero overflow reruns)
+# whose live/padded account beats the capacity-sized counterfactual
+# >=2x, move fewer cost-model bytes than the lever-off program, and
+# YDB_TPU_LATE_MAT=0 must replan + recompile byte-equal
+JAX_PLATFORMS=cpu python scripts/latemat_gate.py
+lmrc=$?
+if [ "$lmrc" -ne 0 ]; then
+    echo "late-materialization gate FAILED (rc=$lmrc)" >&2
+    exit "$lmrc"
+fi
+
+echo "== bench trajectory regression gate (history vs last-known-good, q7/q9 watched, host-lane ceiling) =="
 # the newest BENCH_HISTORY.jsonl entry must not regress any suite's
 # geomean >25% vs .bench_last_good.json (offending queries named); a
-# missing ledger fails — the trajectory is a committed artifact
+# missing ledger fails — the trajectory is a committed artifact.
+# Per-query pins bite on their own: BENCH_GATE_WATCH walls (default
+# q7,q9) and the crit/host_lane_ms ceiling (default 120 ms — q12's
+# folded portioned residue must not regrow)
 python scripts/bench_history.py --gate
 hrc=$?
 if [ "$hrc" -ne 0 ]; then

@@ -33,6 +33,12 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # force tiling at the gate's small scale: cap 32768 → 4 tiles of 8192
 TILE_ROWS = int(os.environ.get("CI_GROUPBY_TILE_ROWS", "8192"))
 os.environ["YDB_TPU_GROUPBY_TILE_ROWS"] = str(TILE_ROWS)
+# pin capacity sizing: device compaction (query/latemat.py) shrinks this
+# plan below the 4-tile scale the budgets above are calibrated to (fewer,
+# smaller gathers — good, but it makes the tile-count assertion measure
+# compact sizing instead of the tiling lowering). The compact interaction
+# has its own gate (latemat_gate.py) and differential suite.
+os.environ["YDB_TPU_LATE_MAT"] = "0"
 GATHER_BUDGET = int(os.environ.get("CI_GROUPBY_GATHER_BUDGET", "0"))
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,197 @@
+"""CI gate: late materialization moves row-ids, not bytes (CPU runner).
+
+Deterministic legs over a bench-join shape with emit-only payloads
+behind a selective filter (the q3/q10 silhouette the tentpole targets:
+payload columns referenced only by the final SELECT, a ~1/16 equality
+filter, a LIMIT tail):
+
+  * deferral live — the planner marks the emit-only build payloads
+    late-materializable (`latemat/deferred_cols` delta ≥ 1) and EXPLAIN
+    carries the `latemat:`/`(row-id)` annotations; the statement runs
+    the FUSED path (a deferral that forces portioned execution would
+    defeat the point);
+  * compaction live + bound-sized — the selective filter plans an
+    `ir.Compact` (`latemat/compact_plans` delta ≥ 1) whose chosen
+    capacity is a ladder rung STRICTLY under half the scan capacity
+    (the sizing contract: compaction only fires when it buys ≥2×), and
+    the run finishes with ZERO `latemat/compact_overflow_reruns` — the
+    estimator sized honestly on this data;
+  * bytes move less — the XLA cost model's `bytes_accessed`, summed
+    over the statement's compiled programs (`QueryStats.programs`),
+    must be LOWER with the lever on than off: payloads crossing the
+    byte-heavy middle as int32 row-ids instead of data columns is the
+    whole mechanism, and this is the metric that cannot be gamed by
+    wall-clock noise;
+  * padding account improves — the memledger's `compact` pad kind
+    (measured live rows vs the chosen rung) must beat the
+    capacity-sized counterfactual ≥2×: the same live rows scored
+    against the scan capacity every downstream op ran at before the
+    seam existed. (The GLOBAL cross-lever `pad_efficiency` is not the
+    comparison: lever-off's hash builds and capacity-sized
+    intermediates never enter the pad ledger, so compaction would be
+    punished for making previously-invisible buffers visible —
+    `bytes_accessed` is the honest cross-lever metric, the `compact`
+    kind the honest within-pipeline one);
+  * the lever — YDB_TPU_LATE_MAT=0 must replan + recompile to the
+    eager-materialization path and return byte-equal rows (the lever
+    rides the plan fingerprint and every program cache key, so
+    in-process flips cannot reuse row-id-shaped artifacts).
+
+The SF1 trajectory itself (q7/q9 watched walls, the per-query host-lane
+ceiling that keeps q12/q4 folded into the fused program) is
+`scripts/bench_history.py --gate`'s job, which ci.sh runs right after
+this gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("YDB_TPU_LATE_MAT", None)   # default-on lever
+
+import numpy as np  # noqa: E402
+import pandas as pd  # noqa: E402
+
+FACT_ROWS = 24_000
+DIM_ROWS = 3_000
+
+SQL = ("select li.lid as lid, odate, oprio from li "
+       "join ord on li.okey = ord.okey where flag = 3 "
+       "order by lid limit 100")
+
+
+def build_engine():
+    from ydb_tpu.query import QueryEngine
+    eng = QueryEngine(block_rows=1 << 20)
+    eng.execute("create table li (lid Int64 not null, okey Int64 not null, "
+                "flag Int64 not null, val Double not null, "
+                "primary key (lid)) with (store = column)")
+    eng.execute("create table ord (okey Int64 not null, "
+                "odate Int64 not null, oprio Int64 not null, "
+                "primary key (okey)) with (store = column)")
+    rng = np.random.default_rng(20260807)
+    li = pd.DataFrame({
+        "lid": np.arange(FACT_ROWS, dtype=np.int64),
+        "okey": rng.integers(0, DIM_ROWS, FACT_ROWS),
+        "flag": rng.integers(0, 16, FACT_ROWS),
+        "val": rng.normal(size=FACT_ROWS) * 100,
+    })
+    od = pd.DataFrame({
+        "okey": np.arange(DIM_ROWS, dtype=np.int64),
+        "odate": rng.integers(8000, 11000, DIM_ROWS),
+        "oprio": rng.integers(0, 5, DIM_ROWS),
+    })
+    ver = eng._next_version()
+    for name, df in (("li", li), ("ord", od)):
+        t = eng.catalog.table(name)
+        t.bulk_upsert(df, ver)
+        t.indexate()
+    return eng
+
+
+def byte_equal(a: pd.DataFrame, b: pd.DataFrame) -> bool:
+    if list(a.columns) != list(b.columns) or len(a) != len(b):
+        return False
+    for col in a.columns:
+        xa, xb = a[col].to_numpy(), b[col].to_numpy()
+        na, nb = pd.isna(xa), pd.isna(xb)
+        if not (na == nb).all() or not (xa[~na] == xb[~nb]).all():
+            return False
+    return True
+
+
+def prog_bytes(eng) -> float:
+    """Sum of the XLA cost model's bytes_accessed over the statement's
+    compiled programs — 0.0 when progstats captured nothing."""
+    pg = eng.last_stats.programs or {}
+    return sum(float(p.get("bytes_accessed") or 0.0)
+               for p in (pg.get("programs") or []))
+
+
+def main() -> int:
+    from ydb_tpu.utils.metrics import GLOBAL
+    eng = build_engine()
+
+    names = ("latemat/deferred_cols", "latemat/compact_plans",
+             "latemat/compact_overflow_reruns")
+    before = {n: GLOBAL.get(n) for n in names}
+    on_df = eng.query(SQL)
+    delta = {n: GLOBAL.get(n) - before[n] for n in names}
+    path_on = eng.executor.last_path
+    bytes_on = prog_bytes(eng)
+    pad_compact = ((eng.last_stats.memory or {}).get("pad") or {}).get(
+        "compact") or {}
+    caps = dict(eng.executor._compact_caps)
+    cap0 = max((k[3] for k in caps), default=0)  # scan capacity in the key
+
+    explain_txt = "\n".join(
+        eng.query("explain " + SQL).iloc[:, 0].astype(str))
+
+    os.environ["YDB_TPU_LATE_MAT"] = "0"
+    try:
+        off_df = eng.query(SQL)
+        path_off = eng.executor.last_path
+        bytes_off = prog_bytes(eng)
+    finally:
+        os.environ.pop("YDB_TPU_LATE_MAT", None)
+
+    report = {"deltas": delta, "path": [path_on, path_off],
+              "bytes_accessed": [bytes_on, bytes_off],
+              "pad_compact": pad_compact,
+              "compact_caps": sorted(caps.values())}
+    print(json.dumps(report), flush=True)
+
+    errs = []
+    if delta["latemat/deferred_cols"] < 1:
+        errs.append("no payload column was deferred on the bench join")
+    if "latemat:" not in explain_txt or "(row-id)" not in explain_txt:
+        errs.append("EXPLAIN lost the `latemat:`/`(row-id)` annotations")
+    if path_on != "fused":
+        errs.append(f"lever-on ran {path_on!r}, not fused — deferral "
+                    "must not forfeit the fused path")
+    if delta["latemat/compact_plans"] < 1:
+        errs.append("the selective filter planned no ir.Compact")
+    if delta["latemat/compact_overflow_reruns"]:
+        errs.append(f"{delta['latemat/compact_overflow_reruns']} overflow "
+                    "rerun(s) on honestly-estimable data — the sizing "
+                    "estimator regressed")
+    if not caps:
+        errs.append("no compact capacity was chosen (sizing declined)")
+    elif not all(0 < c < (k[3] // 2) for k, c in caps.items()):
+        errs.append(f"compact capacity not bound-sized: {sorted(caps.values())} "
+                    f"vs scan capacity {cap0} — the <cap/2 contract broke")
+    if bytes_on <= 0 or bytes_off <= 0:
+        errs.append("progstats captured no bytes_accessed — cannot verify "
+                    "the byte-movement claim")
+    elif bytes_on >= bytes_off:
+        errs.append(f"bytes_accessed did not drop: on={bytes_on:.3g} vs "
+                    f"off={bytes_off:.3g} — row-ids are not cheaper than "
+                    "payloads here")
+    if not pad_compact.get("padded_rows"):
+        errs.append("the pad ledger carries no `compact` kind — the "
+                    "seam's live/padded account went dark")
+    else:
+        eff_rung = pad_compact["live_rows"] / pad_compact["padded_rows"]
+        eff_counterfactual = (pad_compact["live_rows"] / cap0) if cap0 \
+            else 0.0
+        if not cap0 or eff_rung < 2.0 * eff_counterfactual:
+            errs.append(f"compact pad efficiency {eff_rung:.3f} does not "
+                        f"beat the capacity-sized counterfactual "
+                        f"{eff_counterfactual:.3f} by >=2x")
+    if not byte_equal(on_df, off_df):
+        errs.append("YDB_TPU_LATE_MAT=0 is not byte-equal on the bench join")
+
+    if errs:
+        for e in errs:
+            print(f"latemat gate FAILED: {e}", file=sys.stderr)
+        return 1
+    print("latemat gate ok", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,214 @@
+"""Late materialization (`query/latemat.py`, YDB_TPU_LATE_MAT): the
+differential contract and the device-compaction escape hatches.
+
+The lever moves row-ids, not bytes — deferred join payloads thread
+(row-id, match) pairs through the byte-heavy middle of a fused plan and
+materialize ONCE at the bound-sized tail; selective pipelines compact
+from scan capacity down to a ladder-quantized bound (`ir.Compact`).
+None of that may change a single output byte:
+
+  * on/off byte-equal across string payloads (dictionary remap at the
+    tail), nullable payloads (validity planes ride the row-id gather),
+    duplicate-heavy joins (the portioned path strips deferral), LIMIT
+    tails, and 0-row pipelines;
+  * a forged-low compact bound trips the LOUD full-capacity rerun
+    (`latemat/compact_overflow_reruns`) — never a silent truncation;
+  * lever flips replan + recompile (the lever rides the plan-cache
+    fingerprint and every program cache key) instead of reusing
+    shape-mismatched artifacts, and repeated runs mint no new programs
+    (the sticky compact capacity pins cache churn).
+
+All aggregated columns hold integer-valued doubles, so sums are exact
+in float64 regardless of reduction order — capacity changes between the
+two lever states cannot excuse an LSB drift.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ydb_tpu.query import QueryEngine
+from ydb_tpu.utils.metrics import GLOBAL
+
+
+@pytest.fixture(scope="module")
+def eng():
+    e = QueryEngine(block_rows=1 << 13)
+    rng = np.random.default_rng(11)
+    e.execute("create table li (id Int64 not null, k Int64 not null, "
+              "flag Int64 not null, qty Double not null, "
+              "primary key (id)) with (store = column)")
+    e.execute("create table pr (k Int64 not null, name Utf8, "
+              "cat Int64 not null, w Double not null, nv Double, "
+              "primary key (k)) with (store = column)")
+    n, m = 6000, 400
+    li = pd.DataFrame({
+        "id": np.arange(n, dtype=np.int64),
+        "k": rng.integers(0, m, n),
+        "flag": rng.integers(0, 10, n),
+        # integer-valued doubles: exact under any summation order
+        "qty": rng.integers(1, 1000, n).astype(np.float64),
+    })
+    nv = rng.integers(0, 500, m).astype(np.float64)
+    nv[::7] = np.nan                     # nullable payload column
+    pr = pd.DataFrame({
+        "k": np.arange(m, dtype=np.int64),
+        "name": np.array([f"name#{i % 37:02d}" for i in range(m)],
+                         dtype=object),
+        "cat": rng.integers(0, 9, m),    # duplicate-heavy join key
+        "w": rng.integers(1, 100, m).astype(np.float64),
+        "nv": nv,
+    })
+    ver = e._next_version()
+    for name, df in (("li", li), ("pr", pr)):
+        t = e.catalog.table(name)
+        t.bulk_upsert(df, ver)
+        t.indexate()
+    e.frames = {"li": li, "pr": pr}
+    return e
+
+
+def _byte_equal(a, b):
+    assert list(a.columns) == list(b.columns)
+    assert len(a) == len(b)
+    for col in a.columns:
+        xa, xb = a[col].to_numpy(), b[col].to_numpy()
+        na, nb = pd.isna(xa), pd.isna(xb)
+        assert (na == nb).all(), col
+        assert (xa[~na] == xb[~nb]).all(), col
+
+
+def _explain(eng, sql: str) -> str:
+    return "\n".join(eng.query("explain " + sql).iloc[:, 0].astype(str))
+
+
+# -- the YDB_TPU_LATE_MAT lever: byte-equal differential --------------------
+
+
+DIFF_QUERIES = [
+    # string + numeric emit-only payloads deferred to the LIMIT tail
+    "select li.id as id, name, w from li join pr on li.k = pr.k "
+    "where flag = 3 order by id limit 50",
+    # nullable payload: the validity plane must ride the row-id gather
+    "select li.id as id, nv from li join pr on li.k = pr.k "
+    "where flag < 2 order by id limit 100",
+    # duplicate-heavy build key (fan-out beyond capacity exercises the
+    # portioned path, which strips deferral — still byte-equal)
+    "select flag, count(*) as c, sum(w) as sw from li "
+    "join pr on li.flag = pr.cat group by flag order by flag",
+    # LEFT JOIN payload: unmatched probes must stay NULL at the tail
+    "select li.id as id, w from li left join pr "
+    "on li.k = pr.k where flag = 7 order by id limit 30",
+    # aggregation over a deferred-then-materialized payload
+    "select name, count(*) as c, sum(qty) as s from li "
+    "join pr on li.k = pr.k group by name order by name",
+    # 0-row pipeline: nothing survives, tail gathers nothing
+    "select li.id as id, name from li join pr on li.k = pr.k "
+    "where qty < 0 order by id",
+]
+
+
+@pytest.mark.parametrize("qi", range(len(DIFF_QUERIES)))
+def test_latemat_lever_byte_equal(eng, qi, monkeypatch):
+    sql = DIFF_QUERIES[qi]
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "0")
+    off = eng.query(sql)
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "1")
+    on = eng.query(sql)
+    _byte_equal(off, on)
+
+
+# -- plan surface -----------------------------------------------------------
+
+
+def test_explain_annotates_deferrals(eng, monkeypatch):
+    sql = ("select li.id as id, name, w from li join pr on li.k = pr.k "
+           "where flag = 3 order by id limit 50")
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "1")
+    txt = _explain(eng, sql)
+    assert "latemat:" in txt
+    assert "(row-id)" in txt
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "0")
+    assert "latemat:" not in _explain(eng, sql)
+
+
+def test_deferred_cols_counted(eng, monkeypatch):
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "1")
+    before = GLOBAL.get("latemat/deferred_cols")
+    eng.query("select li.id as id, name, w from li join pr "
+              "on li.k = pr.k where flag = 4 order by id limit 20")
+    assert GLOBAL.get("latemat/deferred_cols") > before
+    assert eng.executor.last_path == "fused"
+
+
+# -- device compaction ------------------------------------------------------
+
+
+def test_selective_filter_compacts(eng, monkeypatch):
+    """An equality filter the CBO estimates at ~1/10 shrinks the
+    pipeline from scan capacity to a ladder rung (counter-visible), and
+    the compacted result matches the lever-off bytes."""
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "0")
+    sql = ("select li.id as id, qty from li join pr on li.k = pr.k "
+           "where flag = 5 order by id")
+    off = eng.query(sql)
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "1")
+    before = GLOBAL.get("latemat/compact_plans")
+    on = eng.query(sql)
+    assert GLOBAL.get("latemat/compact_plans") > before
+    assert GLOBAL.get("latemat/compact_capacity_rows") > 0
+    _byte_equal(off, on)
+
+
+def test_forged_low_bound_reruns_loudly(eng, monkeypatch):
+    """A compact capacity forged BELOW the live row count must trip the
+    device overflow flag and rerun at full capacity — the result is
+    complete, the rerun is counted, truncation is never served."""
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "0")
+    sql = ("select li.k as k, count(*) as c, sum(qty) as s from li "
+           "join pr on li.k = pr.k group by li.k order by k")
+    off = eng.query(sql)                 # ~6000 live rows pre-group
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "1")
+    monkeypatch.setattr(eng.executor, "_compact_sizing",
+                        lambda *a, **k: 2048)
+    before = GLOBAL.get("latemat/compact_overflow_reruns")
+    on = eng.query(sql)
+    assert GLOBAL.get("latemat/compact_overflow_reruns") == before + 1
+    _byte_equal(off, on)
+    # the measured-live memo taught the sizing: a rerun at honest
+    # capacity leaves live counts >= the forged bound behind
+    assert max(eng.executor._compact_memo.values(), default=0) > 2048
+
+
+def test_zero_row_pipeline_compacts_to_floor(eng, monkeypatch):
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "1")
+    before = GLOBAL.get("latemat/compact_overflow_reruns")
+    got = eng.query("select li.id as id, name from li join pr "
+                    "on li.k = pr.k where qty < 0 order by id")
+    assert len(got) == 0
+    assert GLOBAL.get("latemat/compact_overflow_reruns") == before
+
+
+# -- program-cache churn ----------------------------------------------------
+
+
+def test_repeat_runs_mint_no_new_programs(eng, monkeypatch):
+    """The sticky compact capacity + ladder quantization pin cache
+    churn: re-running a compacted statement reuses the compiled
+    program, and a lever flip mints exactly one program per state."""
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "1")
+    sql = ("select li.id as id, w from li join pr on li.k = pr.k "
+           "where flag = 6 order by id limit 25")
+    eng.query(sql)
+    n0 = len(eng.executor._fused_cache)
+    for _ in range(3):
+        eng.query(sql)
+    assert len(eng.executor._fused_cache) == n0
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "0")
+    eng.query(sql)
+    n_off = len(eng.executor._fused_cache)
+    assert n_off >= n0          # the off-state program is its own entry
+    monkeypatch.setenv("YDB_TPU_LATE_MAT", "1")
+    eng.query(sql)
+    assert len(eng.executor._fused_cache) == n_off, \
+        "lever flip back must reuse the on-state program"

@@ -471,6 +471,24 @@ def _build_counts_fn(ndev: int, cap: int):
     return jax.jit(counts)
 
 
+def _build_counts_batched_fn(ndev: int, nch: int, cap: int):
+    """The stage-level twin of `_build_counts_fn`: ONE fused program
+    over EVERY hash-shuffle edge's bucket plane (`[nch, ndev, cap]`,
+    planes padded to the widest capacity with -1 — pad rows route
+    nowhere), so a stage with several outgoing edges pays ONE host
+    round trip for all its sizing messages instead of one per channel
+    (ROADMAP 1c)."""
+    import jax
+    import jax.numpy as jnp
+
+    def counts(buckets):                     # [nch, ndev, cap]
+        return jnp.stack(
+            [jnp.sum(buckets == d, axis=2) for d in range(ndev)],
+            axis=2).astype(jnp.int32)        # [nch, ndev, ndev]
+
+    return jax.jit(counts)
+
+
 def _device_specs(ch, blocks, columns):
     """One (codec_tag, numpy dtype) per column, decided over every
     producer SCHEMA (no pandas, no sync) — the planned twin of
@@ -554,15 +572,83 @@ def exchange_blocks(ch, blocks: list, key_kind: str = None,
     Returns `(out_blocks, stats)`; raises `IciPlaneError` when the edge
     cannot run device-resident (the runner falls back to the host
     plane)."""
+    st = _prepare_exchange(ch, blocks, key_kind, counters)
+    counts_host, ce_bytes = None, 0
+    if st["bucket"] is not None:
+        counts_host = _exchange_counts(st)
+        ce_bytes = st["ndev"] * st["ndev"] * 4
+    return _finish_exchange(st, counts_host, ce_bytes, counters)
+
+
+def exchange_blocks_batched(chans: list, blocks: list, key_kinds=None,
+                            counters=None) -> list:
+    """Stage-level batched count exchange (ROADMAP 1c): prepare EVERY
+    outgoing ICI edge of the stage, ship ALL their sizing counts as ONE
+    fused program + ONE `[nch, ndev, ndev]` device_get — one host round
+    trip per STAGE instead of one per channel — then finish each
+    collective with its own counts slice. Bucket planes pad to the
+    widest channel's capacity with -1, and pad rows route nowhere, so
+    each slice equals the channel's solo counts exactly. Broadcast
+    edges need no counts and ride along untouched; a stage with at most
+    one shuffle edge degenerates to the solo exchange. Any preparation
+    failure raises `IciPlaneError` for the WHOLE stage (the runner's
+    host-plane fallback re-runs every edge).
+
+    Returns `[(out_blocks, stats)]` in channel order."""
     import jax
     import jax.numpy as jnp
 
-    from ydb_tpu.core.schema import Column, Schema
-    from ydb_tpu.dq.graph import BROADCAST, HASH_SHUFFLE
-    from ydb_tpu.ops.device import (DeviceBlock, DeviceStageBlock,
-                                    to_device)
-    from ydb_tpu.progstore.buckets import bucket_segment
     from ydb_tpu.utils import memledger
+
+    kks = list(key_kinds) if key_kinds is not None \
+        else [None] * len(chans)
+    sts = [_prepare_exchange(ch, blocks, kk, counters)
+           for ch, kk in zip(chans, kks)]
+    shuf = [st for st in sts if st["bucket"] is not None]
+    if len(shuf) > 1:
+        ndev = shuf[0]["ndev"]
+        capmax = max(st["cap"] for st in shuf)
+        planes = [st["bucket"] if st["cap"] == capmax else jnp.pad(
+            st["bucket"], ((0, 0), (0, capmax - st["cap"])),
+            constant_values=-1) for st in shuf]
+        csig = ("counts_batched", ndev, len(shuf), capmax)
+        # lint: allow-cache-key(batched counts depend only on the geometry (ndev, nch, cap) — no tuning lever feeds them)
+        cfn = _FNS.get(csig)
+        if cfn is None:
+            cfn = _FNS[csig] = _build_counts_batched_fn(
+                ndev, len(shuf), capmax)
+        all_counts = jax.device_get(cfn(jnp.stack(planes)))
+        memledger.record_transfer(
+            "dq/ici.py::count_exchange_batched",
+            len(shuf) * ndev * ndev * 4, boundary=True)
+        if counters is not None:
+            counters.inc("dq/count_exchange_batched")
+        for st, cm in zip(shuf, all_counts):
+            st["_counts"] = cm           # already host numpy (device_get)
+    elif shuf:
+        shuf[0]["_counts"] = _exchange_counts(shuf[0])
+    out = []
+    for st in sts:
+        ce = st["ndev"] * st["ndev"] * 4 \
+            if st["bucket"] is not None else 0
+        out.append(_finish_exchange(st, st.pop("_counts", None), ce,
+                                    counters))
+    return out
+
+
+def _prepare_exchange(ch, blocks: list, key_kind: str = None,
+                      counters=None) -> dict:
+    """Upload/align every producer's buffers and compute the hash-
+    shuffle bucket plane — everything `exchange_blocks` does BEFORE the
+    count exchange. Split out so the stage-level batched count exchange
+    (`exchange_blocks_batched`) prepares every edge once and the SAME
+    code computes both the solo and the batched routing — the two can
+    never drift."""
+    import jax.numpy as jnp
+
+    from ydb_tpu.dq.graph import BROADCAST, HASH_SHUFFLE
+    from ydb_tpu.ops.device import DeviceStageBlock, to_device
+    from ydb_tpu.progstore.buckets import bucket_segment
     from ydb_tpu.utils.hashing import splitmix64
 
     ndev = len(blocks)
@@ -662,7 +748,7 @@ def exchange_blocks(ch, blocks: list, key_kind: str = None,
 
     names = tuple(columns)
     dt_sig = tuple((c, specs[c][0], str(specs[c][1])) for c in names)
-    ce_bytes = 0
+    bucket = None
     if ch.kind == HASH_SHUFFLE:
         key = ch.key
         if not key or key not in columns:
@@ -696,18 +782,56 @@ def exchange_blocks(ch, blocks: list, key_kind: str = None,
         active = (idx_row < lengths_col) & valids[key]
         bucket = jnp.where(active, bucket, jnp.int32(-1))
 
-        csig = ("counts", ndev, cap)
-        # lint: allow-cache-key(the counts program depends only on (ndev, cap) — no tuning lever feeds it)
-        cfn = _FNS.get(csig)
-        if cfn is None:
-            cfn = _FNS[csig] = _build_counts_fn(ndev, cap)
-        # the count exchange: the planned path's ONE host round trip —
-        # ndev^2 int32, counted as the blessed sizing message (the
-        # legacy row-plane device_get disappears entirely)
-        counts_host = jax.device_get(cfn(bucket))
-        ce_bytes = ndev * ndev * 4
-        memledger.record_transfer("dq/ici.py::count_exchange", ce_bytes,
-                                  boundary=True)
+    return {
+        "ch": ch, "blocks": blocks, "mesh": mesh, "ndev": ndev,
+        "columns": columns, "specs": specs, "quant_names": quant_names,
+        "refused": refused, "cap": cap, "lengths": lengths,
+        "arrays": arrays, "valids": valids, "unions": unions,
+        "names": names, "dt_sig": dt_sig, "bucket": bucket,
+        "masked": {c: _masked(c) for c in columns},
+    }
+
+
+def _exchange_counts(st: dict):
+    """The solo count exchange for ONE prepared hash-shuffle channel:
+    the planned path's single host round trip — ndev^2 int32, counted
+    as the blessed sizing message (the legacy row-plane device_get
+    disappears entirely)."""
+    import jax
+
+    from ydb_tpu.utils import memledger
+
+    ndev, cap = st["ndev"], st["cap"]
+    csig = ("counts", ndev, cap)
+    # lint: allow-cache-key(the counts program depends only on (ndev, cap) — no tuning lever feeds it)
+    cfn = _FNS.get(csig)
+    if cfn is None:
+        cfn = _FNS[csig] = _build_counts_fn(ndev, cap)
+    counts_host = jax.device_get(cfn(st["bucket"]))
+    memledger.record_transfer("dq/ici.py::count_exchange",
+                              ndev * ndev * 4, boundary=True)
+    return counts_host
+
+
+def _finish_exchange(st: dict, counts_host, ce_bytes: int,
+                     counters=None) -> tuple:
+    """Size, compile and run the collective from prepared state plus
+    the already-exchanged sizing counts, then build the landed consumer
+    blocks and the wire/padding account. `counts_host` is None exactly
+    for broadcast edges (they gather full buffers — no sizing
+    message)."""
+    from ydb_tpu.core.schema import Column, Schema
+    from ydb_tpu.ops.device import DeviceBlock, DeviceStageBlock
+    from ydb_tpu.progstore.buckets import bucket_segment
+    from ydb_tpu.utils import memledger
+
+    ch, blocks, mesh = st["ch"], st["blocks"], st["mesh"]
+    ndev, cap = st["ndev"], st["cap"]
+    columns, specs, names = st["columns"], st["specs"], st["names"]
+    dt_sig, quant_names = st["dt_sig"], st["quant_names"]
+    arrays, valids = st["arrays"], st["valids"]
+    lengths, unions = st["lengths"], st["unions"]
+    if st["bucket"] is not None:
         max_pair = int(counts_host.max()) if counts_host.size else 0
         seg = bucket_segment(max(max_pair, 1), minimum=1)
         bound = getattr(ch, "out_bound", None)
@@ -734,7 +858,8 @@ def exchange_blocks(ch, blocks: list, key_kind: str = None,
             dtypes = {c: specs[c][1] for c in names}
             fn = _FNS[sig] = _build_shuffle_fn(
                 mesh, ndev, cap, seg, names, dtypes, tuple(quant_names))
-        out_d, out_v, _lens, _ovf = fn(arrays, valids, bucket, lengths)
+        out_d, out_v, _lens, _ovf = fn(arrays, valids, st["bucket"],
+                                       lengths)
         # _lens/_ovf are NEVER fetched: the landed totals and the
         # no-overflow verdict are host-known from the count exchange
         landed = [int(counts_host[:, d].sum()) for d in range(ndev)]
@@ -760,7 +885,7 @@ def exchange_blocks(ch, blocks: list, key_kind: str = None,
         if c in unions:
             out_dicts[c] = unions[c]
     out_schema = Schema(out_cols)
-    masked = {c: _masked(c) for c in columns}
+    masked = st["masked"]
     out_blocks = []
     for d in range(ndev):
         dev = DeviceBlock(
@@ -790,7 +915,7 @@ def exchange_blocks(ch, blocks: list, key_kind: str = None,
         "ici_frames": segs,
         "quant_bytes_saved": int(segs * seg * (exact_row - per_row)),
         "quant_cols": list(quant_names),
-        "quant_refused": list(refused),
+        "quant_refused": list(st["refused"]),
         "pad_live_bytes": live_wire,
         "pad_padded_bytes": padded_wire,
         "pad_efficiency": round(live_wire / padded_wire, 3)

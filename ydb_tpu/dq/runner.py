@@ -355,13 +355,30 @@ class DqTaskRunner:
                        "quant_bytes_saved": 0,
                        "pad_live_bytes": 0, "pad_padded_bytes": 0,
                        "count_exchange_bytes": 0})
+        kkinds = {}
         for ch in ici_chs:
             kkind = None
             for resp in by_idx.values():
                 kkind = (resp.get("ici_key_kinds") or {}).get(ch.id) \
                     or kkind
+            kkinds[ch.id] = kkind
+        batched = None
+        if planned and len(ici_chs) > 1:
+            # a multi-edge stage ships ALL its sizing counts as ONE
+            # fused program + one exchanged matrix instead of one host
+            # round trip per channel (`dq/count_exchange_batched`)
+            with self._span("ici-exchange-batched", stage=stage.id,
+                            channels=len(ici_chs)):
+                batched = ici.exchange_blocks_batched(
+                    ici_chs, blocks,
+                    key_kinds=[kkinds[ch.id] for ch in ici_chs],
+                    counters=self.counters)
+        for ci, ch in enumerate(ici_chs):
+            kkind = kkinds[ch.id]
             with self._span("ici-exchange", channel=ch.id, kind=ch.kind):
-                if planned:
+                if batched is not None:
+                    out_parts, stats = batched[ci]
+                elif planned:
                     out_parts, stats = ici.exchange_blocks(
                         ch, blocks, key_kind=kkind,
                         counters=self.counters)

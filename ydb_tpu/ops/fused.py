@@ -51,12 +51,36 @@ def apply_join_schema(schema: Schema, payload_cols: list) -> Schema:
                   + list(payload_cols))
 
 
+LM_POS = "__lmpos"                       # deferred-scan row-position column
+
+
+def _prog_refs(prog: ir.Program) -> set:
+    """Column names a program actually COMPUTES over (Assign exprs,
+    Filter preds, GroupBy keys/carries/agg args). Projection names are
+    deliberately excluded: projecting a deferred column keeps it
+    deferred (`_trace_program` passthrough) rather than forcing a
+    full-capacity gather."""
+    refs: set = set()
+    for cmd in prog.commands:
+        if isinstance(cmd, ir.Assign):
+            ir.expr_columns(cmd.expr, refs)
+        elif isinstance(cmd, ir.Filter):
+            ir.expr_columns(cmd.pred, refs)
+        elif isinstance(cmd, ir.GroupBy):
+            refs.update(cmd.keys)
+            refs.update(cmd.carry_keys)
+            refs.update(a.arg for a in cmd.aggs if a.arg is not None)
+    return refs
+
+
 def _fused_body(pipe, final_program: Optional[ir.Program],
                 scan_cols: list, K: int, CAP: int,
                 sb_valid_names: frozenset, join_metas: list,
                 rank_assigns: list, sort_spec: tuple,
                 limit: Optional[int], offset: Optional[int],
-                keep: tuple, lift_limit: bool = False):
+                keep: tuple, lift_limit: bool = False,
+                late_scan: frozenset = frozenset(),
+                compact_prog: Optional[ir.Program] = None):
     """Un-jitted trace body shared by the single-query fused program
     (`build_fused_fn`) and the multi-query batched lane
     (`build_fused_batched_fn`, which vmaps it over stacked params).
@@ -66,51 +90,135 @@ def _fused_body(pipe, final_program: Optional[ir.Program],
     clamp becomes runtime, while the output slice stays static at the
     limit's capacity bucket (identical to the baked path's bucket, so
     results are byte-equal); callers key the compiled program on the
-    bucket, and every limit inside it shares one executable."""
+    bucket, and every limit inside it shares one executable.
+
+    Late materialization (`YDB_TPU_LATE_MAT`, `xla_exec.late_mat_enabled`):
+    `late_scan` names scan columns that are NOT loaded into the row env
+    up front — a single int32 row-position column (`__lmpos`) rides the
+    pipeline instead, and each deferred column gathers from the
+    superblock at its first compute reference or at the bound-sized
+    tail. Joins whose meta carries `late` likewise thread a
+    (build row-id, match) pair (`ops/join.probe_lut_traced`) in place of
+    their payload widths. `compact_prog` (an `ir.Compact` wrapper built
+    by the executor) shrinks the working capacity to a ladder-quantized
+    bound after the joins, so deferred gathers and the partial group-by
+    run at the small shape; its live/overflow scalars come back in the
+    4th return element (the executor's loud-rerun input)."""
     lim2 = None if limit is None else limit + (offset or 0)
     layout_box: dict = {}
 
     def fn(sb, sbv, lengths, builds, params):
-        cap = K * CAP
+        cap0 = K * CAP
+        cap = cap0
+        aux: dict = {}
         env = {}
+        deferred: dict = {}              # out name -> ("scan", src) |
+        #                                  ("join", join_idx, src)
         for c in scan_cols:
-            d = sb[c.name].reshape(cap)
-            v = sbv[c.name].reshape(cap) if c.name in sb_valid_names else None
-            env[c.name] = (d, v)
+            if c.name in late_scan:
+                deferred[c.name] = ("scan", c.name)
+            else:
+                d = sb[c.name].reshape(cap0)
+                v = sbv[c.name].reshape(cap0) \
+                    if c.name in sb_valid_names else None
+                env[c.name] = (d, v)
+        if deferred:
+            env[LM_POS] = (jnp.arange(cap0, dtype=jnp.int32), None)
         sel = (jnp.arange(CAP, dtype=jnp.int32)[None, :]
-               < lengths[:, None]).reshape(cap)
-        length = jnp.int32(cap)
+               < lengths[:, None]).reshape(cap0)
+        length = jnp.int32(cap0)
         schema = Schema(list(scan_cols))
 
-        def run(prog, env, length, sel, schema, cap):
+        def helper_names() -> tuple:
+            return tuple(n for n in env if n.startswith("__lm"))
+
+        def gc():
+            # drop row-id helper columns whose deferrals are all
+            # materialized — they must not ride sorts/compresses for free
+            if not any(s[0] == "scan" for s in deferred.values()):
+                env.pop(LM_POS, None)
+            live_joins = {s[1] for s in deferred.values()
+                          if s[0] == "join"}
+            for j, m in enumerate(join_metas):
+                if m.get("late") and j not in live_joins:
+                    env.pop(m["row_col"], None)
+                    env.pop(m["found_col"], None)
+
+        def materialize(names):
+            # the deferred gather: runs at the CURRENT capacity — after a
+            # compact/limit slice that is the bound, not the scan
+            for nm in names:
+                src = deferred.pop(nm, None)
+                if src is None:
+                    continue
+                if src[0] == "scan":
+                    pos = env[LM_POS][0]
+                    d = sb[src[1]].reshape(cap0)[pos]
+                    v = (sbv[src[1]].reshape(cap0)[pos]
+                         if src[1] in sb_valid_names else None)
+                    env[nm] = (d, v)
+                else:
+                    _k, j, s = src
+                    m = join_metas[j]
+                    row = env[m["row_col"]][0]
+                    ok = env[m["found_col"]][0]
+                    pv = builds[j]["pvalid"].get(s)
+                    d = builds[j]["payload"][s][row]
+                    v = ok if pv is None else (ok & pv[row])
+                    env[nm] = (d, v)
+            gc()
+
+        def run(prog):
+            nonlocal env, length, sel, schema, cap
+            materialize(sorted(_prog_refs(prog) & set(deferred)))
             env, length, sel, schema = _trace_program(
-                prog, schema.columns, cap, env, length, params, sel=sel)
+                prog, schema.columns, cap, env, length, params, sel=sel,
+                aux=aux, passthrough=helper_names())
             if env:
                 cap = next(iter(env.values()))[0].shape[0]
-            return env, length, sel, schema, cap
+            elif sel is not None:
+                # a column-free env (count(*) plans) still changes
+                # capacity through a Compact — the mask carries it
+                cap = sel.shape[0]
+            # a GroupBy/Projection that dropped a deferred column from
+            # the schema retires its deferral (it no longer exists)
+            for nm in [n for n in deferred if not schema.has(n)]:
+                del deferred[nm]
+            gc()
 
         if pipe.pre_program is not None:
-            env, length, sel, schema, cap = run(pipe.pre_program, env,
-                                                length, sel, schema, cap)
+            run(pipe.pre_program)
         bi = 0
         for kind, step in pipe.steps:
             if kind == "join":
                 meta = join_metas[bi]
+                if meta["probe_key"] in deferred:
+                    materialize([meta["probe_key"]])
                 env, sel = probe_lut_traced(env, sel, builds[bi], meta)
+                if meta.get("late") and meta["kind"] in ("inner", "left"):
+                    for src, out in zip(meta["src_names"],
+                                        meta["payload_names"]):
+                        env.pop(out, None)   # replaced by this probe
+                        deferred[out] = ("join", bi, src)
                 bi += 1
                 schema = apply_join_schema(schema, meta["payload_cols"])
             else:
-                env, length, sel, schema, cap = run(step, env, length, sel,
-                                                    schema, cap)
+                run(step)
+        if compact_prog is not None:
+            run(compact_prog)
         if pipe.partial is not None:
-            env, length, sel, schema, cap = run(pipe.partial, env, length,
-                                                sel, schema, cap)
+            run(pipe.partial)
         if final_program is not None:
-            env, length, sel, schema, cap = run(final_program, env, length,
-                                                sel, schema, cap)
+            run(final_program)
         if sel is not None:
             env, length = compress(env, length, sel, cap)
+            sel = None
 
+        need: set = set()
+        for a in rank_assigns:
+            ir.expr_columns(a.expr, need)
+        need.update(n for (n, _asc, _nf) in sort_spec)
+        materialize(sorted(need & set(deferred)))
         for a in rank_assigns:
             env[a.name] = _eval(a.expr, env, params, cap)
         if sort_spec:
@@ -126,7 +234,16 @@ def _fused_body(pipe, final_program: Optional[ir.Program],
             out_cap = min(bucket_capacity(lim2, minimum=128), cap)
             env = {n: (d[:out_cap], v[:out_cap] if v is not None else None)
                    for n, (d, v) in env.items()}
-        out_names = [n for n in keep if n in env] or list(env.keys())
+        # the tail gather: whatever is still deferred materializes HERE,
+        # at the post-limit capacity — a LIMIT-K plan gathers its payload
+        # widths for K-bucket rows, not scan capacity
+        want = [n for n in keep if n in env or n in deferred]
+        if want:
+            materialize([n for n in want if n in deferred])
+        else:
+            materialize(sorted(deferred))
+            want = [n for n in env if not n.startswith("__lm")]
+        out_names = [n for n in want if n in env]
         groups: dict = {}
         data_layout = []
         for n in out_names:
@@ -140,7 +257,7 @@ def _fused_body(pipe, final_program: Optional[ir.Program],
         data_stacks = {k: jnp.stack(v) for k, v in groups.items()}
         valid_stack = (jnp.stack([env[n][1] for n in valid_names])
                        if valid_names else None)
-        return data_stacks, valid_stack, length
+        return data_stacks, valid_stack, length, aux
 
     return fn, layout_box
 
@@ -150,7 +267,9 @@ def build_fused_fn(pipe, final_program: Optional[ir.Program],
                    sb_valid_names: frozenset, join_metas: list,
                    rank_assigns: list, sort_spec: tuple,
                    limit: Optional[int], offset: Optional[int],
-                   keep: tuple, lift_limit: bool = False):
+                   keep: tuple, lift_limit: bool = False,
+                   late_scan: frozenset = frozenset(),
+                   compact_prog: Optional[ir.Program] = None):
     """Compile the full single-node query pipeline into one jitted fn.
 
     scan_cols: [Column] of the flattened scan env (internal names).
@@ -159,16 +278,21 @@ def build_fused_fn(pipe, final_program: Optional[ir.Program],
     schema by the probe).
 
     Returns (fn, layout_box); fn(sb, sbv, lengths, builds, params) →
-    (data_stacks {dtype: (k, cap)}, valid_stack (m, cap) | None, length).
-    Outputs are STACKED by dtype so the result crosses the link in a
-    handful of transfers instead of one per column (each device→host
-    round trip costs ~15 ms on this platform — PERF.md); `layout_box`
-    is filled at trace time with {"data": [(name, dtype_str, row)],
-    "valids": [name]} describing the stacking."""
+    (data_stacks {dtype: (k, cap)}, valid_stack (m, cap) | None, length,
+    aux) — `aux` is empty unless `compact_prog` ran (then it carries the
+    compact live count + overflow flag; the executor consumes it before
+    any result use). Outputs are STACKED by dtype so the result crosses
+    the link in a handful of transfers instead of one per column (each
+    device→host round trip costs ~15 ms on this platform — PERF.md);
+    `layout_box` is filled at trace time with
+    {"data": [(name, dtype_str, row)], "valids": [name]} describing the
+    stacking."""
     fn, layout_box = _fused_body(pipe, final_program, scan_cols, K, CAP,
                                  sb_valid_names, join_metas, rank_assigns,
                                  sort_spec, limit, offset, keep,
-                                 lift_limit=lift_limit)
+                                 lift_limit=lift_limit,
+                                 late_scan=late_scan,
+                                 compact_prog=compact_prog)
     return jax.jit(fn), layout_box
 
 
@@ -178,7 +302,8 @@ def build_fused_batched_fn(pipe, final_program: Optional[ir.Program],
                            rank_assigns: list, sort_spec: tuple,
                            limit: Optional[int], offset: Optional[int],
                            keep: tuple, param_axes: dict, axis_size: int,
-                           lift_limit: bool = False):
+                           lift_limit: bool = False,
+                           late_scan: frozenset = frozenset()):
     """The multi-query batched dispatch program: ONE executable running
     `axis_size` same-shape queries as a vmap over their stacked lifted
     params (DrJAX's mapped-over-a-fixed-program composition, arxiv
@@ -186,11 +311,14 @@ def build_fused_batched_fn(pipe, final_program: Optional[ir.Program],
     value is batch-invariant broadcast (in_axes None); only the
     per-member params carry the leading batch axis (`param_axes`:
     {name: 0 | None}). Outputs gain a leading batch axis; each client's
-    result is its slice (`fetch_fused_batch`)."""
+    result is its slice (`fetch_fused_batch`). Late materialization rides
+    the vmapped trace unchanged (row-id gathers batch like any other op);
+    the compact step stays single-query-only, so `aux` is always empty
+    here."""
     fn, layout_box = _fused_body(pipe, final_program, scan_cols, K, CAP,
                                  sb_valid_names, join_metas, rank_assigns,
                                  sort_spec, limit, offset, keep,
-                                 lift_limit=lift_limit)
+                                 lift_limit=lift_limit, late_scan=late_scan)
     batched = jax.vmap(fn, in_axes=(None, None, None, None, param_axes),
                        axis_size=axis_size)
     return jax.jit(batched), layout_box
@@ -323,7 +451,10 @@ def build_tile_fn(pipe, scan_cols: list, K: int, CAP: int,
     finalize/merge stage.
 
     fn(sb, sbv, lengths, builds, params) → (data {name}, valids {name},
-    length) — compressed (active rows at front), NOT transferred."""
+    length) — compressed (active rows at front), NOT transferred. Tiles
+    stream and merge host-side, so the late-materialization deferral is
+    stripped here (a row-id crossing a tile boundary would dangle)."""
+    join_metas = [{**m, "late": False} for m in join_metas]
 
     @jax.jit
     def fn(sb, sbv, lengths, builds, params):
@@ -392,7 +523,8 @@ def tile_cache_key(pipe, scan_cols, K, CAP, sb_valid_names, builds_sig,
 
 
 def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,
-                    sort_spec, rank_assigns, param_names, lim_key=None):
+                    sort_spec, rank_assigns, param_names, lim_key=None,
+                    compact_cap=None):
     # the plan signature carries the group-by tuning (tile rows / gather
     # batch cap / legacy flag): the cost gate for the tile count P runs
     # at trace time from (capacity, tuning), so a knob flip must compile
@@ -424,6 +556,10 @@ def fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names, builds_sig,
             ir.Program(rank_assigns).fingerprint() if rank_assigns else "",
             lim,
             tuple(n for (n, _lbl) in plan.output), tuple(param_names),
+            # ladder-quantized compact capacity: a re-sized compact is a
+            # different program; the late-mat LEVER itself rides inside
+            # groupby_tuning(), so a flip can never reuse this trace
+            ("compact", int(compact_cap or 0)),
             groupby_tuning())
 
 

@@ -131,7 +131,27 @@ class Projection:
     names: tuple                     # tuple[str, ...]
 
 
-Command = Union[Assign, Filter, GroupBy, Projection]
+@dataclass(frozen=True)
+class Compact:
+    """Shrink the working capacity to `cap` rows: selected rows compact
+    to the front (stable, like BlockCompress) and the block is SLICED to
+    the ladder-quantized `cap` (`progstore/buckets.bucket_segment`) —
+    downstream commands compile and run at the small shape instead of
+    scan capacity.
+
+    `cap` is SIZING-quality (an estimate or a lattice bound), never a
+    correctness input: the lowering emits the live count and an overflow
+    flag out-of-band (`_trace_program`'s aux box) and the executor
+    re-runs the un-compacted program LOUDLY when live > cap — truncation
+    is detected, never silent. `bound` records the pre-quantized bound
+    the planner/executor derived (documentation + structural identity).
+    Part of the structural fingerprint, so a re-sized compact recompiles.
+    """
+    cap: int
+    bound: int = 0
+
+
+Command = Union[Assign, Filter, GroupBy, Projection, Compact]
 
 
 @dataclass
@@ -156,6 +176,10 @@ class Program:
 
     def project(self, names: list[str]) -> "Program":
         self.commands.append(Projection(tuple(names)))
+        return self
+
+    def compact(self, cap: int, bound: int = 0) -> "Program":
+        self.commands.append(Compact(cap, bound))
         return self
 
     # -- structural identity (jit pattern-cache key) ----------------------
@@ -225,6 +249,8 @@ def infer_schema(program: Program, schema: Schema) -> Schema:
             cur = Schema(cols)
         elif isinstance(cmd, Projection):
             cur = cur.select(list(cmd.names))
+        elif isinstance(cmd, Compact):
+            pass                         # capacity change only — schema holds
         else:
             raise TypeError(f"bad command {cmd!r}")
     return cur

@@ -260,18 +260,29 @@ def probe_lut_traced(env: dict, sel, bt_arrays: dict, meta: dict):
     pcap = next(iter(bt_arrays["payload"].values())).shape[0] \
         if bt_arrays["payload"] else d.shape[0]
     safe = jnp.clip(idx, 0, pcap - 1)
+    # late materialization: thread the (build row-id, match) pair instead
+    # of gathering payload widths at probe capacity — the fused body
+    # gathers from `payload[...]` at the first reference (post-compact)
+    # or at the bound-sized tail (`ops/fused.py`). Selection semantics
+    # are computed identically either way.
+    late = bool(meta.get("late")) and kind in ("inner", "left")
     out_sel, gathered, gathered_valid = _select_and_gather(
         found, safe, active, v, bt_arrays["n"], kind, meta["not_in"],
-        bt_arrays["payload"], bt_arrays["pvalid"], meta["src_names"])
+        bt_arrays["payload"], bt_arrays["pvalid"],
+        () if late else meta["src_names"])
 
     if kind == "left_anti" and meta["not_in"]:
         # a NULL in the build set makes NOT IN never-true for every row
         out_sel = out_sel & ~bt_arrays["has_null"]
 
     env2 = dict(env)
-    for src, out in zip(meta["src_names"], meta["payload_names"]):
-        if src in gathered:
-            env2[out] = (gathered[src], gathered_valid[src])
+    if late:
+        env2[meta["row_col"]] = (safe.astype(jnp.int32), None)
+        env2[meta["found_col"]] = (found, None)
+    else:
+        for src, out in zip(meta["src_names"], meta["payload_names"]):
+            if src in gathered:
+                env2[out] = (gathered[src], gathered_valid[src])
     if kind == "mark":
         env2[meta["mark_col"] or "__mark"] = (found, None)
     return env2, out_sel

@@ -185,6 +185,17 @@ def run_program(program: ir.Program, block: HostBlock,
         elif isinstance(cmd, ir.Projection):
             schema = schema.select(list(cmd.names))
             cols = {nm: cols[nm] for nm in cmd.names}
+        elif isinstance(cmd, ir.Compact):
+            # the oracle is unpadded: compact just materializes the
+            # selection. `cap` is a device-sizing hint — truncating here
+            # would bake a forged bound into the truth the differential
+            # tests compare against, so the oracle never truncates.
+            if sel is not None:
+                idx = np.nonzero(sel)[0]
+                cols = {nm: (d[idx], v[idx] if v is not None else None)
+                        for nm, (d, v) in cols.items()}
+                n = len(idx)
+                sel = None
         else:
             raise TypeError(f"bad command {cmd!r}")
 

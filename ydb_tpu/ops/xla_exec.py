@@ -138,6 +138,12 @@ def groupby_tuning() -> tuple:  # lint: tuning-provider
       out_bounds) per setting, and the lever riding here puts it in
       every compiled-program cache key by construction.
 
+    * YDB_TPU_LATE_MAT — the late-materialization lever
+      (`late_mat_enabled`): fused traces thread row-id vectors instead
+      of payload columns and may carry a bound-sized `ir.Compact`;
+      riding here keys every compiled program on the lever, so a flip
+      recompiles instead of serving a deferral-shaped trace.
+
     The tuple is a component of every compiled-program cache key
     (ProgramCache, fused/tile/finalize/dist-agg keys), so flipping a knob
     recompiles instead of serving a trace built under other settings."""
@@ -151,7 +157,20 @@ def groupby_tuning() -> tuple:  # lint: tuning-provider
     tile_rows = max(_int("YDB_TPU_GROUPBY_TILE_ROWS", 1 << 22), 8)
     batch_cap = max(_int("YDB_TPU_GATHER_BATCH_CAP", 1 << 22), 0)
     legacy = os.environ.get("YDB_TPU_GROUPBY_LEGACY", "") not in ("", "0")
-    return (tile_rows, batch_cap, legacy, bounds_enabled())
+    return (tile_rows, batch_cap, legacy, bounds_enabled(),
+            late_mat_enabled())
+
+
+def late_mat_enabled() -> bool:  # lint: tuning-provider
+    """YDB_TPU_LATE_MAT — default ON. The late-materialization lever:
+    the fused path carries compact row-id vectors instead of payload
+    columns through the byte-heavy middle of a plan (probe gathers
+    defer to their first reference or to a bound-sized tail gather) and
+    compacts intermediates to ladder-quantized bounds (`ir.Compact`).
+    `=0` restores the eager-gather path byte-equal (the A/B lever for
+    `scripts/latemat_gate.py`); it rides every affected compiled-program
+    cache key via `groupby_tuning`."""
+    return os.environ.get("YDB_TPU_LATE_MAT", "") not in ("0",)
 
 
 class _TraceStats(threading.local):
@@ -603,7 +622,7 @@ def _trace_group_by_sorted(cmd: ir.GroupBy, env, schema: Schema, sel,
     value would silently drop groups, so only guaranteed sources may set
     it. Precision of csum diffs is unchanged from the legacy path (see
     `_trace_group_by_sorted_legacy`)."""
-    tile_budget, batch_cap, legacy, _bounds = groupby_tuning()
+    tile_budget, batch_cap, legacy, _bounds, _lm = groupby_tuning()
     if legacy:
         return _trace_group_by_sorted_legacy(cmd, env, schema, sel, length,
                                              cap)
@@ -799,7 +818,7 @@ def _trace_group_by_sorted_legacy(cmd: ir.GroupBy, env, schema: Schema, sel,
     for a tiny group inside a huge total the cancellation costs ~(total /
     group_sum)·1e-16 relative error — acceptable for SQL doubles and the
     test oracles' 1e-6 tolerances."""
-    tile_budget, _batch_cap, _legacy, _bounds = groupby_tuning()
+    tile_budget, _batch_cap, _legacy, _bounds, _lm = groupby_tuning()
     _t_inc("traces")
     _t_inc("tiles", 1)
     _t_max("sort_rows_max", cap)
@@ -950,10 +969,18 @@ def _trace_group_by(cmd: ir.GroupBy, env, schema: Schema, sel, length, cap):
 
 
 def _trace_program(program: ir.Program, in_schema_cols, cap, env, length,
-                   params, sel=None):
+                   params, sel=None, aux=None, passthrough=()):
     """env: name -> (data, valid|None); returns (env, length, sel, schema).
     `sel` seeds the selection mask (fused pipelines thread it between
-    programs instead of compressing)."""
+    programs instead of compressing).
+
+    `aux`: out-of-band scalar box filled by `ir.Compact` (live count +
+    overflow flag — the executor's loud-rerun input; scalars cannot ride
+    the row-shaped env). `passthrough`: helper column names (the fused
+    late-materialization row-id vectors) that survive Projections and
+    whose projected names may be ABSENT from env (deferred columns stay
+    deferred through a projection); callers that pass no passthrough
+    keep the strict behavior."""
     schema = Schema(list(in_schema_cols))
     for cmd in program.commands:
         if isinstance(cmd, ir.Assign):
@@ -976,7 +1003,21 @@ def _trace_program(program: ir.Program, in_schema_cols, cap, env, length,
             sel = None
         elif isinstance(cmd, ir.Projection):
             schema = schema.select(list(cmd.names))
-            env = {nm: env[nm] for nm in cmd.names}
+            if passthrough:
+                new_env = {nm: env[nm] for nm in cmd.names if nm in env}
+                for h in passthrough:
+                    if h in env:
+                        new_env[h] = env[h]
+                env = new_env
+            else:
+                env = {nm: env[nm] for nm in cmd.names}
+        elif isinstance(cmd, ir.Compact):
+            env, length, sel, live, ovf = compact_env(env, length, sel,
+                                                      cap, cmd.cap)
+            cap = cmd.cap
+            if aux is not None:
+                aux["compact_live"] = live
+                aux["compact_ovf"] = ovf
         else:
             raise TypeError(f"bad command {cmd!r}")
     return env, length, sel, schema
@@ -995,6 +1036,37 @@ def compress(env, length, sel, cap):
     for name, (d, v) in env.items():
         new_env[name] = (d[order], v[order] if v is not None else None)
     return new_env, new_len
+
+
+def compact_env(env, length, sel, cap, new_cap: int):
+    """`ir.Compact` lowering: stable-compress selected rows to the front
+    of a `new_cap`-sized buffer — downstream operators compile at the
+    small shape. O(cap) prefix-sum + dropping scatter, NOT an argsort:
+    each live row's target slot is its rank among live rows
+    (`cumsum - 1`), dropped/overflow rows scatter out of bounds
+    (`mode="drop"`), so the compact costs one pass over the wide
+    capacity instead of a sort of it. Returns (env', length', sel',
+    live, overflow): `live` is the true selected count and
+    `overflow = live > new_cap` — the host-side loud-rerun signal; rows
+    beyond `new_cap` ARE dropped from env', so a result produced under
+    overflow must be discarded, never served."""
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    active = (iota < length) if sel is None else ((iota < length) & sel)
+    rank = jnp.cumsum(active.astype(jnp.int32)) - 1
+    tgt = jnp.where(active, rank, jnp.int32(new_cap))   # inactive → OOB
+    live = jnp.sum(active.astype(jnp.int32))
+    ovf = live > jnp.int32(new_cap)
+
+    def _scatter(a):
+        return jnp.zeros((new_cap,), a.dtype).at[tgt].set(a, mode="drop")
+
+    new_env = {}
+    for name, (d, v) in env.items():
+        new_env[name] = (_scatter(d),
+                         _scatter(v) if v is not None else None)
+    new_len = jnp.minimum(live, jnp.int32(new_cap))
+    new_sel = jnp.arange(new_cap, dtype=jnp.int32) < new_len
+    return new_env, new_len, new_sel, live, ovf
 
 
 # --------------------------------------------------------------------------

@@ -118,26 +118,41 @@ def estimate_plan_bytes(catalog, plan, snapshot) -> int:
       * a join build reserves min(scan, proven output bound × width) —
         builds MATERIALIZE at output cardinality, so a grouped/limited/
         bounded-multiplicity build stops double-charging its driving
-        scan (the q21 class)."""
+        scan (the q21 class).
+
+    Join-payload copy term: the fused probe materializes each build
+    payload column at PROBE capacity — on q7/q9 that padded copy was the
+    difference between the 229/313 MB admitted and the 354/402 MB
+    measured peak. With late materialization (`YDB_TPU_LATE_MAT`) the
+    probe threads a 5-byte (row-id, match) pair instead, and payload
+    widths materialize once at build cardinality (bound-sized tail) —
+    the estimate charges whichever execution the lever selects."""
     import numpy as np
 
+    from ydb_tpu.ops.xla_exec import late_mat_enabled
     from ydb_tpu.query.bounds import (bounds_enabled, build_bytes_bound,
                                       scan_rows_bound)
     from ydb_tpu.utils.metrics import GLOBAL
     lattice = bounds_enabled()
+    late = late_mat_enabled()
     memo: dict = {}                    # one stats walk per plan node
 
-    def pipe_bytes(pipe) -> int:
+    def pipe_rows(pipe) -> int:
         try:
             table = catalog.table(pipe.scan.table)
         except KeyError:
             return 0
         rows = getattr(table, "num_rows", 0)
-        if not rows:
-            return 0
-        if lattice and pipe.scan.prune:
+        if rows and lattice and pipe.scan.prune:
             rows = min(rows, scan_rows_bound(catalog, pipe.scan, snapshot)
                        or rows)
+        return int(rows)
+
+    def pipe_bytes(pipe) -> int:
+        rows = pipe_rows(pipe)
+        if not rows:
+            return 0
+        table = catalog.table(pipe.scan.table)
         per_row = 0
         for (s, _i) in pipe.scan.columns:
             if not table.schema.has(s):
@@ -146,7 +161,26 @@ def estimate_plan_bytes(catalog, plan, snapshot) -> int:
             per_row += np.dtype(dt.np).itemsize + (1 if dt.nullable else 0)
         return rows * per_row
 
+    def payload_width(bp, step) -> int:
+        """Per-row bytes of the payload columns a probe attaches
+        (data + validity; unresolvable names assume a wide 9-byte
+        column — overcharging beats under-admitting)."""
+        try:
+            table = catalog.table(bp.scan.table)
+        except KeyError:
+            return 9 * len(step.payload)
+        w = 0
+        for name in step.payload:
+            if table.schema.has(name):
+                dt = table.schema.dtype(name)
+                w += np.dtype(dt.np).itemsize + 1   # probe payloads
+                #                                     are nullable-tagged
+            else:
+                w += 9                 # derived/renamed build column
+        return w
+
     total = pipe_bytes(plan.pipeline)
+    probe_rows = pipe_rows(plan.pipeline)
     for kind, step in plan.pipeline.steps:
         if kind != "join":
             continue
@@ -162,4 +196,15 @@ def estimate_plan_bytes(catalog, plan, snapshot) -> int:
                            scan_est - bb)
                 scan_est = bb
         total += scan_est
+        # the probe-time copy of this join's output columns
+        if step.kind in ("inner", "left") and step.payload:
+            width = payload_width(bp, step)
+            if late:
+                # (int32 row-id + bool match) per probe row; widths
+                # materialize once at build cardinality
+                total += probe_rows * 5 + pipe_rows(bp) * width
+            else:
+                total += probe_rows * width
+        elif step.kind == "mark":
+            total += probe_rows       # 1-byte match-flag column
     return total

@@ -120,7 +120,16 @@ def _build_key_unique_declared(step, catalog) -> bool:
     from ydb_tpu.query.plan import QueryPlan
     build = step.build
     if isinstance(build, QueryPlan):
-        # subquery build: grouped/distinct output keyed on the build key
+        # subquery build: grouped/distinct output keyed on the build key.
+        # The build key is the plan's OUTPUT label (`__s0k0`) — resolve
+        # it back to the projected internal name first, or a grouped
+        # q18-class build (group l_orderkey having sum > K) reads as
+        # non-unique just because of the rename.
+        bk = step.build_key
+        for (iname, label) in build.output:
+            if label == bk:
+                bk = iname
+                break
         progs = [build.pipeline.partial, build.final_program]
         for prog in progs:
             if prog is None:
@@ -128,12 +137,18 @@ def _build_key_unique_declared(step, catalog) -> bool:
             for cmd in prog.commands:
                 if isinstance(cmd, ir.GroupBy) and cmd.keys \
                         and len(cmd.keys) + len(cmd.carry_keys) >= 1 \
-                        and step.build_key in cmd.keys \
+                        and bk in cmd.keys \
                         and len(cmd.keys) == 1:
                     return True
         return False
     if step.build_hash_keys:
         keys = list(step.build_hash_keys)
+    elif step.build_key_cols:
+        # in-program composite hash: the synthesized `__jkNb` isn't a
+        # storage column, but the columns it was derived from are — a
+        # 64-bit hash of a unique tuple stays unique for sizing purposes
+        # (collisions are post-join-verified and overflow-rerun-guarded)
+        keys = list(step.build_key_cols)
     else:
         keys = [step.build_key]
     storage = {i: s for (s, i) in build.scan.columns}

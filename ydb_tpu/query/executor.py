@@ -159,6 +159,14 @@ class Executor:
         # with the lane off, where the same statement compiles inline
         # (guarded-by: _warm_mu)
         self._compile_debt: dict = {}
+        # bound-sized compaction (late materialization): measured live
+        # row counts per compact-free fused key, monotone max — an
+        # overflow rerun teaches every future sizing of the same shape.
+        # Plain dict: values are ints, reads/writes are GIL-atomic.
+        self._compact_memo: dict = {}
+        # chosen compact capacities per compact-free fused key — sticky
+        # so within-headroom data growth reuses the compiled program
+        self._compact_caps: dict = {}
     # DQ task-graph runtime (`ydb_tpu/dq/`): >0 while THIS THREAD is
     # running a statement as a stage program of a distributed task — the
     # worker's share of a multi-process graph, or the 1-worker degenerate
@@ -319,7 +327,8 @@ class Executor:
     # -- fused whole-query path --------------------------------------------
 
     def _try_execute_fused(self, plan: QueryPlan, params: dict,
-                           snapshot: Snapshot, defer: bool = False):
+                           snapshot: Snapshot, defer: bool = False,
+                           _no_compact: bool = False):
         """Run the query as ONE fused device program (`ops/fused.py`) when
         its shape allows: single device, joins unique-keyed where
         payloads attach (expanding duplicate-key probes need a
@@ -350,8 +359,9 @@ class Executor:
                     not bt.unique and step.kind in ("inner", "left", "mark")):
                 return builds   # partitioned / expanding probe
 
+        plan0 = plan            # pre-rewrite plan (the overflow-rerun input)
         (plan, pipe, scan_cols, schema, partial_schema, dicts,
-         join_metas) = self._fused_plan_setup(plan, builds)
+         join_metas, late_scan) = self._fused_plan_setup(plan, builds)
 
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
@@ -403,16 +413,47 @@ class Executor:
         lift_limit, lim_key = self._lift_limit_setup(plan, all_params)
 
         builds_sig = tuple(F.build_inputs_sig(bt) for bt in builds)
-        key = F.fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names,
-                                builds_sig, sort_spec, rank_assigns,
-                                tuple(sorted(all_params)), lim_key=lim_key)
+        base_key = F.fused_cache_key(plan, scan_cols, K, CAP,
+                                     sb_valid_names, builds_sig, sort_spec,
+                                     rank_assigns,
+                                     tuple(sorted(all_params)),
+                                     lim_key=lim_key)
+        # bound-sized device compaction: when the filters/joins provably
+        # collapse the live count, an `ir.Compact` shrinks the pipeline
+        # from scan capacity to a ladder-quantized bound before the
+        # partial group-by, and every deferred late-mat gather compiles
+        # at the small shape. Sized from CBO + FK selectivities plus the
+        # measured-live memo; an underestimate trips the device overflow
+        # flag in `fetch` and the statement reruns WITHOUT the compact —
+        # loud and counted, never a silent truncation.
+        compact_cap = None if _no_compact else self._compact_sizing(
+            base_key, pipe, builds, sources, K * CAP)
+        compact_prog = None
+        key = base_key
+        if compact_cap:
+            compact_prog = ir.Program([ir.Compact(compact_cap)])
+            key = F.fused_cache_key(plan, scan_cols, K, CAP,
+                                    sb_valid_names, builds_sig, sort_spec,
+                                    rank_assigns,
+                                    tuple(sorted(all_params)),
+                                    lim_key=lim_key,
+                                    compact_cap=compact_cap)
+        from ydb_tpu.utils.metrics import GLOBAL
+        ndeferred = len(late_scan) + sum(
+            len(m["payload_names"]) for m in join_metas if m["late"])
+        if ndeferred:
+            GLOBAL.inc("latemat/deferred_cols", ndeferred)
+        if compact_cap:
+            GLOBAL.inc("latemat/compact_plans")
+            GLOBAL.inc("latemat/compact_capacity_rows", compact_cap)
 
         def _builder():
             fn, layout_box = F.build_fused_fn(
                 pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
                 join_metas, rank_assigns, sort_spec, plan.limit, plan.offset,
                 tuple(dict.fromkeys(n for (n, _lbl) in plan.output)),
-                lift_limit=lift_limit)
+                lift_limit=lift_limit, late_scan=late_scan,
+                compact_prog=compact_prog)
             keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
             out_cols = [c for c in schema.columns if c.name in keep] \
                 or list(schema.columns)
@@ -452,8 +493,8 @@ class Executor:
                         (arrays, valids, lengths, build_inputs,
                          dev_params))
                 fill_wait_ms = (_time.perf_counter() - t_disp) * 1000.0
-            data_stacks, valid_stack, length = fn(arrays, valids, lengths,
-                                                  build_inputs, dev_params)
+            data_stacks, valid_stack, length, aux = fn(
+                arrays, valids, lengths, build_inputs, dev_params)
             if fresh_compile:
                 # jit compiles synchronously inside the first call of a
                 # fresh shape (AOT: in capture above); steady-state
@@ -512,6 +553,48 @@ class Executor:
             # roofline join: the measured device-execute delta against
             # this program's compiler-reported flops/bytes
             progstats.record_exec(prog_kid, exec_ms, fresh=fresh_compile)
+            if aux:
+                # compact live/overflow: 8 bytes of plan metadata the
+                # loud-rerun decision needs host-side. The program is
+                # already done executing, so these two scalars ride the
+                # result drain — part of the readout's ONE boundary
+                # transfer, not a second booked host sync
+                live, ovf = (int(x) for x in jax.device_get(
+                    (aux["compact_live"], aux["compact_ovf"])
+                ))  # lint: transfer-ok(compact overflow check — two scalars riding the result drain)
+                GLOBAL.inc("latemat/compact_live_rows", live)
+                # measured-live memo (monotone max, keyed by the compact-
+                # free program identity): future sizings of this shape
+                # never undercut an observed live count
+                prev_live = self._compact_memo.get(base_key, 0)
+                if live > prev_live:
+                    self._compact_memo[base_key] = live
+                # live/padded account for the compacted shape: measured
+                # live rows against the ladder rung every downstream op
+                # ran at (unit-width lanes — the ratio is the signal;
+                # the capacity-sized buffers this rung REPLACED never
+                # entered the ledger, so this entry is the only place
+                # the seam's padding collapse is visible)
+                memledger.record_pad("compact", live, compact_cap,
+                                     live * 8, compact_cap * 8)
+                if ovf:
+                    # the bound was forged low — rows past compact_cap
+                    # were dropped ON DEVICE. Discard this result and
+                    # rerun the statement without the compact (full
+                    # capacity), loudly counted. Never serve a truncation.
+                    GLOBAL.inc("latemat/compact_overflow_reruns")
+                    prev_cap = self.dq_device_capture
+                    self.dq_device_capture = capture_device
+                    try:
+                        redo = self._try_execute_fused(
+                            plan0, params, snapshot, _no_compact=True)
+                    finally:
+                        self.dq_device_capture = prev_cap
+                    if redo is None or isinstance(redo, (list, tuple)):
+                        raise RuntimeError(
+                            "compact overflow rerun declined the fused "
+                            "path")
+                    return redo
             if capture_device:
                 # device-resident spine: hand the stage result back as
                 # device arrays by reference — the 4-byte length scalar
@@ -694,7 +777,7 @@ class Executor:
                                                     "mark")):
                 return False
         (plan, pipe, scan_cols, schema, partial_schema, dicts,
-         join_metas) = self._fused_plan_setup(plan, builds)
+         join_metas, late_scan) = self._fused_plan_setup(plan, builds)
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
         sources, src_ids = enumerate_scan_sources(table, snapshot,
@@ -718,9 +801,26 @@ class Executor:
         all_params = {**params, **sort_params}
         lift_limit, lim_key = self._lift_limit_setup(plan, all_params)
         builds_sig = tuple(F.build_inputs_sig(bt) for bt in builds)
-        key = F.fused_cache_key(plan, scan_cols, K, CAP, sb_valid_names,
-                                builds_sig, sort_spec, rank_assigns,
-                                tuple(sorted(all_params)), lim_key=lim_key)
+        base_key = F.fused_cache_key(plan, scan_cols, K, CAP,
+                                     sb_valid_names, builds_sig, sort_spec,
+                                     rank_assigns,
+                                     tuple(sorted(all_params)),
+                                     lim_key=lim_key)
+        # MUST mirror the dispatch path's compact sizing exactly — a
+        # warm on a different capacity would compile a program the
+        # dispatch never asks for
+        compact_cap = self._compact_sizing(base_key, pipe, builds,
+                                           sources, K * CAP)
+        compact_prog = None
+        key = base_key
+        if compact_cap:
+            compact_prog = ir.Program([ir.Compact(compact_cap)])
+            key = F.fused_cache_key(plan, scan_cols, K, CAP,
+                                    sb_valid_names, builds_sig, sort_spec,
+                                    rank_assigns,
+                                    tuple(sorted(all_params)),
+                                    lim_key=lim_key,
+                                    compact_cap=compact_cap)
         if key in self._fused_cache:
             return False                 # already live — nothing to warm
 
@@ -729,7 +829,8 @@ class Executor:
                 pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
                 join_metas, rank_assigns, sort_spec, plan.limit, plan.offset,
                 tuple(dict.fromkeys(n for (n, _lbl) in plan.output)),
-                lift_limit=lift_limit)
+                lift_limit=lift_limit, late_scan=late_scan,
+                compact_prog=compact_prog)
             keep = list(dict.fromkeys(n for (n, _lbl) in plan.output))
             out_cols = [c for c in schema.columns if c.name in keep] \
                 or list(schema.columns)
@@ -777,11 +878,16 @@ class Executor:
         metas (incl. the LUT-vs-bsearch probe choice per build) and
         landing on the final schema, plus the join-derived group-bound
         rewrite. Returns (plan, pipe, scan_cols, schema, partial_schema,
-        dicts, join_metas) — plan/pipe possibly rewritten (copies; a
-        cached plan is never mutated)."""
+        dicts, join_metas, late_scan) — plan/pipe possibly rewritten
+        (copies; a cached plan is never mutated); `late_scan` is the set
+        of scan columns the fused body defers behind a row-position
+        column (query/latemat.py), empty when the lever is off."""
         from ydb_tpu.core.dtypes import DType, Kind as _K
         from ydb_tpu.ops import fused as F
+        from ydb_tpu.ops.xla_exec import late_mat_enabled
+        from ydb_tpu.query import latemat
 
+        late = late_mat_enabled()
         pipe = plan.pipeline
         table = self.catalog.table(pipe.scan.table)
         scan_cols = [Column(i, table.schema.dtype(s))
@@ -822,6 +928,14 @@ class Executor:
                 "bsearch": bt.lut is None
                 or schema.dtype(step.probe_key).kind in (_K.FLOAT64,
                                                          _K.FLOAT32),
+                # late materialization: inner/left payloads ride as a
+                # (build row-id, match) pair and gather at first compute
+                # reference or the bound-sized tail; semi/anti/mark
+                # produce no payloads to defer
+                "late": late and step.kind in ("inner", "left")
+                and bool(bt.schema.names),
+                "row_col": f"__lmr{bi - 1}",
+                "found_col": f"__lmf{bi - 1}",
             })
             schema = F.apply_join_schema(schema, payload_cols)
         if pipe.partial is not None:
@@ -835,8 +949,10 @@ class Executor:
         # sorted group-by with the proven bound so per-group gathers run
         # at output cardinality (the q3/q9/q13 late-materialization win)
         plan, pipe = self._bounded_groupby_rewrite(plan, builds, join_metas)
+        late_scan = latemat.deferrable_scan(
+            pipe, [c.name for c in scan_cols]) if late else frozenset()
         return plan, pipe, scan_cols, schema, partial_schema, dicts, \
-            join_metas
+            join_metas, late_scan
 
     @staticmethod
     def _lift_limit_setup(plan: QueryPlan, all_params=None,
@@ -861,6 +977,137 @@ class Executor:
         if all_params is not None:
             all_params[LIMIT_PARAM] = np.int32(lim2)
         return True, ("limB", bucket_capacity(lim2, minimum=128))
+
+    def _compact_sizing(self, base_key, pipe, builds, sources,
+                        cap0: int) -> Optional[int]:
+        """Ladder-quantized capacity the fused pipeline compacts to
+        after its join steps, or None when compaction isn't worth a
+        shape (`ir.Compact` placement: `ops/fused._fused_body`).
+
+        The estimate is sizing-quality, not correctness-bearing — the
+        device overflow flag catches every underestimate and the
+        statement reruns at full capacity (loud). Components:
+
+        * live scan rows, tightened by the CBO's post-local-predicate
+          estimate (`ScanSpec.est_rows`) when present;
+        * per INNER join against a filtered build, a uniform-FK
+          selectivity `min(1, build_rows / base_table_rows)` — the
+          Selinger containment assumption (q7's nation-filtered
+          supplier ~2/25);
+        * per SEMI join whose build key is declared-UNIQUE, coverage
+          `min(1, build_rows / key_domain)`: a unique build holds one
+          row per covered key, so its cardinality IS the covered-key
+          count and the ratio is the uniform-FK survival probability
+          (q9's part-name semi keeps ~1/17 of lineitem; q18's
+          300-quantity order set keeps ~60 of 1.5M orders). Non-unique
+          semi builds deliberately do NOT reduce — there the probe
+          survives on key COVERAGE, not build cardinality, and under FK
+          fanout even a heavily filtered build covers most probe keys
+          (the q4 shape before its subplan build deduped: 63% of
+          lineitem rows covered ~98% of orders; applying the raw
+          cardinality ratio forged the bound low and burned overflow
+          reruns). `_semi_key_domain` picks the denominator: the probe
+          key's own table when the probe key is its declared PK (q18's
+          o_orderkey → orders), else the build's base table (q9's
+          l_partkey probe → part);
+        * the measured-live memo (monotone max per compact-free key):
+          an observed live count is never undercut again;
+        * 25% headroom, floor 1024, quantized UP on the fine segment
+          ladder (`progstore/buckets.bucket_segment`) so data growth
+          recompiles at ≤1.25x-ratio rungs, not per row count;
+        * STICKY per compact-free key: once a capacity is chosen, data
+          growth that still fits inside it reuses the compiled program
+          (the headroom absorbs within-bucket growth — the shape-bucket
+          churn pin stays intact); the capacity re-derives only when
+          the estimate outgrows it.
+
+        Only capacities under cap0/2 are worth the reshape."""
+        from ydb_tpu.ops.xla_exec import late_mat_enabled
+        if not late_mat_enabled():
+            return None
+        live = float(sum(b.length for b in sources)) if sources else 0.0
+        if pipe.scan.est_rows >= 0:
+            live = min(live, float(pipe.scan.est_rows))
+        est = live
+        bi = 0
+        for kind, step in pipe.steps:
+            if kind != "join":
+                continue
+            bt = builds[bi]
+            bi += 1
+            if step.not_in:
+                continue
+            if step.kind == "inner":
+                base = self._build_base_rows(step)
+                if base > 0:
+                    est *= min(1.0, float(int(bt.n)) / base)
+            elif step.kind == "left_semi":
+                dom = self._semi_key_domain(step)
+                if dom > 0:
+                    est *= min(1.0, float(int(bt.n)) / dom)
+        if pipe.out_bound and not (
+                pipe.partial is not None
+                and any(isinstance(c, ir.GroupBy)
+                        for c in pipe.partial.commands)):
+            # a pipeline bound proven at plan time bounds the PRE-partial
+            # rows only when no partial group-by sits between
+            est = min(est, float(pipe.out_bound))
+        est = max(est, float(self._compact_memo.get(base_key, 0)))
+        prev = self._compact_caps.get(base_key)
+        if prev is not None and est <= prev:
+            return prev
+        cand = shape_buckets.bucket_segment(
+            max(int(est * 1.25) + 1, 1024))
+        if cand >= cap0 // 2:
+            self._compact_caps.pop(base_key, None)
+            return None
+        self._compact_caps[base_key] = cand
+        return cand
+
+    def _build_base_rows(self, step: JoinStep) -> int:
+        """Unfiltered base-table row count of a join's build side (the
+        FK-selectivity denominator); 0 = unknown (no reduction
+        assumed). The planner stamps `est_rows` POST-predicate; the
+        denominator needs the unfiltered table, so resolve through the
+        catalog like the bounds lattice does."""
+        build = step.build
+        pipe = getattr(build, "pipeline", build)   # QueryPlan | Pipeline
+        scan = getattr(pipe, "scan", None)
+        if scan is None:
+            return 0
+        try:
+            tbl = self.catalog.table(scan.table)
+        except Exception:              # noqa: BLE001 — sizing, not law
+            return 0
+        return int(getattr(tbl, "num_rows", 0))
+
+    def _semi_key_domain(self, step: JoinStep) -> int:
+        """Key-domain denominator for a semi join's coverage estimate,
+        or 0 when the build key isn't declared-unique (no reduction —
+        see `_compact_sizing`). A probe key that is itself the declared
+        single-column PK of its aliased table names the domain exactly
+        (q18: o_orderkey → orders rows). A plain-pipeline build whose
+        scan PK is the key uses its base table (q9: part filter — every
+        base row is one distinct key). A SUBPLAN build probed by a
+        non-PK key gets no domain: its scan table counts ROWS, not
+        keys, and under FK fanout that denominator forges the estimate
+        low (q21's correlated-exists orderkey set over lineitem —
+        4 rows per key → a 4x understatement and an overflow rerun)."""
+        from ydb_tpu.query import bounds
+        from ydb_tpu.query.plan import QueryPlan
+        if not bounds._build_key_unique_declared(step, self.catalog):
+            return 0
+        if "." in step.probe_key:
+            alias, col = step.probe_key.split(".", 1)
+            try:
+                tbl = self.catalog.table(alias)
+                if list(tbl.key_columns) == [col]:
+                    return int(getattr(tbl, "num_rows", 0))
+            except Exception:          # noqa: BLE001 — sizing, not law
+                pass
+        if not isinstance(step.build, QueryPlan):
+            return self._build_base_rows(step)
+        return 0
 
     # -- multi-query batched dispatch --------------------------------------
 
@@ -900,7 +1147,7 @@ class Executor:
                                                     "mark")):
                 return None
         (plan, pipe, scan_cols, schema, partial_schema, dicts,
-         join_metas) = self._fused_plan_setup(plan, builds)
+         join_metas, late_scan) = self._fused_plan_setup(plan, builds)
 
         storage_names = [s for (s, _i) in pipe.scan.columns]
         rename = {s: i for (s, i) in pipe.scan.columns}
@@ -1002,7 +1249,8 @@ class Executor:
             bfn, box = F.build_fused_batched_fn(
                 pipe, plan.final_program, scan_cols, K, CAP, sb_valid_names,
                 join_metas, rank_assigns, sort_spec, plan.limit,
-                plan.offset, keep, dict(axes), Bb, lift_limit=lift_limit)
+                plan.offset, keep, dict(axes), Bb, lift_limit=lift_limit,
+                late_scan=late_scan)
             out_cols = [c for c in schema.columns if c.name in keep] \
                 or list(schema.columns)
             return bfn, box, Schema(out_cols)
@@ -1028,7 +1276,9 @@ class Executor:
                             "batched", key, _builder,
                             (arrays, valids, lengths, build_inputs,
                              dev_params), cache=False)
-                data_stacks, valid_stack, length = fn(
+                # no compact in the batched lane (aux is always empty
+                # — `_fused_plan_setup` never hands it a compact_prog)
+                data_stacks, valid_stack, length, _aux = fn(
                     arrays, valids, lengths, build_inputs, dev_params)
                 if fresh_compile:
                     dsp.attrs["compile_ms"] = round(

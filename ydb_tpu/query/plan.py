@@ -43,6 +43,14 @@ class JoinStep:
     # composite keys: executor hashes these build columns host-side into
     # `build_key` before building (probe side hashes in its program)
     build_hash_keys: list = field(default_factory=list)
+    # sizing metadata ONLY (bounds lattice / compact sizing): the
+    # storage-backed build columns a synthesized `build_key` was derived
+    # from, for in-program composite hashes where `build_hash_keys` must
+    # stay empty (the hash is computed inside the build's partial, not
+    # host-side). Lets PK-uniqueness survive the key synthesis — without
+    # it a composite-PK probe (q9 lineitem x partsupp on the partkey/
+    # suppkey pair) degrades the pipeline bound to a row product.
+    build_key_cols: list = field(default_factory=list)
 
 
 @dataclass
@@ -58,6 +66,11 @@ class Pipeline:
     # Sizing-quality (admission, segment sizing, EXPLAIN) — the
     # correctness-bearing bounds live on ir.GroupBy.
     out_bound: int = 0
+    # late materialization (query/latemat.py): columns the fused path
+    # carries as row-ids — scan deferrals by name, join payloads as
+    # "name(row-id)". Observability metadata (EXPLAIN `-- latemat:`);
+    # the executor recomputes the sets against the actual fused shape.
+    late_names: tuple = ()
 
 
 @dataclass
@@ -115,6 +128,9 @@ def explain(plan: QueryPlan, indent: int = 0) -> str:
         if p.out_bound:
             lines.append(f"{pp}  -- bounds: pipeline ≤ {p.out_bound} rows"
                          + _gb_bounds(p.partial))
+        if p.late_names:
+            lines.append(f"{pp}  -- latemat: {len(p.late_names)} deferred "
+                         f"[{', '.join(p.late_names)}]")
         if p.pre_program:
             lines.append(f"{pp}  pre: {_prog(p.pre_program)}")
         for kind, step in p.steps:

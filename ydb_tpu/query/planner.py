@@ -197,6 +197,15 @@ class Planner:
                 annotate_plan(plan, self.catalog)
             except Exception:          # noqa: BLE001 — sizing, not law
                 pass
+            try:
+                # late-materialization sets (query/latemat.py): which
+                # columns the fused path carries as row-ids — EXPLAIN
+                # metadata; the executor recomputes against the actual
+                # fused shape, so this too must never fail a query
+                from ydb_tpu.query.latemat import annotate_plan as _lm
+                _lm(plan)
+            except Exception:          # noqa: BLE001 — sizing, not law
+                pass
             return plan
 
     def plan_dq(self, sel: ast.Select, topology):
@@ -399,17 +408,31 @@ class Planner:
         # The non-unique penalty is steep: such builds force expanding
         # probes onto the portioned path, losing whole-query fusion — on
         # this platform a constant-factor cliff, not a linear cost.
+        # And when such a build must also ATTACH PAYLOAD (columns of it
+        # are demanded above the join — a payload-free join replans as a
+        # fusable semi probe), the cliff is certain, so the DRIVER's
+        # effective rows join the cost: the portioned fallback walks the
+        # whole driving stream host-side. That term is what flips q12
+        # onto the lineitem-driven orientation — a 32× penalty on a
+        # well-filtered lineitem build still undercut scanning every
+        # order through the host lane.
         _BAD_MULT = 32.0
+        payload_alias = {a for a in rels
+                         if any(n.split(".", 1)[0] == a for n in needed)}
         best = None
         for cand in rels:
             children_c, in_tree_c, leftovers_c, scores = \
                 self._spanning_tree(cand, rels, edges, eff)
             unreachable = set(rels) - in_tree_c
             cost = 0.0
+            defused = False
             for a in in_tree_c:
                 if a != cand:
-                    cost += eff[a] * (1.0 if scores.get(a, 0) >= 2
-                                      else _BAD_MULT)
+                    bad = scores.get(a, 0) < 2
+                    cost += eff[a] * (_BAD_MULT if bad else 1.0)
+                    defused = defused or (bad and a in payload_alias)
+            if defused:
+                cost += eff[cand]
             rank = (len(unreachable), cost)
             if best is None or rank < best[0]:
                 best = (rank, cand, children_c, in_tree_c, leftovers_c)
@@ -658,8 +681,11 @@ class Planner:
                 payload = list(dict.fromkeys(
                     [c for c in sub.out_names if c in needed]
                     + [b.internal for b in build_bs] + remap_names))
-                join_steps.append((JoinStep(sub, bjk, jk, "inner", payload),
-                                   verify))
+                join_steps.append(
+                    (JoinStep(sub, bjk, jk, "inner", payload,
+                              build_key_cols=[b.internal
+                                              for b in build_bs]),
+                     verify))
 
         # own columns demanded from above
         own_cols = {n for n in needed

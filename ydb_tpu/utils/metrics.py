@@ -304,6 +304,9 @@ COUNTER_REGISTRY = {
     "dq/planned_overflow_reruns":
         "[viz] planned exchanges whose counts beat the sized segment "
         "(full-capacity rerun)",
+    "dq/count_exchange_batched":
+        "[viz] stage-level batched count exchanges (one fused counts "
+        "program + one device_get for ALL outgoing edges)",
     # -- Hive control plane -------------------------------------------------
     "hive/registered": "[viz] workers registered (first time)",
     "hive/heartbeats": "[viz] lease renewals (push agents or pulse)",
@@ -356,6 +359,19 @@ COUNTER_REGISTRY = {
         "mesh shuffle merges with bound-sized segments",
     "groupby/join_bounded_plans":
         "[viz] plans whose group count a join build side bounded",
+    # -- late materialization (query/latemat.py, YDB_TPU_LATE_MAT) ---------
+    "latemat/deferred_cols":
+        "[viz] columns carried as row-ids per fused dispatch "
+        "(scan deferrals + late join payloads)",
+    "latemat/compact_plans":
+        "[viz] fused dispatches carrying a bound-sized ir.Compact",
+    "latemat/compact_capacity_rows":
+        "ladder-quantized compact capacities allocated (rows)",
+    "latemat/compact_live_rows":
+        "measured live rows at the compact seam (rows)",
+    "latemat/compact_overflow_reruns":
+        "[viz] compacts whose live count beat the sized bound "
+        "(full-capacity rerun — loud, never a truncation)",
     "sort/rows_max": "[viz] (dynamic) lax.sort row watermark",
     "sort/operands_max": "[viz] (dynamic) lax.sort operand watermark",
     # -- program / device caches -------------------------------------------
